@@ -1,0 +1,74 @@
+"""Benchmark: ResNet-50 training throughput (images/sec/chip).
+
+Counterpart of the reference's `train_imagenet.py --benchmark 1`
+(synthetic data) + docs/faq/perf.md methodology.  Baseline of record
+(BASELINE.md): V100 fp16 training ≈ 364 img/s at batch 128; fp32 ≈ 300.
+
+Runs the fused sharded train step (mxnet_tpu.parallel.ShardedTrainer):
+one XLA program per step (fwd+bwd+update, donated buffers), bf16 compute
+with fp32 params — the TPU-native equivalent of the reference's
+Module + kvstore('device') training loop.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, gluon, parallel
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
+    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+    on_tpu = any(d.platform != "cpu" for d in jax.devices())
+    if not on_tpu:
+        # keep CPU smoke runs fast
+        batch = min(batch, 16)
+        steps = min(steps, 3)
+        warmup = 1
+
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize(mx.init.Xavier())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    trainer = parallel.ShardedTrainer(
+        net, lambda o, l: loss_fn(o, l), mesh=None, optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
+        dtype=jax.numpy.bfloat16 if on_tpu else None)
+
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.rand(batch, 3, 224, 224).astype(np.float32))
+    y = nd.array(rng.randint(0, 1000, batch).astype(np.float32))
+
+    # warmup/compile
+    for _ in range(warmup):
+        loss = trainer.step([x], y)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = trainer.step([x], y)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    ips = batch * steps / dt
+    baseline = 364.0  # V100 fp16 train img/s @ bs128 (BASELINE.md)
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(ips, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(ips / baseline, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,112 @@
+"""ImageNet training — counterpart of the reference's
+example/image-classification/train_imagenet.py (BASELINE config 2/4).
+
+--benchmark 1 runs on synthetic data (the reference's benchmark flag);
+--kv-store dist_device_sync under tools/launch.py runs the TCP-PS data
+parallel path; on a TPU mesh use --sharded for the fused in-program
+collective trainer (the fast path).
+"""
+import argparse
+import logging
+import time
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd, parallel
+from mxnet_tpu.gluon.model_zoo import vision
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--network", default="resnet50_v1")
+    parser.add_argument("--batch-size", type=int, default=128)
+    parser.add_argument("--num-classes", type=int, default=1000)
+    parser.add_argument("--image-shape", default="3,224,224")
+    parser.add_argument("--lr", type=float, default=0.1)
+    parser.add_argument("--num-epochs", type=int, default=1)
+    parser.add_argument("--benchmark", type=int, default=0)
+    parser.add_argument("--steps", type=int, default=30)
+    parser.add_argument("--kv-store", default="device")
+    parser.add_argument("--sharded", action="store_true",
+                        help="use the mesh ShardedTrainer fast path")
+    parser.add_argument("--data-train", default=None,
+                        help=".rec file for real training")
+    args = parser.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    shape = tuple(int(x) for x in args.image_shape.split(","))
+    net = vision.get_model(args.network, classes=args.num_classes)
+    net.initialize(mx.init.Xavier())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    if args.benchmark:
+        rng = np.random.RandomState(0)
+        x = mx.nd.array(rng.rand(args.batch_size, *shape).astype(np.float32))
+        y = mx.nd.array(rng.randint(0, args.num_classes,
+                                    args.batch_size).astype(np.float32))
+        if args.sharded:
+            import jax
+
+            mesh = parallel.local_mesh()
+            trainer = parallel.ShardedTrainer(
+                net, lambda o, l: loss_fn(o, l), mesh=mesh,
+                optimizer="sgd",
+                optimizer_params={"learning_rate": args.lr, "momentum": 0.9})
+            xs, ys = trainer.shard_batch(x, y)
+            trainer.step([xs], ys)  # compile
+            t0 = time.time()
+            for _ in range(args.steps):
+                loss = trainer.step([xs], ys)
+            jax.block_until_ready(loss)
+        else:
+            net.hybridize()
+            trainer = gluon.Trainer(net.collect_params(), "sgd",
+                                    {"learning_rate": args.lr,
+                                     "momentum": 0.9},
+                                    kvstore=args.kv_store)
+            with autograd.record():
+                loss = loss_fn(net(x), y)
+            loss.backward()
+            trainer.step(args.batch_size)
+            t0 = time.time()
+            for _ in range(args.steps):
+                with autograd.record():
+                    loss = loss_fn(net(x), y)
+                loss.backward()
+                trainer.step(args.batch_size)
+            loss.wait_to_read()
+        dt = time.time() - t0
+        print("speed: %.2f images/sec" % (args.batch_size * args.steps / dt))
+        return
+
+    assert args.data_train, "provide --data-train .rec or use --benchmark 1"
+    train = mx.io.ImageRecordIter(
+        path_imgrec=args.data_train, data_shape=shape,
+        batch_size=args.batch_size, shuffle=True, rand_crop=True,
+        rand_mirror=True)
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9,
+                             "wd": 1e-4}, kvstore=args.kv_store)
+    metric = mx.metric.Accuracy()
+    net.hybridize()
+    for epoch in range(args.num_epochs):
+        train.reset()
+        metric.reset()
+        tic = time.time()
+        for i, batch in enumerate(train):
+            x, y = batch.data[0], batch.label[0]
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(args.batch_size)
+            metric.update([y], [out])
+            if i % 20 == 0:
+                logging.info("epoch %d batch %d %s %.1f img/s", epoch, i,
+                             metric.get(),
+                             args.batch_size * (i + 1) / (time.time() - tic))
+
+
+if __name__ == "__main__":
+    main()

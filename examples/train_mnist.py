@@ -1,0 +1,94 @@
+"""MNIST training — counterpart of the reference's
+example/image-classification/train_mnist.py (BASELINE config 1).
+
+Runs both API families: Module.fit over a Symbol MLP, and a Gluon
+LeNet with hybridize (jit). Uses local idx-ubyte files when present
+(--data-dir), deterministic synthetic digits otherwise.
+"""
+import argparse
+import logging
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, autograd
+from mxnet_tpu.gluon import nn
+
+
+def get_iters(batch_size):
+    train = mx.io.MNISTIter(batch_size=batch_size, shuffle=True, flat=False)
+    val = mx.io.MNISTIter(batch_size=batch_size, shuffle=False, flat=False)
+    return train, val
+
+
+def mlp_symbol():
+    data = mx.sym.var("data")
+    net = mx.sym.Flatten(data)
+    net = mx.sym.FullyConnected(net, num_hidden=128, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=64, name="fc2")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=10, name="fc3")
+    return mx.sym.SoftmaxOutput(net, mx.sym.var("softmax_label"),
+                                name="softmax")
+
+
+def lenet_gluon():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(20, 5, activation="relu"), nn.MaxPool2D(2, 2),
+            nn.Conv2D(50, 5, activation="relu"), nn.MaxPool2D(2, 2),
+            nn.Flatten(), nn.Dense(500, activation="relu"), nn.Dense(10))
+    return net
+
+
+def train_module(args):
+    train, val = get_iters(args.batch_size)
+    mod = mx.mod.Module(mlp_symbol(), context=mx.gpu() if args.gpus
+                        else mx.cpu())
+    mod.fit(train, eval_data=val, optimizer="sgd",
+            optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
+            num_epoch=args.epochs,
+            batch_end_callback=mx.callback.Speedometer(args.batch_size, 50))
+    return mod.score(val, "acc")
+
+
+def train_gluon(args):
+    train, val = get_iters(args.batch_size)
+    ctx = mx.gpu() if args.gpus else mx.cpu()
+    net = lenet_gluon()
+    net.initialize(mx.init.Xavier(), ctx=ctx)
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": args.lr, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+    for epoch in range(args.epochs):
+        train.reset()
+        metric.reset()
+        for batch in train:
+            x = batch.data[0].as_in_context(ctx)
+            y = batch.label[0].as_in_context(ctx)
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(args.batch_size)
+            metric.update([y], [out])
+        logging.info("gluon epoch %d %s", epoch, metric.get())
+    return metric.get()
+
+
+if __name__ == "__main__":
+    logging.basicConfig(level=logging.INFO)
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--lr", type=float, default=0.05)
+    parser.add_argument("--gpus", type=int, default=0)
+    parser.add_argument("--api", choices=["module", "gluon", "both"],
+                        default="both")
+    args = parser.parse_args()
+    if args.api in ("module", "both"):
+        print("module:", train_module(args))
+    if args.api in ("gluon", "both"):
+        print("gluon:", train_gluon(args))

@@ -1,0 +1,366 @@
+"""Autograd: imperative tape -> jax.vjp.
+
+Reference parity: src/imperative/imperative.cc (RecordOp tape, Backward
+graph construction via the nnvm MXGradient pass) and the Python surface
+python/mxnet/autograd.py (record/pause scopes :122,146, mark_variables:197,
+backward:243, grad:270, custom Function :385-511).
+
+TPU-native design: while recording, each differentiable op appends a tape
+node holding its OpInfo + captured input arrays.  backward() walks the
+tape in reverse topological order and calls jax.vjp on each op's jax
+function — no hand-written FGradient registry; the vjp of the *same*
+traced code is the gradient.  (The jit path — CachedOp/hybridize — skips
+the tape entirely and differentiates the whole step with jax.grad.)
+"""
+from __future__ import annotations
+
+import threading
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "set_recording", "set_training", "mark_variables",
+           "backward", "grad", "get_symbol", "Function"]
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+    return _state
+
+
+def is_recording():
+    return _st().recording
+
+
+def is_training():
+    return _st().training
+
+
+def set_recording(is_record):
+    st = _st()
+    prev = st.recording
+    st.recording = bool(is_record)
+    return prev
+
+
+def set_training(train_mode_):
+    st = _st()
+    prev = st.training
+    st.training = bool(train_mode_)
+    return prev
+
+
+class _RecordingStateScope:
+    def __init__(self, is_record, train_mode_):
+        self._enter_record = is_record
+        self._enter_train = train_mode_
+        self._prev = None
+
+    def __enter__(self):
+        st = _st()
+        self._prev = (st.recording, st.training)
+        if self._enter_record is not None:
+            st.recording = self._enter_record
+        if self._enter_train is not None:
+            st.training = self._enter_train
+        return self
+
+    def __exit__(self, *a):
+        st = _st()
+        st.recording, st.training = self._prev
+
+
+def record(train_mode=True):
+    """`with autograd.record():` parity (autograd.py:122)."""
+    return _RecordingStateScope(True, train_mode)
+
+
+def pause(train_mode=False):
+    return _RecordingStateScope(False, train_mode)
+
+
+def train_mode():
+    return _RecordingStateScope(None, True)
+
+
+def predict_mode():
+    return _RecordingStateScope(None, False)
+
+
+# ---------------------------------------------------------------------------
+# tape structures
+# ---------------------------------------------------------------------------
+
+
+class _TapeRef:
+    """Identity of one tensor *version* on the tape (parity: nnvm NodeEntry
+    + engine var version)."""
+
+    __slots__ = ("producer", "out_index", "variable", "array")
+
+    def __init__(self, producer=None, out_index=0, variable=None, array=None):
+        self.producer = producer
+        self.out_index = out_index
+        self.variable = variable  # NDArray with .grad attached
+        self.array = array  # captured jax array (for zeros_like etc.)
+
+
+class _TapeNode:
+    __slots__ = ("info", "attrs", "input_refs", "input_arrays",
+                 "output_refs", "custom_backward")
+
+    def __init__(self, info, attrs, input_refs, input_arrays, custom_backward=None):
+        self.info = info
+        self.attrs = attrs
+        self.input_refs = input_refs
+        self.input_arrays = input_arrays
+        self.output_refs = []
+        self.custom_backward = custom_backward
+
+
+def record_op(info, attrs, nd_inputs, nd_outputs, custom_backward=None):
+    """Append an op to the tape if any input participates in grad flow."""
+    input_refs = [x._tape_ref for x in nd_inputs]
+    if not any(r is not None for r in input_refs):
+        return
+    node = _TapeNode(info, dict(attrs), input_refs,
+                     [x._data for x in nd_inputs], custom_backward)
+    for i, out in enumerate(nd_outputs):
+        ref = _TapeRef(producer=node, out_index=i, array=out._data)
+        node.output_refs.append(ref)
+        out._tape_ref = ref
+
+
+def mark_variables(variables, gradients, grad_reqs="write"):
+    """Parity: autograd.mark_variables (autograd.py:197)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for var, g, req in zip(variables, gradients, grad_reqs):
+        var._grad = g
+        var._grad_req = req
+        var._tape_ref = _TapeRef(variable=var, array=var._data)
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+
+def _topo_nodes(output_refs):
+    seen = set()
+    order = []
+
+    def visit(node):
+        if node is None or id(node) in seen:
+            return
+        seen.add(id(node))
+        for r in node.input_refs:
+            if r is not None and r.producer is not None:
+                visit(r.producer)
+        order.append(node)
+
+    for ref in output_refs:
+        if ref is not None and ref.producer is not None:
+            visit(ref.producer)
+    return order
+
+
+def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
+    """Compute gradients of heads w.r.t. marked variables
+    (parity: autograd.backward / Imperative::Backward)."""
+    import jax
+    import jax.numpy as jnp
+
+    from .ndarray.ndarray import NDArray
+
+    if isinstance(heads, NDArray):
+        heads = [heads]
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+    elif isinstance(head_grads, NDArray):
+        head_grads = [head_grads]
+
+    grad_map = {}  # id(_TapeRef) -> jax array
+    for h, hg in zip(heads, head_grads):
+        ref = h._tape_ref
+        if ref is None:
+            continue
+        g = hg._data if hg is not None else jnp.ones_like(h._data)
+        key = id(ref)
+        grad_map[key] = grad_map[key] + g if key in grad_map else g
+
+    nodes = _topo_nodes([h._tape_ref for h in heads])
+
+    with _RecordingStateScope(False, train_mode):
+        for node in reversed(nodes):
+            out_grads = []
+            any_grad = False
+            for ref in node.output_refs:
+                g = grad_map.get(id(ref))
+                if g is None:
+                    g = jnp.zeros_like(ref.array)
+                else:
+                    any_grad = True
+                out_grads.append(g)
+            if not any_grad:
+                continue
+            if node.custom_backward is not None:
+                in_grads = node.custom_backward(out_grads)
+            else:
+                info, attrs = node.info, node.attrs
+
+                def f(*arrs):
+                    return info.fn(*arrs, **attrs)
+
+                _, vjp_fn = jax.vjp(f, *node.input_arrays)
+                multi = len(node.output_refs) > 1
+                cot = tuple(out_grads) if multi else out_grads[0]
+                in_grads = vjp_fn(cot)
+            for ref, g in zip(node.input_refs, in_grads):
+                if ref is None or g is None:
+                    continue
+                if hasattr(g, "dtype") and g.dtype == jax.dtypes.float0:
+                    continue
+                key = id(ref)
+                grad_map[key] = grad_map[key] + g if key in grad_map else g
+
+    # write into marked variables
+    def deposit(ref):
+        if ref is None or ref.variable is None:
+            return
+        g = grad_map.get(id(ref))
+        if g is None:
+            return
+        var = ref.variable
+        if var._grad is None:
+            return
+        if var._grad_req == "add":
+            var._grad._rebind(var._grad._data + g)
+        elif var._grad_req != "null":
+            var._grad._rebind(g.astype(var._grad._data.dtype))
+
+    seen_refs = set()
+    for node in nodes:
+        for ref in node.input_refs:
+            if ref is not None and id(ref) not in seen_refs:
+                seen_refs.add(id(ref))
+                deposit(ref)
+    for h in heads:
+        ref = h._tape_ref
+        if ref is not None and id(ref) not in seen_refs:
+            seen_refs.add(id(ref))
+            deposit(ref)
+
+    if not retain_graph:
+        for h in heads:
+            if h._tape_ref is not None and h._tape_ref.variable is None:
+                h._tape_ref = None
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Parity: autograd.grad (autograd.py:270). First-order only; the
+    TPU-native higher-order path is jax.grad-of-jax.grad on a hybridized
+    block."""
+    if create_graph:
+        raise NotImplementedError(
+            "create_graph=True: use jax.grad composition via CachedOp")
+    from .ndarray.ndarray import NDArray, zeros
+
+    if isinstance(variables, NDArray):
+        variables = [variables]
+    old = [(v._grad, v._grad_req, v._tape_ref) for v in variables]
+    # temporarily mark
+    for v in variables:
+        if v._tape_ref is None or v._tape_ref.variable is None:
+            raise MXNetError("variables passed to grad() must have attached "
+                             "grad (attach_grad) and participate in the graph")
+        v._grad = zeros(v.shape, dtype=v.dtype)
+    backward(heads, head_grads, retain_graph=bool(retain_graph),
+             train_mode=train_mode)
+    outs = [v._grad for v in variables]
+    for v, (g, req, ref) in zip(variables, old):
+        v._grad, v._grad_req, v._tape_ref = g, req, ref
+    return outs
+
+
+def get_symbol(x):
+    """Parity: autograd.get_symbol — reconstruct a Symbol from the tape."""
+    from .symbol import symbol as _sym
+
+    ref = x._tape_ref
+    counter = [0]
+    cache = {}
+
+    def build(ref):
+        if ref is None or ref.producer is None:
+            counter[0] += 1
+            return _sym.var("data%d" % counter[0])
+        node = ref.producer
+        if id(node) not in cache:
+            ins = [build(r) for r in node.input_refs]
+            cache[id(node)] = _sym._invoke_sym(node.info.name, ins, node.attrs)
+        out = cache[id(node)]
+        return out[ref.out_index] if len(node.output_refs) > 1 else out
+
+    return build(ref)
+
+
+# ---------------------------------------------------------------------------
+# custom differentiable Function (parity: autograd.Function :385-511)
+# ---------------------------------------------------------------------------
+
+
+class Function:
+    """User-defined differentiable function over NDArrays."""
+
+    class _Registry:
+        pass
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *args):
+        self._saved = args
+
+    @property
+    def saved_tensors(self):
+        return self._saved or ()
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray.ndarray import NDArray
+        from .ops.registry import OpInfo
+
+        with pause():
+            outputs = self.forward(*inputs)
+        single = not isinstance(outputs, (list, tuple))
+        outs = [outputs] if single else list(outputs)
+
+        if is_recording():
+            fn_self = self
+
+            def custom_backward(out_grads_raw):
+                ograds = [NDArray(g) for g in out_grads_raw]
+                with pause():
+                    igrads = fn_self.backward(*ograds)
+                if not isinstance(igrads, (list, tuple)):
+                    igrads = [igrads]
+                return [g._data if isinstance(g, NDArray) else g for g in igrads]
+
+            info = OpInfo("_custom_function", None, num_inputs=len(inputs),
+                          num_outputs=len(outs))
+            record_op(info, {}, list(inputs), outs,
+                      custom_backward=custom_backward)
+        return outputs

@@ -1,0 +1,93 @@
+"""Base types and helpers for the TPU-native MXNet-capability framework.
+
+Reference parity: python/mxnet/base.py (MXNetError, name managers, dtype
+maps fed from the C registry).  Here there is no C ABI — the "registry" is
+a pure-Python op registry (mxnet_tpu/ops/registry.py) and dtypes map
+directly onto numpy/jax dtypes.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = [
+    "MXNetError", "MXTpuError", "string_types", "numeric_types",
+    "integer_types", "dtype_np_to_str", "dtype_str_to_np",
+    "classproperty", "_Null",
+]
+
+
+class MXNetError(RuntimeError):
+    """Error raised by the framework (parity with mxnet.base.MXNetError)."""
+
+
+# Alias under the new framework's own name.
+MXTpuError = MXNetError
+
+string_types = (str,)
+numeric_types = (float, int, np.generic)
+integer_types = (int, np.integer)
+
+
+class _NullType:
+    """Placeholder for missing kwargs (parity with mxnet.base._Null)."""
+
+    _inst = None
+
+    def __new__(cls):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+    def __repr__(self):
+        return "_Null"
+
+    def __bool__(self):
+        return False
+
+
+_Null = _NullType()
+
+# dtype string <-> numpy mapping, mirroring mxnet's supported set
+# (reference: python/mxnet/base.py _DTYPE_NP_TO_MX / _DTYPE_MX_TO_NP)
+# plus bfloat16 which is first-class on TPU.
+_DTYPE_STR = {
+    "float32": np.float32,
+    "float64": np.float64,
+    "float16": np.float16,
+    "uint8": np.uint8,
+    "int8": np.int8,
+    "int32": np.int32,
+    "int64": np.int64,
+    "bool": np.bool_,
+}
+try:  # bfloat16 via ml_dtypes (always present with jax)
+    import ml_dtypes
+
+    _DTYPE_STR["bfloat16"] = ml_dtypes.bfloat16
+except ImportError:  # pragma: no cover
+    pass
+
+
+def dtype_str_to_np(dtype):
+    """Normalize a dtype spec (str, np.dtype, type) to a numpy dtype class."""
+    if dtype is None:
+        return np.float32
+    if isinstance(dtype, str):
+        if dtype not in _DTYPE_STR:
+            raise MXNetError("unknown dtype %r" % (dtype,))
+        return _DTYPE_STR[dtype]
+    return np.dtype(dtype).type if not isinstance(dtype, type) else dtype
+
+
+def dtype_np_to_str(dtype):
+    """numpy dtype -> canonical string name."""
+    name = np.dtype(dtype).name
+    return name
+
+
+class classproperty:
+    def __init__(self, fget):
+        self.fget = fget
+
+    def __get__(self, obj, owner):
+        return self.fget(owner)

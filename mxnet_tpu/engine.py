@@ -1,0 +1,129 @@
+"""Dependency-engine facade, TPU-native.
+
+Reference parity: src/engine/ (ThreadedEnginePerDevice, NaiveEngine;
+include/mxnet/engine.h Engine::PushAsync/WaitForVar/WaitForAll).
+
+TPU-native design: JAX dispatch *is* the dependency engine — every op
+returns immediately with a future-backed jax.Array, and XLA:TPU orders
+execution by data dependence, exactly what ThreadedVar queues provided.
+What remains here is the control surface the reference exposes:
+
+- ``wait_for_var(arr)``  -> jax ``block_until_ready`` (engine.h:230 WaitForVar)
+- ``wait_for_all()``     -> block on all live arrays / clear async error state
+- NaiveEngine mode (``MXNET_ENGINE_TYPE=NaiveEngine`` or set_engine_type) ->
+  every op blocks on completion; the race-free oracle used to bisect
+  scheduler bugs (src/engine/threaded_engine.h:400-404 suggests the same).
+- deferred exception semantics: ops that fail asynchronously (TPU-side)
+  surface at the next sync point; we capture callbacks' exceptions and
+  rethrow at wait_* (src/engine/threaded_engine.cc:379-430).
+- bulking knobs (engine.h:311-317) are accepted and ignored — XLA fuses.
+"""
+from __future__ import annotations
+
+import os
+import threading
+
+__all__ = ["Engine", "get", "set_bulk_size", "bulk"]
+
+
+class Engine:
+    """Singleton facade over JAX async dispatch."""
+
+    _inst = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self._engine_type = os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+        self._bulk_size = 0
+        self._deferred_exc = []
+        self._exc_lock = threading.Lock()
+
+    # -- singleton --------------------------------------------------------
+    @staticmethod
+    def get():
+        with Engine._lock:
+            if Engine._inst is None:
+                Engine._inst = Engine()
+        return Engine._inst
+
+    # -- engine type ------------------------------------------------------
+    @property
+    def is_naive(self):
+        return self._engine_type == "NaiveEngine"
+
+    def set_engine_type(self, name):
+        self._engine_type = name
+
+    # -- sync points ------------------------------------------------------
+    def wait_for_var(self, data):
+        """Block until `data` (a jax.Array or nested structure) is ready,
+        rethrowing any deferred exception (parity: Engine::WaitForVar)."""
+        self._rethrow()
+        import jax
+
+        jax.block_until_ready(data)
+        self._rethrow()
+        return data
+
+    def wait_for_all(self):
+        """Parity: Engine::WaitForAll. JAX has no global barrier; callers
+        that need one block per-array via wait_for_var. We still drain and
+        rethrow deferred exceptions here."""
+        import jax
+
+        # effects_barrier waits for all dispatched computations' side effects
+        try:
+            jax.effects_barrier()
+        except Exception:  # pragma: no cover - defensive
+            pass
+        self._rethrow()
+
+    # -- deferred exceptions ----------------------------------------------
+    def record_exception(self, exc):
+        with self._exc_lock:
+            self._deferred_exc.append(exc)
+
+    def _rethrow(self):
+        with self._exc_lock:
+            if self._deferred_exc:
+                exc = self._deferred_exc.pop(0)
+                raise exc
+
+    # -- bulking (accepted, delegated to XLA fusion) ----------------------
+    def set_bulk_size(self, size):
+        prev, self._bulk_size = self._bulk_size, size
+        return prev
+
+    @property
+    def bulk_size(self):
+        return self._bulk_size
+
+    # -- naive-mode hook used by NDArray op dispatch ----------------------
+    def maybe_block(self, data):
+        if self.is_naive:
+            import jax
+
+            jax.block_until_ready(data)
+        return data
+
+
+def get():
+    return Engine.get()
+
+
+def set_bulk_size(size):
+    """Parity: mx.engine.set_bulk_size."""
+    return Engine.get().set_bulk_size(size)
+
+
+class bulk:
+    """Parity: `with mx.engine.bulk(size):` — a no-op scope (XLA fuses)."""
+
+    def __init__(self, size):
+        self._size = size
+
+    def __enter__(self):
+        self._old = Engine.get().set_bulk_size(self._size)
+
+    def __exit__(self, *args):
+        Engine.get().set_bulk_size(self._old)

@@ -1,0 +1,228 @@
+"""Executor: compiled symbolic graph execution.
+
+Reference parity: include/mxnet/executor.h:53, src/executor/graph_executor.cc
+(GraphExecutor::Init/Forward/Backward; SimpleBind :1694) and the Python
+wrapper python/mxnet/executor.py.
+
+TPU-native design: bind() does NOT build per-node engine ops.  The whole
+symbol evaluates as one pure jax function over named arrays; `forward`
+runs jax.jit of it; `backward` runs a jit'd jax.vjp of the same function
+w.r.t. the grad-requiring arguments.  XLA performs the memory planning
+(plan_memory.cc), op fusion/bulking (graph_executor.cc:1188) and schedule
+that the reference implemented by hand.  Forward in train mode is lazy:
+the fused fwd+vjp runs once at backward(), so a training step costs one
+compiled program — the analogue of CachedOp static bulking.
+
+BatchNorm-style aux states are threaded functionally: the graph fn
+returns aux updates which are rebound after the step (the reference
+mutates them in-place inside the kernel).
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+from .base import MXNetError
+from .context import current_context
+from . import autograd as _ag
+from . import random as _random
+from .ndarray.ndarray import NDArray
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    def __init__(self, symbol, ctx, args, args_grad, grad_req, aux_states,
+                 shared_exec=None):
+        import jax
+
+        self._symbol = symbol
+        self._ctx = ctx or current_context()
+        self.arg_dict = dict(args)
+        self.grad_dict = dict(args_grad or {})
+        self.aux_dict = dict(aux_states or {})
+        self._grad_req = grad_req
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        self._out_names = symbol.list_outputs()
+
+        missing = [n for n in self._arg_names if n not in self.arg_dict]
+        if missing:
+            raise MXNetError("bind: missing arguments %s" % missing)
+
+        self._grad_names = tuple(sorted(
+            n for n in self.grad_dict
+            if (grad_req.get(n, "null") if isinstance(grad_req, dict)
+                else grad_req) != "null"))
+
+        self._sym_fn, _, _ = symbol._build_fn()
+        self._outputs = None
+        self._pending = None  # values dict awaiting lazy train-forward
+        self.monitor_callback = None
+        self._monitor_all = False
+
+        fn = self._sym_fn
+
+        def fwd(values, rng, is_train):
+            _random.push_trace_key(rng)
+            prev = _ag.set_training(is_train)
+            try:
+                outs, aux = fn(values, is_train=is_train)
+            finally:
+                _ag.set_training(prev)
+                _random.pop_trace_key()
+            return tuple(outs), aux
+
+        self._jit_fwd_infer = jax.jit(functools.partial(fwd, is_train=False))
+        self._jit_fwd_train = jax.jit(functools.partial(fwd, is_train=True))
+
+        grad_names = self._grad_names
+
+        def fwd_bwd(values, rng, cots):
+            oa = {k: v for k, v in values.items() if k not in grad_names}
+            ga = {k: values[k] for k in grad_names}
+
+            def f(ga_):
+                outs, aux = fwd({**oa, **ga_}, rng, True)
+                return outs, aux
+
+            outs, vjp_fn, aux = jax.vjp(f, ga, has_aux=True)
+            (grads,) = vjp_fn(cots)
+            return outs, aux, grads
+
+        self._jit_fwd_bwd = jax.jit(fwd_bwd)
+
+    # ------------------------------------------------------------------
+    @property
+    def outputs(self):
+        if self._outputs is None and self._pending is not None:
+            values, rng = self._pending
+            outs, aux = self._jit_fwd_train(values, rng)
+            self._apply_aux(aux)
+            self._outputs = [NDArray(o, self._ctx) for o in outs]
+        return self._outputs or []
+
+    def _values(self):
+        v = {n: self.arg_dict[n]._data for n in self._arg_names}
+        v.update({n: self.aux_dict[n]._data for n in self._aux_names})
+        return v
+
+    def _apply_aux(self, aux_updates):
+        for name, val in aux_updates.items():
+            if name in self.aux_dict:
+                self.aux_dict[name]._rebind(val)
+
+    def forward(self, is_train=False, **kwargs):
+        for k, v in kwargs.items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._rebind(
+                    v._data if isinstance(v, NDArray) else v)
+        values = self._values()
+        rng = _random.next_key()
+        if is_train:
+            # lazy: the fused fwd+bwd program runs at backward()
+            self._pending = (values, rng)
+            self._outputs = None
+        else:
+            outs, aux = self._jit_fwd_infer(values, rng)
+            self._outputs = [NDArray(o, self._ctx) for o in outs]
+            self._pending = None
+        if self.monitor_callback is not None:
+            for name, out in zip(self._out_names, self.outputs):
+                self.monitor_callback(name, out)
+        return self.outputs
+
+    def backward(self, out_grads=None, is_train=True):
+        import jax.numpy as jnp
+
+        if self._pending is None:
+            raise MXNetError("backward called before forward(is_train=True)")
+        values, rng = self._pending
+        if out_grads is None:
+            cots = tuple(
+                jnp.ones(self.arg_dict[self._arg_names[0]].shape[:0] or (),
+                         dtype=np.float32)
+                if False else None for _ in self._out_names)
+            # ones_like each output: need shapes — use eval_shape-free path:
+            outs, aux = self._jit_fwd_train(values, rng)
+            cots = tuple(jnp.ones_like(o) for o in outs)
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cots = tuple(g._data if isinstance(g, NDArray) else g
+                         for g in out_grads)
+        outs, aux, grads = self._jit_fwd_bwd(values, rng, cots)
+        self._outputs = [NDArray(o, self._ctx) for o in outs]
+        self._apply_aux(aux)
+        for name in self._grad_names:
+            req = (self._grad_req.get(name, "write")
+                   if isinstance(self._grad_req, dict) else self._grad_req)
+            tgt = self.grad_dict[name]
+            g = grads[name].astype(tgt._data.dtype)
+            if req == "add":
+                tgt._rebind(tgt._data + g)
+            else:
+                tgt._rebind(g)
+        self._pending = None
+
+    # ------------------------------------------------------------------
+    @property
+    def arg_arrays(self):
+        return [self.arg_dict[n] for n in self._arg_names]
+
+    @property
+    def grad_arrays(self):
+        return [self.grad_dict.get(n) for n in self._arg_names]
+
+    @property
+    def aux_arrays(self):
+        return [self.aux_dict[n] for n in self._aux_names]
+
+    @property
+    def output_dict(self):
+        return dict(zip(self._out_names, self.outputs))
+
+    def copy_params_from(self, arg_params, aux_params=None,
+                         allow_extra_params=False):
+        for k, v in (arg_params or {}).items():
+            if k in self.arg_dict:
+                self.arg_dict[k]._rebind(v._data.astype(
+                    self.arg_dict[k]._data.dtype))
+            elif not allow_extra_params:
+                raise MXNetError("unknown arg %s" % k)
+        for k, v in (aux_params or {}).items():
+            if k in self.aux_dict:
+                self.aux_dict[k]._rebind(v._data)
+            elif not allow_extra_params:
+                raise MXNetError("unknown aux %s" % k)
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False, **kwargs):
+        """Rebind with new shapes — jit recompiles per shape automatically;
+        arrays are re-allocated to the new shapes."""
+        from .ndarray.ndarray import zeros as nd_zeros
+
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        new_args = {}
+        for name, shp in zip(self._arg_names, arg_shapes):
+            old = self.arg_dict[name]
+            if tuple(old.shape) == tuple(shp):
+                new_args[name] = old
+            else:
+                new_args[name] = nd_zeros(shp, ctx=self._ctx, dtype=old.dtype)
+        new_grads = {n: nd_zeros(new_args[n].shape, ctx=self._ctx)
+                     for n in self.grad_dict}
+        new_aux = {}
+        for name, shp in zip(self._aux_names, aux_shapes):
+            old = self.aux_dict[name]
+            new_aux[name] = old if tuple(old.shape) == tuple(shp) else \
+                nd_zeros(shp, ctx=self._ctx, dtype=old.dtype)
+        return Executor(self._symbol, self._ctx, new_args, new_grads,
+                        self._grad_req, new_aux)
+
+    def set_monitor_callback(self, callback, monitor_all=False):
+        self.monitor_callback = callback
+        self._monitor_all = monitor_all
+
+    def debug_str(self):
+        return "Executor(outputs=%s)" % (self._out_names,)

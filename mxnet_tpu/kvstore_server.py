@@ -1,0 +1,226 @@
+"""TCP parameter server: the ps-lite replacement.
+
+Reference parity: 3rdparty/ps-lite (ZMQ PS: scheduler/server/worker roles
+from DMLC_* env) + src/kvstore/kvstore_dist_server.h:155 (DataHandleEx:325,
+sync aggregation ApplyUpdates:346 waiting for ps::NumWorkers() pushes,
+server-side pickled-optimizer updates) + python/mxnet/kvstore_server.py.
+
+Design: one server process (role=server, rank 0 by convention) listens on
+DMLC_PS_ROOT_URI:DMLC_PS_ROOT_PORT.  Workers open one persistent socket
+each.  Messages are length-prefixed pickles.  Sync mode: PUSH blocks until
+NumWorkers pushes for that key are merged (the reference blocks at the
+next engine sync instead — same observable ordering).  Async mode: each
+push applies immediately (sync_mode_=false parity).  DCN-scale multi-host
+TPU training should prefer the in-program collective path (mxnet_tpu/
+parallel/); this server exists for kvstore='dist_*' API parity and for
+CPU-host aggregation workloads (sparse embeddings).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import struct
+import threading
+
+import numpy as np
+
+__all__ = ["KVServer", "WorkerClient", "run_server", "_init_params"]
+
+
+def _send_msg(sock, obj):
+    payload = pickle.dumps(obj, protocol=4)
+    sock.sendall(struct.pack("<Q", len(payload)) + payload)
+
+
+def _recv_msg(sock):
+    hdr = b""
+    while len(hdr) < 8:
+        chunk = sock.recv(8 - len(hdr))
+        if not chunk:
+            raise ConnectionError("socket closed")
+        hdr += chunk
+    (n,) = struct.unpack("<Q", hdr)
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(1 << 20, n - len(buf)))
+        if not chunk:
+            raise ConnectionError("socket closed")
+        buf += chunk
+    return pickle.loads(bytes(buf))
+
+
+class KVServer:
+    """The server role (KVStoreDistServer parity)."""
+
+    def __init__(self, host, port, num_workers, sync_mode=True):
+        self._store = {}
+        self._push_buf = {}  # key -> (accum, count)
+        self._num_workers = num_workers
+        self._sync = sync_mode
+        self._updater = None
+        self._optimizer = None
+        self._cv = threading.Condition()
+        self._barrier_count = 0
+        self._barrier_gen = 0
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(num_workers + 2)
+        self._done = threading.Event()
+
+    def serve(self):
+        threads = []
+        for _ in range(self._num_workers):
+            conn, _addr = self._sock.accept()
+            t = threading.Thread(target=self._handle, args=(conn,), daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+
+    def _apply_update(self, key, agg):
+        if self._optimizer is not None:
+            # server-side optimizer (ApplyUpdates:346 parity): run the
+            # pickled Optimizer via an Updater keyed by param key
+            from .ndarray.ndarray import array as nd_array
+            from . import optimizer as opt
+
+            if self._updater is None:
+                self._updater = opt.get_updater(self._optimizer)
+            w = nd_array(self._store[key])
+            g = nd_array(agg)
+            self._updater(int(key) if key.isdigit() else key, g, w)
+            self._store[key] = w.asnumpy()
+        else:
+            self._store[key] = self._store[key] + agg
+
+    def _handle(self, conn):
+        try:
+            while not self._done.is_set():
+                msg = _recv_msg(conn)
+                op = msg["op"]
+                if op == "init":
+                    with self._cv:
+                        self._store.setdefault(msg["key"], msg["value"])
+                    _send_msg(conn, {"ok": True})
+                elif op == "push":
+                    key, value = msg["key"], msg["value"]
+                    if not self._sync:
+                        with self._cv:
+                            self._apply_update(key, value)
+                        _send_msg(conn, {"ok": True})
+                        continue
+                    with self._cv:
+                        acc, cnt, gen = self._push_buf.get(key, (0.0, 0, 0))
+                        acc = value if cnt == 0 else acc + value
+                        cnt += 1
+                        if cnt == self._num_workers:
+                            self._apply_update(key, acc)
+                            self._push_buf[key] = (0.0, 0, gen + 1)
+                            self._cv.notify_all()
+                        else:
+                            self._push_buf[key] = (acc, cnt, gen)
+                            target = gen + 1
+                            self._cv.wait_for(
+                                lambda: self._push_buf[key][2] >= target,
+                                timeout=600)
+                    _send_msg(conn, {"ok": True})
+                elif op == "pull":
+                    with self._cv:
+                        val = self._store[msg["key"]]
+                    _send_msg(conn, {"ok": True, "value": val})
+                elif op == "set_optimizer":
+                    self._optimizer = pickle.loads(msg["value"])
+                    self._updater = None
+                    _send_msg(conn, {"ok": True})
+                elif op == "barrier":
+                    with self._cv:
+                        gen = self._barrier_gen
+                        self._barrier_count += 1
+                        if self._barrier_count == self._num_workers:
+                            self._barrier_count = 0
+                            self._barrier_gen += 1
+                            self._cv.notify_all()
+                        else:
+                            self._cv.wait_for(
+                                lambda: self._barrier_gen > gen, timeout=600)
+                    _send_msg(conn, {"ok": True})
+                elif op == "command":
+                    _send_msg(conn, {"ok": True})
+                elif op == "shutdown":
+                    _send_msg(conn, {"ok": True})
+                    self._done.set()
+                    break
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            conn.close()
+
+
+class WorkerClient:
+    """Worker-side connection (ps::KVWorker parity)."""
+
+    def __init__(self, host, port, rank, num_workers):
+        self.rank = rank
+        self.num_workers = num_workers
+        self._sock = socket.create_connection((host, port), timeout=600)
+        self._lock = threading.Lock()
+
+    @classmethod
+    def from_env(cls):
+        host = os.environ["DMLC_PS_ROOT_URI"]
+        port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+        rank = int(os.environ.get("DMLC_WORKER_RANK",
+                                  os.environ.get("DMLC_RANK", "0")))
+        num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+        return cls(host, port, rank, num_workers)
+
+    def _rpc(self, **msg):
+        with self._lock:
+            _send_msg(self._sock, msg)
+            return _recv_msg(self._sock)
+
+    def init(self, key, value):
+        self._rpc(op="init", key=key, value=np.asarray(value))
+
+    def push(self, key, value, sync=True):
+        self._rpc(op="push", key=key, value=np.asarray(value))
+
+    def pull(self, key):
+        return self._rpc(op="pull", key=key)["value"]
+
+    def set_optimizer(self, pickled):
+        self._rpc(op="set_optimizer", value=pickled)
+
+    def barrier(self):
+        self._rpc(op="barrier")
+
+    def command(self, head, body):
+        self._rpc(op="command", head=head, body=body)
+
+    def shutdown(self):
+        try:
+            self._rpc(op="shutdown")
+        except ConnectionError:
+            pass
+
+
+def _init_params():
+    return (os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
+            int(os.environ.get("DMLC_PS_ROOT_PORT", "9091")),
+            int(os.environ.get("DMLC_NUM_WORKER", "1")))
+
+
+def run_server(sync_mode=None):
+    """Entry for role=server processes (parity: kvstore_server.py:64-73 /
+    MXKVStoreRunServer)."""
+    host, port, num_workers = _init_params()
+    if sync_mode is None:
+        sync_mode = os.environ.get("MXTPU_PS_ASYNC", "0") != "1"
+    server = KVServer("0.0.0.0", port, num_workers, sync_mode=sync_mode)
+    server.serve()
+
+
+if __name__ == "__main__":  # pragma: no cover
+    run_server()

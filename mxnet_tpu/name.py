@@ -1,0 +1,51 @@
+"""Automatic name scopes (reference parity: python/mxnet/name.py)."""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["NameManager", "Prefix", "current"]
+
+_local = threading.local()
+
+
+class NameManager:
+    def __init__(self):
+        self._counter = {}
+        self._old = None
+
+    def get(self, name, hint):
+        if name:
+            return name
+        hint = hint.lower()
+        n = self._counter.get(hint, 0)
+        self._counter[hint] = n + 1
+        return "%s%d" % (hint, n)
+
+    def __enter__(self):
+        if not hasattr(_local, "stack"):
+            _local.stack = [NameManager()]
+        _local.stack.append(self)
+        return self
+
+    def __exit__(self, *a):
+        _local.stack.pop()
+
+    @staticmethod
+    def current():
+        if not hasattr(_local, "stack"):
+            _local.stack = [NameManager()]
+        return _local.stack[-1]
+
+
+class Prefix(NameManager):
+    def __init__(self, prefix):
+        super().__init__()
+        self._prefix = prefix
+
+    def get(self, name, hint):
+        name = super().get(name, hint)
+        return self._prefix + name
+
+
+def current():
+    return NameManager.current()

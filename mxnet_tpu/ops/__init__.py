@@ -1,0 +1,2 @@
+"""Op registry + implementations (TPU-native NNVM-registry equivalent)."""
+from .registry import register, get_op, list_ops, alias, OpInfo  # noqa: F401

@@ -1,0 +1,258 @@
+"""Contrib ops subset (reference: src/operator/contrib/ — 84 files;
+implemented here: the ones exercised by the SSD/detection stack plus
+common utility contribs; coverage widens per round)."""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+from .utils import pbool, pint, pfloat, ptuple, pdtype, paxis
+
+
+@register("_contrib_div_sqrt_dim")
+def _div_sqrt_dim(data, **kw):
+    return data / jnp.sqrt(jnp.asarray(data.shape[-1], data.dtype))
+
+
+@register("_contrib_arange_like", differentiable=False)
+def _arange_like(data, start=0.0, step=1.0, repeat=1, axis=None, **kw):
+    ax = paxis(axis)
+    if ax is None:
+        n = data.size
+        return (jnp.arange(n, dtype=data.dtype) * pfloat(step, 1.0)
+                + pfloat(start, 0.0)).reshape(data.shape)
+    n = data.shape[ax]
+    return jnp.arange(n, dtype=data.dtype) * pfloat(step, 1.0) + pfloat(start, 0.0)
+
+
+@register("_contrib_index_copy", num_inputs=3, differentiable=False)
+def _index_copy(old, index, new, **kw):
+    return old.at[index.astype(jnp.int32)].set(new)
+
+
+@register("_contrib_getnnz", differentiable=False)
+def _getnnz(data, axis=None, **kw):
+    return jnp.sum((data != 0).astype(jnp.int32), axis=paxis(axis))
+
+
+# ---------------------------------------------------------------------------
+# Bounding-box ops (reference: src/operator/contrib/bounding_box.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_box_iou", num_inputs=2, differentiable=False)
+def _box_iou(lhs, rhs, format="corner", **kw):
+    def to_corner(b):
+        if (format or "corner") == "center":
+            x, y, w, h = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+            return jnp.stack([x - w / 2, y - h / 2, x + w / 2, y + h / 2], axis=-1)
+        return b
+
+    a = to_corner(lhs)[..., :, None, :]
+    b = to_corner(rhs)[..., None, :, :]
+    tl = jnp.maximum(a[..., :2], b[..., :2])
+    br = jnp.minimum(a[..., 2:], b[..., 2:])
+    wh = jnp.maximum(br - tl, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = (a[..., 2] - a[..., 0]) * (a[..., 3] - a[..., 1])
+    area_b = (b[..., 2] - b[..., 0]) * (b[..., 3] - b[..., 1])
+    return inter / jnp.maximum(area_a + area_b - inter, 1e-12)
+
+
+@register("_contrib_box_nms", differentiable=False, aliases=("_contrib_nms",))
+def _box_nms(data, overlap_thresh=0.5, valid_thresh=0.0, topk=-1, coord_start=2,
+             score_index=1, id_index=-1, background_id=-1, force_suppress=False,
+             in_format="corner", out_format="corner", **kw):
+    """Greedy NMS via lax.fori_loop over score-sorted candidates; suppressed
+    entries get all fields -1 (reference: bounding_box-inl.h BoxNMSForward)."""
+    ot = pfloat(overlap_thresh, 0.5)
+    vt = pfloat(valid_thresh, 0.0)
+    cs = pint(coord_start, 2)
+    si = pint(score_index, 1)
+    ii = pint(id_index, -1)
+    force = pbool(force_suppress)
+    batch_shape = data.shape[:-2]
+    N, F = data.shape[-2], data.shape[-1]
+    flat = data.reshape((-1, N, F))
+
+    def one(batch):
+        scores = batch[:, si]
+        order = jnp.argsort(-scores)
+        sortd = batch[order]
+        boxes = sortd[:, cs:cs + 4]
+        ious = _box_iou(boxes, boxes, format=in_format)
+        valid = sortd[:, si] > vt
+        same_cls = jnp.ones((N, N), bool) if (force or ii < 0) else (
+            sortd[:, ii][:, None] == sortd[:, ii][None, :])
+
+        def body(i, keep):
+            sup = (ious[i] > ot) & same_cls[i] & (jnp.arange(N) > i)
+            return jnp.where(keep[i] & valid[i], keep & ~sup, keep)
+
+        keep = lax.fori_loop(0, N, body, jnp.ones((N,), bool)) & valid
+        return jnp.where(keep[:, None], sortd, -jnp.ones_like(sortd))
+
+    out = jax.vmap(one)(flat)
+    return out.reshape(batch_shape + (N, F))
+
+
+# ---------------------------------------------------------------------------
+# SSD ops (reference: src/operator/contrib/multibox_*.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_MultiBoxPrior", differentiable=False,
+          aliases=("MultiBoxPrior",))
+def _multibox_prior(data, sizes="(1,)", ratios="(1,)", clip=False, steps=None,
+                    offsets="(0.5, 0.5)", **kw):
+    import ast
+
+    def plist(v, d):
+        if v is None:
+            return d
+        if isinstance(v, str):
+            return tuple(float(x) for x in ast.literal_eval(v)) if v else d
+        if isinstance(v, (int, float)):
+            return (float(v),)
+        return tuple(float(x) for x in v)
+
+    sizes = plist(sizes, (1.0,))
+    ratios = plist(ratios, (1.0,))
+    offs = plist(offsets, (0.5, 0.5))
+    h, w = data.shape[2], data.shape[3]
+    step_y, step_x = 1.0 / h, 1.0 / w
+    st = ptuple(steps) if steps is not None else None
+    if st and st[0] > 0:
+        step_y, step_x = st
+    cy = (jnp.arange(h) + offs[0]) * step_y
+    cx = (jnp.arange(w) + offs[1]) * step_x
+    cy, cx = jnp.meshgrid(cy, cx, indexing="ij")
+    anchors = []
+    # mxnet order: (s1,r1), (s2,r1), ..., then (s1,r2), (s1,r3)...
+    combos = [(s, ratios[0]) for s in sizes] + [(sizes[0], r) for r in ratios[1:]]
+    for s, r in combos:
+        aw = s * np.sqrt(r) / 2
+        ah = s / np.sqrt(r) / 2
+        anchors.append(jnp.stack([cx - aw, cy - ah, cx + aw, cy + ah], axis=-1))
+    out = jnp.stack(anchors, axis=2).reshape(1, -1, 4)
+    if pbool(clip):
+        out = jnp.clip(out, 0.0, 1.0)
+    return out.astype(data.dtype)
+
+
+@register("quadratic", aliases=("_contrib_quadratic",))
+def _quadratic(data, a=0.0, b=0.0, c=0.0, **kw):
+    return pfloat(a, 0.0) * jnp.square(data) + pfloat(b, 0.0) * data + pfloat(c, 0.0)
+
+
+@register("_contrib_allclose", num_inputs=2, differentiable=False)
+def _allclose(a, b, rtol=1e-5, atol=1e-8, equal_nan=True, **kw):
+    return jnp.asarray(
+        jnp.allclose(a, b, rtol=pfloat(rtol, 1e-5), atol=pfloat(atol, 1e-8),
+                     equal_nan=pbool(equal_nan, True)), dtype=jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# ROI ops (reference: roi_pooling.cc, contrib/roi_align.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("ROIPooling", num_inputs=2)
+def roi_pooling(data, rois, pooled_size=None, spatial_scale=1.0, **kw):
+    ph, pw = ptuple(pooled_size)
+    scale = pfloat(spatial_scale, 1.0)
+    N, C, H, W = data.shape
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = jnp.round(roi[1] * scale).astype(jnp.int32)
+        y1 = jnp.round(roi[2] * scale).astype(jnp.int32)
+        x2 = jnp.round(roi[3] * scale).astype(jnp.int32)
+        y2 = jnp.round(roi[4] * scale).astype(jnp.int32)
+        rh = jnp.maximum(y2 - y1 + 1, 1)
+        rw = jnp.maximum(x2 - x1 + 1, 1)
+        img = data[bidx]
+
+        ys = jnp.arange(H)
+        xs = jnp.arange(W)
+
+        def cell(py, px):
+            hstart = y1 + (py * rh) // ph
+            hend = y1 + ((py + 1) * rh + ph - 1) // ph
+            wstart = x1 + (px * rw) // pw
+            wend = x1 + ((px + 1) * rw + pw - 1) // pw
+            mask = ((ys[:, None] >= hstart) & (ys[:, None] < hend)
+                    & (xs[None, :] >= wstart) & (xs[None, :] < wend))
+            masked = jnp.where(mask[None], img, -jnp.inf)
+            v = jnp.max(masked, axis=(1, 2))
+            return jnp.where(jnp.isfinite(v), v, 0.0)
+
+        cells = jnp.stack([jnp.stack([cell(py, px) for px in range(pw)], -1)
+                           for py in range(ph)], -2)
+        return cells  # (C, ph, pw)
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("_contrib_ROIAlign", num_inputs=2)
+def roi_align(data, rois, pooled_size=None, spatial_scale=1.0, sample_ratio=-1,
+              position_sensitive=False, aligned=False, **kw):
+    ph, pw = ptuple(pooled_size)
+    scale = pfloat(spatial_scale, 1.0)
+    N, C, H, W = data.shape
+    sr = pint(sample_ratio, -1)
+    sr = sr if sr > 0 else 2
+    off = 0.5 if pbool(aligned) else 0.0
+
+    def bilinear(img, y, x):
+        y = jnp.clip(y, 0.0, H - 1.0)
+        x = jnp.clip(x, 0.0, W - 1.0)
+        y0 = jnp.floor(y).astype(jnp.int32)
+        x0 = jnp.floor(x).astype(jnp.int32)
+        y1 = jnp.minimum(y0 + 1, H - 1)
+        x1 = jnp.minimum(x0 + 1, W - 1)
+        wy = y - y0
+        wx = x - x0
+        v00 = img[:, y0, x0]
+        v01 = img[:, y0, x1]
+        v10 = img[:, y1, x0]
+        v11 = img[:, y1, x1]
+        return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+                + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+    def one_roi(roi):
+        bidx = roi[0].astype(jnp.int32)
+        x1 = roi[1] * scale - off
+        y1 = roi[2] * scale - off
+        x2 = roi[3] * scale - off
+        y2 = roi[4] * scale - off
+        rw = jnp.maximum(x2 - x1, 1.0)
+        rh = jnp.maximum(y2 - y1, 1.0)
+        img = data[bidx]
+        bh, bw = rh / ph, rw / pw
+
+        def cell(py, px):
+            vals = []
+            for iy in range(sr):
+                for ix in range(sr):
+                    y = y1 + (py + (iy + 0.5) / sr) * bh
+                    x = x1 + (px + (ix + 0.5) / sr) * bw
+                    vals.append(bilinear(img, y, x))
+            return jnp.mean(jnp.stack(vals), axis=0)
+
+        return jnp.stack([jnp.stack([cell(py, px) for px in range(pw)], -1)
+                          for py in range(ph)], -2)
+
+    return jax.vmap(one_roi)(rois)
+
+
+@register("_contrib_count_sketch", num_inputs=3, differentiable=False)
+def _count_sketch(data, h, s, out_dim=0, processing_batch_size=32, **kw):
+    od = pint(out_dim)
+    idx = h.astype(jnp.int32)
+    signed = data * s
+    out = jnp.zeros(data.shape[:-1] + (od,), data.dtype)
+    return out.at[..., idx[0] if idx.ndim > 1 else idx].add(signed)

@@ -1,0 +1,725 @@
+"""Neural-net ops, lowered onto lax (MXU-friendly) primitives.
+
+Reference parity: src/operator/nn/ (~25k LoC: convolution-inl.h,
+fully_connected.cc, pooling.cc, batch_norm.cc, layer_norm.cc, dropout-inl.h,
+softmax*.cc, upsampling.cc, lrn.cc) and src/operator/rnn-inl.h:383 (fused
+multi-layer RNN).  TPU-native: convolutions go straight to
+lax.conv_general_dilated (XLA tiles them onto the MXU), pooling to
+lax.reduce_window, RNN to lax.scan over fused gate matmuls — no cuDNN
+algo registry, no im2col, no MKL-DNN fallback paths.
+"""
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+from .utils import pbool, pint, pfloat, ptuple, pdtype, paxis, normalize_axis
+from .. import random as _random
+
+# ---------------------------------------------------------------------------
+# FullyConnected (reference: src/operator/nn/fully_connected.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("FullyConnected", num_inputs=-1)
+def fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False,
+                    flatten=True, **kw):
+    if pbool(flatten, True) and data.ndim > 2:
+        data = data.reshape(data.shape[0], -1)
+    out = jnp.matmul(data, weight.T)
+    if not pbool(no_bias) and bias is not None:
+        out = out + bias
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Convolution / Deconvolution (reference: src/operator/nn/convolution-inl.h)
+# ---------------------------------------------------------------------------
+
+
+def _conv_dims(kernel):
+    return len(kernel)
+
+
+def _dim_numbers(nd):
+    if nd == 1:
+        return ("NCH", "OIH", "NCH")
+    if nd == 2:
+        return ("NCHW", "OIHW", "NCHW")
+    return ("NCDHW", "OIDHW", "NCDHW")
+
+
+@register("Convolution", num_inputs=-1)
+def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
+                pad=None, num_filter=None, num_group=1, no_bias=False,
+                layout=None, workspace=None, cudnn_tune=None, cudnn_off=None, **kw):
+    kernel = ptuple(kernel)
+    nd = _conv_dims(kernel)
+    stride = ptuple(stride, ndim=nd, default=(1,) * nd)
+    dilate = ptuple(dilate, ndim=nd, default=(1,) * nd)
+    pad = ptuple(pad, ndim=nd, default=(0,) * nd)
+    if len(stride) < nd:
+        stride = stride * nd
+    padding = [(p, p) for p in pad]
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape, _dim_numbers(nd))
+    out = lax.conv_general_dilated(
+        data, weight, window_strides=stride, padding=padding,
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=pint(num_group, 1),
+        preferred_element_type=jnp.float32 if data.dtype == jnp.float32 else None)
+    out = out.astype(data.dtype)
+    if not pbool(no_bias) and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+@register("Deconvolution", num_inputs=-1)
+def deconvolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
+                  pad=None, adj=None, num_filter=None, num_group=1, no_bias=True,
+                  target_shape=None, layout=None, workspace=None, cudnn_tune=None,
+                  cudnn_off=None, **kw):
+    """Transposed convolution: weight layout (in_c, out_c/g, *k) as in the
+    reference (deconvolution-inl.h); implemented as the conv gradient via
+    lhs dilation."""
+    kernel = ptuple(kernel)
+    nd = _conv_dims(kernel)
+    stride = ptuple(stride, ndim=nd, default=(1,) * nd)
+    dilate = ptuple(dilate, ndim=nd, default=(1,) * nd)
+    pad = ptuple(pad, ndim=nd, default=(0,) * nd)
+    adj = ptuple(adj, ndim=nd, default=(0,) * nd)
+    groups = pint(num_group, 1)
+    # weight (C_in, C_out/g, *K) -> flip spatial, swap to (C_out, C_in/g, *K)
+    w = jnp.flip(weight, axis=tuple(range(2, 2 + nd)))
+    if groups == 1:
+        w = jnp.swapaxes(w, 0, 1)
+    else:
+        ci, cog = weight.shape[0], weight.shape[1]
+        w = w.reshape((groups, ci // groups, cog) + kernel)
+        w = jnp.swapaxes(w, 1, 2).reshape((groups * cog, ci // groups) + kernel)
+    eff_k = tuple((k - 1) * d + 1 for k, d in zip(kernel, dilate))
+    padding = [(ek - 1 - p, ek - 1 - p + a)
+               for ek, p, a in zip(eff_k, pad, adj)]
+    dn = lax.conv_dimension_numbers(data.shape, w.shape, _dim_numbers(nd))
+    out = lax.conv_general_dilated(
+        data, w, window_strides=(1,) * nd, padding=padding,
+        lhs_dilation=stride, rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=groups)
+    out = out.astype(data.dtype)
+    if not pbool(no_bias, True) and bias is not None:
+        out = out + bias.reshape((1, -1) + (1,) * nd)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pooling (reference: src/operator/nn/pooling.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("Pooling", num_inputs=1)
+def pooling(data, kernel=None, pool_type="max", global_pool=False, stride=None,
+            pad=None, pooling_convention="valid", count_include_pad=True,
+            cudnn_off=None, p_value=None, layout=None, **kw):
+    nd = data.ndim - 2
+    pool_type = pool_type or "max"
+    if pbool(global_pool):
+        kernel = data.shape[2:]
+        stride = (1,) * nd
+        pad = (0,) * nd
+    kernel = ptuple(kernel, ndim=nd, default=(1,) * nd)
+    stride = ptuple(stride, ndim=nd, default=kernel if pbool(global_pool) else (1,) * nd)
+    if stride is None:
+        stride = (1,) * nd
+    pad = ptuple(pad, ndim=nd, default=(0,) * nd)
+    window = (1, 1) + kernel
+    strides = (1, 1) + stride
+    conv = pooling_convention or "valid"
+    if conv == "full":
+        # ceil-mode output: pad high edge extra so every window fits
+        extra = []
+        for i in range(nd):
+            size = data.shape[2 + i] + 2 * pad[i]
+            rem = (size - kernel[i]) % stride[i]
+            extra.append(0 if rem == 0 else stride[i] - rem)
+        padding = ((0, 0), (0, 0)) + tuple(
+            (pad[i], pad[i] + extra[i]) for i in range(nd))
+    else:
+        padding = ((0, 0), (0, 0)) + tuple((p, p) for p in pad)
+
+    if pool_type == "max":
+        init = -jnp.inf if jnp.issubdtype(data.dtype, jnp.floating) else jnp.iinfo(data.dtype).min
+        return lax.reduce_window(data, init, lax.max, window, strides, padding)
+    if pool_type in ("avg", "sum"):
+        s = lax.reduce_window(data, 0.0, lax.add, window, strides, padding)
+        if pool_type == "sum":
+            return s
+        if pbool(count_include_pad, True):
+            denom = float(np.prod(kernel))
+            return s / denom
+        ones = jnp.ones_like(data)
+        denom = lax.reduce_window(ones, 0.0, lax.add, window, strides, padding)
+        return s / denom
+    if pool_type == "lp":
+        p = pfloat(p_value, 2.0)
+        s = lax.reduce_window(jnp.abs(data) ** p, 0.0, lax.add, window, strides, padding)
+        return s ** (1.0 / p)
+    raise ValueError("unknown pool_type %r" % pool_type)
+
+
+# ---------------------------------------------------------------------------
+# Activations (reference: src/operator/nn/activation.cc, leaky_relu.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("Activation")
+def activation(data, act_type="relu", **kw):
+    act = act_type or "relu"
+    if act == "relu":
+        return jnp.maximum(data, 0)
+    if act == "sigmoid":
+        return jax.nn.sigmoid(data)
+    if act == "tanh":
+        return jnp.tanh(data)
+    if act == "softrelu":
+        return jax.nn.softplus(data)
+    if act == "softsign":
+        return jax.nn.soft_sign(data)
+    raise ValueError("unknown act_type %r" % act)
+
+
+@register("LeakyReLU", num_inputs=-1)
+def leaky_relu(data, gamma=None, act_type="leaky", slope=0.25,
+               lower_bound=0.125, upper_bound=0.334, **kw):
+    act = act_type or "leaky"
+    if act == "leaky":
+        return jax.nn.leaky_relu(data, pfloat(slope, 0.25))
+    if act == "elu":
+        return jax.nn.elu(data, pfloat(slope, 0.25))
+    if act == "selu":
+        return jax.nn.selu(data)
+    if act == "gelu":
+        return jax.nn.gelu(data, approximate=False)
+    if act == "prelu":
+        g = gamma
+        if g.ndim < data.ndim and data.ndim > 1:
+            g = g.reshape((1, -1) + (1,) * (data.ndim - 2))
+        return jnp.where(data >= 0, data, g * data)
+    if act == "rrelu":
+        # eval behavior: fixed mean slope (training draws uniform)
+        s = (pfloat(lower_bound, 0.125) + pfloat(upper_bound, 0.334)) / 2.0
+        return jnp.where(data >= 0, data, s * data)
+    raise ValueError("unknown act_type %r" % act)
+
+
+@register("softmax_cross_entropy", num_inputs=2)
+def softmax_cross_entropy(data, label, **kw):
+    logp = jax.nn.log_softmax(data, axis=-1)
+    onehot = jax.nn.one_hot(label.astype(jnp.int32), data.shape[-1], dtype=data.dtype)
+    return -jnp.sum(onehot * logp)
+
+
+# ---------------------------------------------------------------------------
+# Normalization (reference: batch_norm.cc, layer_norm.cc, l2_normalization.cc,
+# lrn.cc, instance_norm.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("BatchNorm", num_inputs=5, num_outputs=3)
+def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+               momentum=0.9, fix_gamma=True, use_global_stats=False,
+               output_mean_var=False, axis=1, cudnn_off=None, **kw):
+    """Functional BatchNorm. Returns (out, mean, var) where mean/var are the
+    batch statistics used (or moving stats in inference). The moving-average
+    update is done by the caller (gluon layer / train step), keeping this op
+    pure for XLA (reference mutates aux states in-place instead:
+    src/operator/nn/batch_norm.cc)."""
+    ax = normalize_axis(pint(axis, 1), data.ndim)
+    eps = pfloat(eps, 1e-3)
+    red = tuple(i for i in range(data.ndim) if i != ax)
+    shape = [1] * data.ndim
+    shape[ax] = data.shape[ax]
+    if pbool(use_global_stats):
+        mean, var = moving_mean, moving_var
+    else:
+        mean = jnp.mean(data, axis=red)
+        var = jnp.var(data, axis=red)
+    g = jnp.ones_like(gamma) if pbool(fix_gamma, True) else gamma
+    inv = lax.rsqrt(var.astype(jnp.float32) + eps).astype(data.dtype)
+    out = (data - mean.reshape(shape)) * inv.reshape(shape) * g.reshape(shape) \
+        + beta.reshape(shape)
+    return out, mean, var
+
+
+@register("LayerNorm", num_inputs=3)
+def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False, **kw):
+    ax = normalize_axis(pint(axis, -1), data.ndim)
+    eps = pfloat(eps, 1e-5)
+    mean = jnp.mean(data, axis=ax, keepdims=True)
+    var = jnp.var(data, axis=ax, keepdims=True)
+    shape = [1] * data.ndim
+    shape[ax] = data.shape[ax]
+    out = (data - mean) * lax.rsqrt(var + eps)
+    return out * gamma.reshape(shape) + beta.reshape(shape)
+
+
+@register("InstanceNorm", num_inputs=3)
+def instance_norm(data, gamma, beta, eps=1e-3, **kw):
+    eps = pfloat(eps, 1e-3)
+    red = tuple(range(2, data.ndim))
+    mean = jnp.mean(data, axis=red, keepdims=True)
+    var = jnp.var(data, axis=red, keepdims=True)
+    shape = (1, -1) + (1,) * (data.ndim - 2)
+    return (data - mean) * lax.rsqrt(var + eps) * gamma.reshape(shape) \
+        + beta.reshape(shape)
+
+
+@register("L2Normalization")
+def l2_normalization(data, eps=1e-10, mode="instance", **kw):
+    eps = pfloat(eps, 1e-10)
+    mode = mode or "instance"
+    if mode == "instance":
+        red = tuple(range(1, data.ndim))
+        keep = True
+    elif mode == "channel":
+        red = (1,)
+        keep = True
+    else:  # spatial
+        red = tuple(range(2, data.ndim))
+        keep = True
+    norm = jnp.sqrt(jnp.sum(jnp.square(data), axis=red, keepdims=keep) + eps)
+    return data / norm
+
+
+@register("LRN")
+def lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5, **kw):
+    alpha, beta, knorm, nsize = (pfloat(alpha, 1e-4), pfloat(beta, 0.75),
+                                 pfloat(knorm, 2.0), pint(nsize, 5))
+    sq = jnp.square(data)
+    half = nsize // 2
+    summed = lax.reduce_window(
+        sq, 0.0, lax.add, (1, nsize, 1, 1), (1, 1, 1, 1),
+        ((0, 0), (half, half), (0, 0), (0, 0)))
+    return data / jnp.power(knorm + alpha / nsize * summed, beta)
+
+
+# ---------------------------------------------------------------------------
+# Dropout (reference: src/operator/nn/dropout-inl.h; RNG per-call from the
+# framework PRNG stream, see mxnet_tpu/random.py)
+# ---------------------------------------------------------------------------
+
+
+@register("Dropout")
+def dropout(data, p=0.5, mode="training", axes=None, cudnn_off=None, **kw):
+    from .. import autograd
+
+    p = pfloat(p, 0.5)
+    if p == 0.0 or (mode != "always" and not autograd.is_training()):
+        return data
+    key = _random.next_key()
+    axes = ptuple(axes)
+    shape = data.shape
+    if axes:
+        shape = tuple(1 if i in axes else s for i, s in enumerate(data.shape))
+    keep = 1.0 - p
+    mask = jax.random.bernoulli(key, keep, shape).astype(data.dtype) / keep
+    return data * mask
+
+
+# ---------------------------------------------------------------------------
+# Softmax output heads (reference: softmax_output.cc — custom gradient that
+# bypasses softmax's jacobian: grad = (softmax - onehot) * scale)
+# ---------------------------------------------------------------------------
+
+
+def _softmax_output_fwd(data, label, grad_scale, ignore_label, use_ignore,
+                        multi_output, normalization, smooth_alpha):
+    axis = 1 if multi_output else -1
+    return jax.nn.softmax(data, axis=axis)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3, 4, 5, 6, 7))
+def _softmax_output_core(data, label, grad_scale, ignore_label, use_ignore,
+                         multi_output, normalization, smooth_alpha):
+    return _softmax_output_fwd(data, label, grad_scale, ignore_label,
+                               use_ignore, multi_output, normalization, smooth_alpha)
+
+
+def _so_fwd(data, label, grad_scale, ignore_label, use_ignore, multi_output,
+            normalization, smooth_alpha):
+    out = _softmax_output_fwd(data, label, grad_scale, ignore_label,
+                              use_ignore, multi_output, normalization, smooth_alpha)
+    return out, (out, label)
+
+
+def _so_bwd(grad_scale, ignore_label, use_ignore, multi_output,
+            normalization, smooth_alpha, res, g):
+    out, label = res
+    axis = 1 if multi_output else -1
+    k = out.shape[axis]
+    onehot = jax.nn.one_hot(label.astype(jnp.int32), k, axis=axis, dtype=out.dtype)
+    if smooth_alpha:
+        onehot = onehot * (1.0 - smooth_alpha) + smooth_alpha / (k - 1)
+    grad = out - onehot
+    if use_ignore:
+        mask = (label != ignore_label).astype(out.dtype)
+        mask = jnp.expand_dims(mask, axis=axis)
+        grad = grad * mask
+    scale = grad_scale
+    if normalization == "batch":
+        scale = scale / out.shape[0]
+    elif normalization == "valid":
+        if use_ignore:
+            valid = jnp.maximum(jnp.sum(label != ignore_label), 1).astype(out.dtype)
+        else:
+            valid = float(np.prod(label.shape))
+        scale = scale / valid
+    grad = grad * scale
+    return (grad, jnp.zeros_like(label))
+
+
+_softmax_output_core.defvjp(_so_fwd, _so_bwd)
+
+
+@register("SoftmaxOutput", num_inputs=2, aliases=("Softmax",))
+def softmax_output(data, label, grad_scale=1.0, ignore_label=-1.0,
+                   multi_output=False, use_ignore=False, preserve_shape=False,
+                   normalization="null", out_grad=False, smooth_alpha=0.0, **kw):
+    return _softmax_output_core(
+        data, label.astype(data.dtype), pfloat(grad_scale, 1.0),
+        pfloat(ignore_label, -1.0), pbool(use_ignore), pbool(multi_output),
+        normalization or "null", pfloat(smooth_alpha, 0.0))
+
+
+@register("SoftmaxActivation")
+def softmax_activation(data, mode="instance", **kw):
+    if (mode or "instance") == "channel":
+        return jax.nn.softmax(data, axis=1)
+    return jax.nn.softmax(data.reshape(data.shape[0], -1), axis=-1).reshape(data.shape)
+
+
+def _regression_core(fwd, grad_fn):
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+    def core(data, label, grad_scale):
+        return fwd(data)
+
+    def f(data, label, grad_scale):
+        out = fwd(data)
+        return out, (out, label)
+
+    def b(grad_scale, res, g):
+        out, label = res
+        num_out = out.size // out.shape[0] if out.ndim else 1
+        grad = grad_fn(out, label.reshape(out.shape)) * grad_scale / num_out
+        return grad, jnp.zeros_like(label)
+
+    core.defvjp(f, b)
+    return core
+
+
+_linreg = _regression_core(lambda d: d, lambda o, l: o - l)
+_maereg = _regression_core(lambda d: d, lambda o, l: jnp.sign(o - l))
+_logreg = _regression_core(jax.nn.sigmoid, lambda o, l: o - l)
+
+
+@register("LinearRegressionOutput", num_inputs=2)
+def linear_regression_output(data, label, grad_scale=1.0, **kw):
+    return _linreg(data, label.astype(data.dtype), pfloat(grad_scale, 1.0))
+
+
+@register("MAERegressionOutput", num_inputs=2)
+def mae_regression_output(data, label, grad_scale=1.0, **kw):
+    return _maereg(data, label.astype(data.dtype), pfloat(grad_scale, 1.0))
+
+
+@register("LogisticRegressionOutput", num_inputs=2)
+def logistic_regression_output(data, label, grad_scale=1.0, **kw):
+    return _logreg(data, label.astype(data.dtype), pfloat(grad_scale, 1.0))
+
+
+# ---------------------------------------------------------------------------
+# Embedding (reference: indexing_op.cc EmbeddingOp)
+# ---------------------------------------------------------------------------
+
+
+@register("Embedding", num_inputs=2)
+def embedding(data, weight, input_dim=None, output_dim=None, dtype="float32",
+              sparse_grad=False, **kw):
+    return jnp.take(weight, data.astype(jnp.int32), axis=0)
+
+
+# ---------------------------------------------------------------------------
+# UpSampling / BilinearResize (reference: upsampling.cc,
+# contrib/bilinear_resize.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("UpSampling", num_inputs=-1)
+def upsampling(*data, scale=1, sample_type="nearest", num_args=1,
+               num_filter=0, multi_input_mode="concat", workspace=None, **kw):
+    scale = pint(scale, 1)
+    x = data[0]
+    if sample_type == "nearest":
+        out = jnp.repeat(jnp.repeat(x, scale, axis=2), scale, axis=3)
+    else:
+        n, c, h, w = x.shape
+        out = jax.image.resize(x, (n, c, h * scale, w * scale), method="bilinear")
+    return out
+
+
+@register("_contrib_BilinearResize2D")
+def bilinear_resize_2d(data, height=1, width=1, scale_height=None,
+                       scale_width=None, mode="size", **kw):
+    n, c, h, w = data.shape
+    sh, sw = pfloat(scale_height), pfloat(scale_width)
+    if sh:
+        height, width = int(h * sh), int(w * (sw or sh))
+    return jax.image.resize(data, (n, c, pint(height, 1), pint(width, 1)),
+                            method="bilinear")
+
+
+# ---------------------------------------------------------------------------
+# Sequence ops (reference: src/operator/sequence_*.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("SequenceMask", num_inputs=-1)
+def sequence_mask(data, sequence_length=None, use_sequence_length=False,
+                  value=0.0, axis=0, **kw):
+    if not pbool(use_sequence_length) or sequence_length is None:
+        return data
+    ax = pint(axis, 0)  # time axis: 0 (default) or 1
+    T = data.shape[ax]
+    steps = jnp.arange(T)
+    if ax == 0:
+        mask = steps[:, None] < sequence_length[None, :].astype(steps.dtype)
+    else:
+        mask = steps[None, :] < sequence_length[:, None].astype(steps.dtype)
+    mask = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+    return jnp.where(mask, data, pfloat(value, 0.0))
+
+
+@register("SequenceLast", num_inputs=-1)
+def sequence_last(data, sequence_length=None, use_sequence_length=False, axis=0, **kw):
+    ax = pint(axis, 0)
+    if not pbool(use_sequence_length) or sequence_length is None:
+        idx = [slice(None)] * data.ndim
+        idx[ax] = -1
+        return data[tuple(idx)]
+    last = (sequence_length.astype(jnp.int32) - 1)
+    if ax == 0:
+        return jnp.take_along_axis(
+            data, last.reshape((1, -1) + (1,) * (data.ndim - 2)), axis=0)[0]
+    return jnp.take_along_axis(
+        data, last.reshape((-1, 1) + (1,) * (data.ndim - 2)), axis=1)[:, 0]
+
+
+@register("SequenceReverse", num_inputs=-1)
+def sequence_reverse(data, sequence_length=None, use_sequence_length=False, **kw):
+    if not pbool(use_sequence_length) or sequence_length is None:
+        return jnp.flip(data, axis=0)
+    T = data.shape[0]
+    steps = jnp.arange(T)[:, None]
+    L = sequence_length.astype(jnp.int32)[None, :]
+    src = jnp.where(steps < L, L - 1 - steps, steps)  # (T, N)
+    src = src.reshape(src.shape + (1,) * (data.ndim - 2))
+    return jnp.take_along_axis(data, src, axis=0)
+
+
+# ---------------------------------------------------------------------------
+# Fused RNN (reference: src/operator/rnn-inl.h:383 — cuDNN-layout flat
+# params; here unpacked and run through lax.scan over fused gate matmuls)
+# ---------------------------------------------------------------------------
+
+_GATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+def _rnn_num_outputs(attrs):
+    if pbool(attrs.get("state_outputs")):
+        return 3 if (attrs.get("mode") == "lstm") else 2
+    return 1
+
+
+def _unpack_rnn_params(params, mode, num_layers, input_size, state_size, bidir):
+    """Unpack the cuDNN-layout flat parameter vector: all weights
+    (layer-major, direction-major: W_i2h then W_h2h), then all biases
+    (b_i2h then b_h2h). Matches rnn-inl.h GetRnnParamSize ordering."""
+    gates = _GATES[mode]
+    D = 2 if bidir else 1
+    H = state_size
+    ws, bs = [], []
+    off = 0
+    for layer in range(num_layers):
+        in_sz = input_size if layer == 0 else H * D
+        for _ in range(D):
+            wi = params[off: off + gates * H * in_sz].reshape(gates * H, in_sz)
+            off += gates * H * in_sz
+            wh = params[off: off + gates * H * H].reshape(gates * H, H)
+            off += gates * H * H
+            ws.append((wi, wh))
+    for layer in range(num_layers):
+        for _ in range(D):
+            bi = params[off: off + gates * H]; off += gates * H
+            bh = params[off: off + gates * H]; off += gates * H
+            bs.append((bi, bh))
+    return ws, bs
+
+
+def _rnn_cell_step(mode, H):
+    if mode == "lstm":
+        def step(carry, gates_x, wh, bh):
+            h, c = carry
+            g = gates_x + jnp.matmul(h, wh.T) + bh
+            i, f, gg, o = jnp.split(g, 4, axis=-1)
+            i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+            gg = jnp.tanh(gg)
+            c = f * c + i * gg
+            h = o * jnp.tanh(c)
+            return (h, c), h
+    elif mode == "gru":
+        def step(carry, gates_x, wh, bh):
+            (h,) = carry
+            gh = jnp.matmul(h, wh.T) + bh
+            xr, xz, xn = jnp.split(gates_x, 3, axis=-1)
+            hr, hz, hn = jnp.split(gh, 3, axis=-1)
+            r = jax.nn.sigmoid(xr + hr)
+            z = jax.nn.sigmoid(xz + hz)
+            n = jnp.tanh(xn + r * hn)
+            h = (1 - z) * n + z * h
+            return (h,), h
+    else:
+        act = jnp.tanh if mode == "rnn_tanh" else (lambda x: jnp.maximum(x, 0))
+
+        def step(carry, gates_x, wh, bh):
+            (h,) = carry
+            h = act(gates_x + jnp.matmul(h, wh.T) + bh)
+            return (h,), h
+    return step
+
+
+@register("RNN", num_inputs=-1, num_outputs=_rnn_num_outputs)
+def rnn(data, parameters, state, state_cell=None, state_size=None,
+        num_layers=1, bidirectional=False, mode="lstm", p=0.0,
+        state_outputs=False, projection_size=None, lstm_state_clip_min=None,
+        lstm_state_clip_max=None, lstm_state_clip_nan=False,
+        use_sequence_length=False, sequence_length=None, **kw):
+    """Fused multi-layer RNN over (T, N, C) input.  Gate order LSTM=ifgo,
+    GRU=rzn (cuDNN convention, as the reference's flat-param layout)."""
+    mode = mode or "lstm"
+    H = pint(state_size)
+    L = pint(num_layers, 1)
+    bidir = pbool(bidirectional)
+    D = 2 if bidir else 1
+    gates = _GATES[mode]
+    T, N, C = data.shape
+    ws, bs = _unpack_rnn_params(parameters, mode, L, C, H, bidir)
+    step = _rnn_cell_step(mode, H)
+
+    h0 = state  # (L*D, N, H)
+    c0 = state_cell if mode == "lstm" else None
+    out = data
+    h_finals, c_finals = [], []
+    from .. import autograd as _ag
+    drop_p = pfloat(p, 0.0)
+    for layer in range(L):
+        dir_outs = []
+        for d in range(D):
+            wi, wh = ws[layer * D + d]
+            bi, bh = bs[layer * D + d]
+            x = out if d == 0 else jnp.flip(out, axis=0)
+            gates_x = jnp.einsum("tnc,gc->tng", x, wi) + bi
+            init_h = h0[layer * D + d]
+            carry = (init_h, c0[layer * D + d]) if mode == "lstm" else (init_h,)
+
+            def scan_fn(carry, gx, _wh=wh, _bh=bh):
+                return step(carry, gx, _wh, _bh)
+
+            carry, ys = lax.scan(scan_fn, carry, gates_x)
+            if d == 1:
+                ys = jnp.flip(ys, axis=0)
+            dir_outs.append(ys)
+            h_finals.append(carry[0])
+            if mode == "lstm":
+                c_finals.append(carry[1])
+        out = jnp.concatenate(dir_outs, axis=-1) if D == 2 else dir_outs[0]
+        if drop_p > 0.0 and layer < L - 1 and _ag.is_training():
+            key = _random.next_key()
+            mask = jax.random.bernoulli(key, 1 - drop_p, out.shape).astype(out.dtype)
+            out = out * mask / (1 - drop_p)
+    hN = jnp.stack(h_finals, axis=0)
+    if pbool(state_outputs):
+        if mode == "lstm":
+            return out, hN, jnp.stack(c_finals, axis=0)
+        return out, hN
+    return out
+
+
+# ---------------------------------------------------------------------------
+# CTC loss (reference: src/operator/nn/ctc_loss.cc via 3rdparty/ctc_include)
+# ---------------------------------------------------------------------------
+
+
+@register("CTCLoss", num_inputs=-1, aliases=("ctc_loss", "_contrib_CTCLoss", "_contrib_ctc_loss"))
+def ctc_loss(data, label, data_lengths=None, label_lengths=None,
+             use_data_lengths=False, use_label_lengths=False,
+             blank_label="first", **kw):
+    """CTC forward-backward loss via logsumexp dynamic program (lax.scan
+    over time). data: (T, N, C) unnormalized; label: (N, L) with 0 padding
+    when blank_label='first' (then blank id = 0, labels are 1-based)."""
+    T, N, C = data.shape
+    logp = jax.nn.log_softmax(data, axis=-1)
+    blank_first = (blank_label or "first") == "first"
+    blank = 0 if blank_first else C - 1
+    lab = label.astype(jnp.int32)
+    if not pbool(use_label_lengths):
+        pad = 0 if blank_first else -1
+        lab_len = jnp.sum((lab != pad).astype(jnp.int32), axis=1)
+    else:
+        lab_len = label_lengths.astype(jnp.int32)
+    if not pbool(use_data_lengths):
+        dat_len = jnp.full((N,), T, dtype=jnp.int32)
+    else:
+        dat_len = data_lengths.astype(jnp.int32)
+    L = lab.shape[1]
+    S = 2 * L + 1
+    # extended label sequence: blank, l1, blank, l2, ... blank
+    ext = jnp.full((N, S), blank, dtype=jnp.int32)
+    ext = ext.at[:, 1::2].set(lab)
+    neg_inf = -1e30
+    s_idx = jnp.arange(S)
+    # allowed skip: ext[s] != blank and ext[s] != ext[s-2]
+    ext_m2 = jnp.pad(ext, ((0, 0), (2, 0)), constant_values=-1)[:, :S]
+    can_skip = (ext != blank) & (ext != ext_m2)
+
+    alpha0 = jnp.full((N, S), neg_inf)
+    alpha0 = alpha0.at[:, 0].set(logp[0, jnp.arange(N), ext[:, 0]])
+    alpha0 = jnp.where((s_idx[None, :] == 1) & (lab_len[:, None] > 0),
+                       logp[0, jnp.arange(N), ext[:, 1], None] if False else
+                       jnp.broadcast_to(logp[0][jnp.arange(N), ext[:, 1]][:, None], (N, S)),
+                       alpha0)
+
+    def lse(a, b):
+        return jnp.logaddexp(a, b)
+
+    def step(alpha, t):
+        a_prev = alpha
+        a_m1 = jnp.pad(alpha, ((0, 0), (1, 0)), constant_values=neg_inf)[:, :S]
+        a_m2 = jnp.pad(alpha, ((0, 0), (2, 0)), constant_values=neg_inf)[:, :S]
+        a = lse(a_prev, a_m1)
+        a = jnp.where(can_skip, lse(a, a_m2), a)
+        emit = jnp.take_along_axis(logp[t], ext, axis=1)
+        new = a + emit
+        # freeze past data length
+        new = jnp.where((t < dat_len)[:, None], new, alpha)
+        return new, None
+
+    alphaT, _ = lax.scan(step, alpha0, jnp.arange(1, T))
+    end1 = 2 * lab_len
+    end2 = 2 * lab_len - 1
+    aT1 = jnp.take_along_axis(alphaT, end1[:, None], axis=1)[:, 0]
+    aT2 = jnp.take_along_axis(alphaT, jnp.maximum(end2, 0)[:, None], axis=1)[:, 0]
+    ll = jnp.logaddexp(aT1, jnp.where(lab_len > 0, aT2, neg_inf))
+    return -ll

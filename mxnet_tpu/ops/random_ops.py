@@ -1,0 +1,122 @@
+"""Random sampling ops (reference: src/operator/random/sample_op.cc,
+multisample_op.cc, shuffle_op.cc). Each draws from the framework PRNG
+stream (mxnet_tpu/random.py) — jax threefry replaces curand/Philox states."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+from .utils import pbool, pint, pfloat, ptuple, pdtype
+from .. import random as _random
+
+
+def _shape(shape):
+    s = ptuple(shape, default=(1,))
+    return s if s is not None else (1,)
+
+
+@register("_random_uniform", num_inputs=0, differentiable=False,
+          aliases=("uniform", "random_uniform"))
+def _uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    return jax.random.uniform(_random.next_key(), _shape(shape),
+                              dtype=pdtype(dtype), minval=pfloat(low, 0.0),
+                              maxval=pfloat(high, 1.0))
+
+
+@register("_random_normal", num_inputs=0, differentiable=False,
+          aliases=("normal", "random_normal"))
+def _normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    return jax.random.normal(_random.next_key(), _shape(shape),
+                             dtype=pdtype(dtype)) * pfloat(scale, 1.0) + pfloat(loc, 0.0)
+
+
+@register("_random_randint", num_inputs=0, differentiable=False,
+          aliases=("random_randint",))
+def _randint(low=0, high=1, shape=None, dtype="int32", ctx=None, **kw):
+    return jax.random.randint(_random.next_key(), _shape(shape),
+                              pint(low, 0), pint(high, 1), dtype=pdtype(dtype))
+
+
+@register("_random_exponential", num_inputs=0, differentiable=False,
+          aliases=("random_exponential",))
+def _exponential(lam=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    return jax.random.exponential(_random.next_key(), _shape(shape),
+                                  dtype=pdtype(dtype)) / pfloat(lam, 1.0)
+
+
+@register("_random_gamma", num_inputs=0, differentiable=False,
+          aliases=("random_gamma",))
+def _gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    return jax.random.gamma(_random.next_key(), pfloat(alpha, 1.0),
+                            _shape(shape), dtype=pdtype(dtype)) * pfloat(beta, 1.0)
+
+
+@register("_random_poisson", num_inputs=0, differentiable=False,
+          aliases=("random_poisson",))
+def _poisson(lam=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    return jax.random.poisson(_random.next_key(), pfloat(lam, 1.0),
+                              _shape(shape)).astype(pdtype(dtype))
+
+
+@register("_random_negative_binomial", num_inputs=0, differentiable=False,
+          aliases=("random_negative_binomial",))
+def _neg_binomial(k=1, p=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    lam = jax.random.gamma(_random.next_key(), pint(k, 1), _shape(shape)) \
+        * (1.0 - pfloat(p, 1.0)) / pfloat(p, 1.0)
+    return jax.random.poisson(_random.next_key(), lam,
+                              _shape(shape)).astype(pdtype(dtype))
+
+
+@register("_random_generalized_negative_binomial", num_inputs=0,
+          differentiable=False, aliases=("random_generalized_negative_binomial",))
+def _gen_neg_binomial(mu=1.0, alpha=1.0, shape=None, dtype="float32", ctx=None, **kw):
+    mu, alpha = pfloat(mu, 1.0), pfloat(alpha, 1.0)
+    r = 1.0 / alpha
+    lam = jax.random.gamma(_random.next_key(), r, _shape(shape)) * (mu * alpha)
+    return jax.random.poisson(_random.next_key(), lam,
+                              _shape(shape)).astype(pdtype(dtype))
+
+
+@register("_sample_multinomial", num_inputs=1, differentiable=False,
+          aliases=("sample_multinomial",))
+def _multinomial(data, shape=None, get_prob=False, dtype="int32", **kw):
+    s = ptuple(shape, default=())
+    n = 1
+    for d in (s or ()):
+        n *= d
+    logits = jnp.log(jnp.maximum(data, 1e-30))
+    if data.ndim == 1:
+        out = jax.random.categorical(_random.next_key(), logits, shape=(n,) if s else ())
+        out = out.reshape(s) if s else out
+    else:
+        out = jax.random.categorical(_random.next_key(), logits[:, None, :],
+                                     axis=-1, shape=(data.shape[0], max(n, 1)))
+        out = out.reshape((data.shape[0],) + s) if s else out[:, 0]
+    return out.astype(pdtype(dtype))
+
+
+@register("_shuffle", num_inputs=1, differentiable=False, aliases=("shuffle",))
+def _shuffle(data, **kw):
+    return jax.random.permutation(_random.next_key(), data, axis=0)
+
+
+# _sample_* row-wise distribution-parameter variants
+@register("_sample_uniform", num_inputs=2, differentiable=False)
+def _sample_uniform(low, high, shape=None, dtype="float32", **kw):
+    s = ptuple(shape, default=())
+    u = jax.random.uniform(_random.next_key(), low.shape + (s or ()),
+                           dtype=pdtype(dtype))
+    ex = low.reshape(low.shape + (1,) * len(s or ())) if s else low
+    exh = high.reshape(high.shape + (1,) * len(s or ())) if s else high
+    return ex + u * (exh - ex)
+
+
+@register("_sample_normal", num_inputs=2, differentiable=False)
+def _sample_normal(mu, sigma, shape=None, dtype="float32", **kw):
+    s = ptuple(shape, default=())
+    z = jax.random.normal(_random.next_key(), mu.shape + (s or ()),
+                          dtype=pdtype(dtype))
+    exm = mu.reshape(mu.shape + (1,) * len(s or ())) if s else mu
+    exs = sigma.reshape(sigma.shape + (1,) * len(s or ())) if s else sigma
+    return exm + z * exs

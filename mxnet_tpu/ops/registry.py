@@ -1,0 +1,107 @@
+"""Operator registry — the TPU-native equivalent of the NNVM op registry.
+
+Reference parity: nnvm::Op registry + include/mxnet/op_attr_types.h
+(FCompute/FInferShape/FInferType/FGradient attrs) and the import-time
+Python codegen in python/mxnet/ndarray/register.py:31,160 and
+python/mxnet/symbol/register.py:35,201.
+
+TPU-native design: an op is a *pure jax-traceable function* over jax
+arrays plus static attrs.  There is no separate FCompute per device —
+XLA lowers one definition to TPU/CPU — and no hand-written FGradient for
+most ops: gradients come from jax.vjp on the same function.  Shape/type
+inference for the Symbol front-end is done by abstract evaluation
+(jax.eval_shape) instead of per-op FInferShape, so every registered op
+gets inference for free.
+
+Both mx.nd.* and mx.sym.* are generated from this one registry at import
+time, mirroring the reference's codegen pipeline.
+"""
+from __future__ import annotations
+
+import functools
+
+from ..base import MXNetError, _Null
+
+__all__ = ["OpInfo", "register", "get_op", "list_ops", "alias"]
+
+_OP_REGISTRY = {}
+
+
+class OpInfo:
+    """One registered operator.
+
+    Parameters
+    ----------
+    name : canonical op name (MXNet spelling, e.g. 'broadcast_add')
+    fn : callable(*arrays, **attrs) -> array | tuple(arrays)
+        Pure jax-traceable implementation.
+    num_inputs : int or -1 for variadic (list passed as first arg)
+    num_outputs : int or callable(attrs)->int
+    differentiable : include on autograd tape
+    mutate_inputs : indices of inputs mutated in place (e.g. optimizer
+        update kernels). The NDArray layer rebinds those handles.
+    """
+
+    __slots__ = (
+        "name", "fn", "num_inputs", "num_outputs", "differentiable",
+        "mutate_inputs", "doc", "aliases",
+    )
+
+    def __init__(self, name, fn, num_inputs=1, num_outputs=1,
+                 differentiable=True, mutate_inputs=(), doc=None):
+        self.name = name
+        self.fn = fn
+        self.num_inputs = num_inputs
+        self.num_outputs = num_outputs
+        self.differentiable = differentiable
+        self.mutate_inputs = tuple(mutate_inputs)
+        self.doc = doc or (fn.__doc__ if fn else None)
+        self.aliases = []
+
+    def n_outputs(self, attrs=None):
+        if callable(self.num_outputs):
+            return self.num_outputs(attrs or {})
+        return self.num_outputs
+
+    def __repr__(self):
+        return "OpInfo(%s)" % self.name
+
+
+def register(name, num_inputs=1, num_outputs=1, differentiable=True,
+             mutate_inputs=(), aliases=()):
+    """Decorator: register a jax-traceable function as an operator."""
+
+    def _reg(fn):
+        info = OpInfo(name, fn, num_inputs, num_outputs, differentiable,
+                      mutate_inputs)
+        if name in _OP_REGISTRY:
+            raise MXNetError("op %r already registered" % name)
+        _OP_REGISTRY[name] = info
+        for a in aliases:
+            alias(name, a)
+        return fn
+
+    return _reg
+
+
+def alias(name, alias_name):
+    info = _OP_REGISTRY[name]
+    info.aliases.append(alias_name)
+    _OP_REGISTRY[alias_name] = info
+
+
+def get_op(name):
+    try:
+        return _OP_REGISTRY[name]
+    except KeyError:
+        raise MXNetError("operator %r is not registered" % name) from None
+
+
+def list_ops():
+    return sorted(_OP_REGISTRY)
+
+
+def clean_attrs(kwargs):
+    """Drop _Null placeholders and framework-internal kwargs."""
+    return {k: v for k, v in kwargs.items()
+            if v is not _Null and not k.startswith("__")}

@@ -1,0 +1,712 @@
+"""Tensor ops: elemwise, broadcast, reduce, matrix manipulation, indexing.
+
+Reference parity: src/operator/tensor/ (~31k LoC of C++/CUDA —
+elemwise_binary_op*.cc, broadcast_reduce_op*.cc, matrix_op.cc, dot.cc,
+indexing_op.cc, init_op.cc, ordering_op.cc, la_op.cc).  TPU-native: every
+op is one jnp/lax expression; XLA fuses elementwise chains into matmul
+epilogues, so there is no hand-written kernel zoo (mshadow_op.h) here.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register, alias
+from .utils import pbool, pint, pfloat, ptuple, pdtype, paxis, normalize_axis
+
+# ---------------------------------------------------------------------------
+# elemwise binary (same-shape) and broadcast binary
+# (reference: src/operator/tensor/elemwise_binary_op_basic.cc,
+#  elemwise_binary_broadcast_op_*.cc)
+# ---------------------------------------------------------------------------
+
+_BINARY = {
+    "add": jnp.add,
+    "sub": jnp.subtract,
+    "mul": jnp.multiply,
+    "div": jnp.divide,
+    "mod": jnp.mod,
+    "power": jnp.power,
+    "maximum": jnp.maximum,
+    "minimum": jnp.minimum,
+    "hypot": jnp.hypot,
+}
+
+for _name, _fn in _BINARY.items():
+    mx_name = {"add": "elemwise_add", "sub": "elemwise_sub",
+               "mul": "elemwise_mul", "div": "elemwise_div"}.get(_name)
+    if mx_name:
+        register(mx_name, num_inputs=2,
+                 aliases=("_" + _name,))(
+            (lambda f: lambda lhs, rhs, **kw: f(lhs, rhs))(_fn))
+    register("broadcast_" + _name, num_inputs=2)(
+        (lambda f: lambda lhs, rhs, **kw: f(lhs, rhs))(_fn))
+
+_CMP = {
+    "equal": jnp.equal, "not_equal": jnp.not_equal,
+    "greater": jnp.greater, "greater_equal": jnp.greater_equal,
+    "lesser": jnp.less, "lesser_equal": jnp.less_equal,
+    "logical_and": jnp.logical_and, "logical_or": jnp.logical_or,
+    "logical_xor": jnp.logical_xor,
+}
+
+for _name, _fn in _CMP.items():
+    # mxnet comparison ops return float (same dtype as input)
+    register("broadcast_" + _name, num_inputs=2, differentiable=False)(
+        (lambda f: lambda lhs, rhs, **kw: f(lhs, rhs).astype(lhs.dtype))(_fn))
+
+# scalar variants (reference: elemwise_binary_scalar_op_*.cc)
+_SCALAR_OPS = {
+    "_plus_scalar": lambda x, s: x + s,
+    "_minus_scalar": lambda x, s: x - s,
+    "_rminus_scalar": lambda x, s: s - x,
+    "_mul_scalar": lambda x, s: x * s,
+    "_div_scalar": lambda x, s: x / s,
+    "_rdiv_scalar": lambda x, s: s / x,
+    "_power_scalar": lambda x, s: jnp.power(x, s),
+    "_rpower_scalar": lambda x, s: jnp.power(s, x),
+    "_mod_scalar": lambda x, s: jnp.mod(x, s),
+    "_rmod_scalar": lambda x, s: jnp.mod(s, x),
+    "_maximum_scalar": lambda x, s: jnp.maximum(x, s),
+    "_minimum_scalar": lambda x, s: jnp.minimum(x, s),
+    "_hypot_scalar": lambda x, s: jnp.hypot(x, jnp.asarray(s, x.dtype)),
+}
+for _name, _fn in _SCALAR_OPS.items():
+    register(_name)(
+        (lambda f: lambda data, scalar=0.0, **kw: f(data, pfloat(scalar, 0.0)))(_fn))
+
+_SCALAR_CMP = {
+    "_equal_scalar": jnp.equal, "_not_equal_scalar": jnp.not_equal,
+    "_greater_scalar": jnp.greater, "_greater_equal_scalar": jnp.greater_equal,
+    "_lesser_scalar": jnp.less, "_lesser_equal_scalar": jnp.less_equal,
+    "_logical_and_scalar": jnp.logical_and, "_logical_or_scalar": jnp.logical_or,
+    "_logical_xor_scalar": jnp.logical_xor,
+}
+for _name, _fn in _SCALAR_CMP.items():
+    register(_name, differentiable=False)(
+        (lambda f: lambda data, scalar=0.0, **kw:
+            f(data, pfloat(scalar, 0.0)).astype(data.dtype))(_fn))
+
+# ---------------------------------------------------------------------------
+# elemwise unary (reference: elemwise_unary_op_basic.cc, _trig.cc, _pow.cc)
+# ---------------------------------------------------------------------------
+
+_UNARY = {
+    "abs": jnp.abs, "sign": jnp.sign, "rint": jnp.rint, "ceil": jnp.ceil,
+    "floor": jnp.floor, "trunc": jnp.trunc, "fix": jnp.fix,
+    "square": jnp.square, "sqrt": jnp.sqrt,
+    "rsqrt": lambda x: lax.rsqrt(x),
+    "cbrt": jnp.cbrt, "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+    "exp": jnp.exp, "log": jnp.log, "log10": jnp.log10, "log2": jnp.log2,
+    "log1p": jnp.log1p, "expm1": jnp.expm1,
+    "sin": jnp.sin, "cos": jnp.cos, "tan": jnp.tan,
+    "arcsin": jnp.arcsin, "arccos": jnp.arccos, "arctan": jnp.arctan,
+    "sinh": jnp.sinh, "cosh": jnp.cosh, "tanh": jnp.tanh,
+    "arcsinh": jnp.arcsinh, "arccosh": jnp.arccosh, "arctanh": jnp.arctanh,
+    "degrees": jnp.degrees, "radians": jnp.radians,
+    "reciprocal": jnp.reciprocal, "negative": jnp.negative,
+    "erf": jax.scipy.special.erf, "erfinv": jax.scipy.special.erfinv,
+    "gamma": lambda x: jnp.exp(jax.scipy.special.gammaln(x)),
+    "gammaln": jax.scipy.special.gammaln,
+    "relu": lambda x: jnp.maximum(x, 0),
+    "sigmoid": jax.nn.sigmoid,
+    "softsign": jax.nn.soft_sign,
+}
+for _name, _fn in _UNARY.items():
+    register(_name)((lambda f: lambda data, **kw: f(data))(_fn))
+
+register("logical_not", differentiable=False)(
+    lambda data, **kw: jnp.logical_not(data).astype(data.dtype))
+register("hard_sigmoid")(
+    lambda data, alpha=0.2, beta=0.5, **kw:
+        jnp.clip(pfloat(alpha, 0.2) * data + pfloat(beta, 0.5), 0.0, 1.0))
+register("_copy")(lambda data, **kw: data)
+register("identity")(lambda data, **kw: data)
+register("BlockGrad", aliases=("stop_gradient",))(
+    lambda data, **kw: lax.stop_gradient(data))
+register("make_loss")(lambda data, **kw: data)
+
+
+@register("clip")
+def _clip(data, a_min=None, a_max=None, **kw):
+    return jnp.clip(data, pfloat(a_min), pfloat(a_max))
+
+
+@register("Cast", aliases=("cast",), differentiable=False)
+def _cast(data, dtype="float32", **kw):
+    return data.astype(pdtype(dtype))
+
+
+register("zeros_like", differentiable=False)(lambda data, **kw: jnp.zeros_like(data))
+register("ones_like", differentiable=False)(lambda data, **kw: jnp.ones_like(data))
+
+# ---------------------------------------------------------------------------
+# reductions (reference: broadcast_reduce_op_value.cc)
+# ---------------------------------------------------------------------------
+
+
+def _reduce(fn, data, axis=None, keepdims=False, exclude=False):
+    axis = paxis(axis)
+    keepdims = pbool(keepdims)
+    if pbool(exclude) and axis is not None:
+        ax = axis if isinstance(axis, tuple) else (axis,)
+        ax = tuple(normalize_axis(a, data.ndim) for a in ax)
+        axis = tuple(i for i in range(data.ndim) if i not in ax)
+    return fn(data, axis=axis, keepdims=keepdims)
+
+
+for _name, _fn in {"sum": jnp.sum, "mean": jnp.mean, "prod": jnp.prod,
+                   "nansum": jnp.nansum, "nanprod": jnp.nanprod,
+                   "max": jnp.max, "min": jnp.min}.items():
+    register(_name, aliases=((_name + "_axis",) if _name in ("sum", "max", "min") else ()))(
+        (lambda f: lambda data, axis=None, keepdims=False, exclude=False, **kw:
+            _reduce(f, data, axis, keepdims, exclude))(_fn))
+
+
+@register("norm")
+def _norm(data, ord=2, axis=None, keepdims=False, **kw):
+    ord = pint(ord, 2)
+    axis = paxis(axis)
+    keepdims = pbool(keepdims)
+    if ord == 1:
+        return jnp.sum(jnp.abs(data), axis=axis, keepdims=keepdims)
+    return jnp.sqrt(jnp.sum(jnp.square(data), axis=axis, keepdims=keepdims))
+
+
+@register("argmax", differentiable=False)
+def _argmax(data, axis=None, keepdims=False, **kw):
+    out = jnp.argmax(data, axis=paxis(axis), keepdims=pbool(keepdims))
+    return out.astype(data.dtype)  # mxnet returns same dtype as input
+
+
+@register("argmin", differentiable=False)
+def _argmin(data, axis=None, keepdims=False, **kw):
+    return jnp.argmin(data, axis=paxis(axis), keepdims=pbool(keepdims)).astype(data.dtype)
+
+
+@register("argmax_channel", differentiable=False)
+def _argmax_channel(data, **kw):
+    return jnp.argmax(data, axis=1).astype(data.dtype)
+
+
+# ---------------------------------------------------------------------------
+# broadcast helpers
+# ---------------------------------------------------------------------------
+
+
+@register("broadcast_to", differentiable=True)
+def _broadcast_to(data, shape=None, **kw):
+    shape = ptuple(shape)
+    tgt = tuple(s if s != 0 else d for s, d in zip(shape, data.shape))
+    return jnp.broadcast_to(data, tgt)
+
+
+@register("broadcast_axis", aliases=("broadcast_axes",))
+def _broadcast_axis(data, axis=None, size=None, **kw):
+    axes = paxis(axis)
+    sizes = ptuple(size)
+    if not isinstance(axes, tuple):
+        axes = (axes,)
+    tgt = list(data.shape)
+    for a, s in zip(axes, sizes):
+        tgt[normalize_axis(a, data.ndim)] = s
+    return jnp.broadcast_to(data, tuple(tgt))
+
+
+@register("broadcast_like", num_inputs=2)
+def _broadcast_like(lhs, rhs, **kw):
+    return jnp.broadcast_to(lhs, rhs.shape)
+
+
+# ---------------------------------------------------------------------------
+# dot / batch_dot / linalg (reference: dot.cc, la_op.cc via cuBLAS/LAPACK;
+# here lax.dot_general -> MXU)
+# ---------------------------------------------------------------------------
+
+
+@register("dot", num_inputs=2)
+def _dot(lhs, rhs, transpose_a=False, transpose_b=False, **kw):
+    if pbool(transpose_a):
+        lhs = lhs.T if lhs.ndim == 2 else jnp.moveaxis(lhs, 0, -1)
+    if pbool(transpose_b):
+        rhs = rhs.T if rhs.ndim == 2 else jnp.moveaxis(rhs, -1, 0)
+    if lhs.ndim == 1 and rhs.ndim == 1:
+        return jnp.dot(lhs, rhs)
+    # mxnet dot contracts last axis of lhs with first axis of rhs
+    return jnp.tensordot(lhs, rhs, axes=([lhs.ndim - 1], [0]))
+
+
+@register("batch_dot", num_inputs=2)
+def _batch_dot(lhs, rhs, transpose_a=False, transpose_b=False, **kw):
+    if pbool(transpose_a):
+        lhs = jnp.swapaxes(lhs, -1, -2)
+    if pbool(transpose_b):
+        rhs = jnp.swapaxes(rhs, -1, -2)
+    return jnp.matmul(lhs, rhs)
+
+
+# linalg_* subset (reference: src/operator/tensor/la_op.cc)
+@register("_linalg_gemm", num_inputs=3, aliases=("linalg_gemm",))
+def _linalg_gemm(A, B, C, transpose_a=False, transpose_b=False, alpha=1.0,
+                 beta=1.0, axis=-3, **kw):
+    if pbool(transpose_a):
+        A = jnp.swapaxes(A, -1, -2)
+    if pbool(transpose_b):
+        B = jnp.swapaxes(B, -1, -2)
+    return pfloat(alpha, 1.0) * jnp.matmul(A, B) + pfloat(beta, 1.0) * C
+
+
+@register("_linalg_gemm2", num_inputs=2, aliases=("linalg_gemm2",))
+def _linalg_gemm2(A, B, transpose_a=False, transpose_b=False, alpha=1.0, **kw):
+    if pbool(transpose_a):
+        A = jnp.swapaxes(A, -1, -2)
+    if pbool(transpose_b):
+        B = jnp.swapaxes(B, -1, -2)
+    return pfloat(alpha, 1.0) * jnp.matmul(A, B)
+
+
+register("_linalg_potrf", aliases=("linalg_potrf",))(
+    lambda A, **kw: jnp.linalg.cholesky(A))
+register("_linalg_syrk", aliases=("linalg_syrk",))(
+    lambda A, transpose=False, alpha=1.0, **kw:
+        pfloat(alpha, 1.0) * (jnp.matmul(jnp.swapaxes(A, -1, -2), A)
+                              if pbool(transpose)
+                              else jnp.matmul(A, jnp.swapaxes(A, -1, -2))))
+register("_linalg_sumlogdiag", aliases=("linalg_sumlogdiag",))(
+    lambda A, **kw: jnp.sum(jnp.log(jnp.diagonal(A, axis1=-2, axis2=-1)), axis=-1))
+register("_linalg_extractdiag", aliases=("linalg_extractdiag",))(
+    lambda A, offset=0, **kw: jnp.diagonal(A, offset=pint(offset, 0), axis1=-2, axis2=-1))
+
+
+@register("_linalg_trsm", num_inputs=2, aliases=("linalg_trsm",))
+def _linalg_trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0, **kw):
+    lower = pbool(lower, True)
+    if pbool(transpose):
+        A = jnp.swapaxes(A, -1, -2)
+        lower = not lower
+    alpha = pfloat(alpha, 1.0)
+    if pbool(rightside):
+        X = jnp.swapaxes(jax.scipy.linalg.solve_triangular(
+            jnp.swapaxes(A, -1, -2), jnp.swapaxes(alpha * B, -1, -2),
+            lower=not lower), -1, -2)
+    else:
+        X = jax.scipy.linalg.solve_triangular(A, alpha * B, lower=lower)
+    return X
+
+
+# ---------------------------------------------------------------------------
+# matrix manipulation (reference: matrix_op.cc)
+# ---------------------------------------------------------------------------
+
+
+def _mx_reshape(shape, src_shape):
+    """MXNet reshape with special codes 0, -1, -2, -3, -4
+    (reference: src/operator/tensor/matrix_op-inl.h InferReshapeShape)."""
+    out = []
+    src = list(src_shape)
+    i = 0  # index into src
+    k = 0
+    shape = list(shape)
+    while k < len(shape):
+        s = shape[k]
+        if s == 0:
+            out.append(src[i]); i += 1
+        elif s == -1:
+            out.append(-1); i += 1
+        elif s == -2:
+            out.extend(src[i:]); i = len(src)
+        elif s == -3:
+            out.append(src[i] * src[i + 1]); i += 2
+        elif s == -4:
+            a, b = shape[k + 1], shape[k + 2]
+            if a == -1:
+                a = src[i] // b
+            if b == -1:
+                b = src[i] // a
+            out.extend([a, b]); i += 1; k += 2
+        else:
+            out.append(s)
+            i += 1
+        k += 1
+    # fix up single -1
+    if -1 in out:
+        known = 1
+        for v in out:
+            if v != -1:
+                known *= v
+        total = int(np.prod(src_shape)) if src_shape else 1
+        out[out.index(-1)] = total // known
+    return tuple(out)
+
+
+@register("Reshape", aliases=("reshape",))
+def _reshape(data, shape=None, reverse=False, **kw):
+    shape = ptuple(shape)
+    if pbool(reverse):
+        rshape = _mx_reshape(list(reversed(shape)), list(reversed(data.shape)))
+        return jnp.reshape(data, tuple(reversed(rshape)))
+    return jnp.reshape(data, _mx_reshape(shape, data.shape))
+
+
+@register("Flatten", aliases=("flatten",))
+def _flatten(data, **kw):
+    return jnp.reshape(data, (data.shape[0], -1))
+
+
+@register("transpose")
+def _transpose(data, axes=None, **kw):
+    axes = ptuple(axes)
+    if not axes:
+        axes = None
+    return jnp.transpose(data, axes)
+
+
+@register("expand_dims")
+def _expand_dims(data, axis=0, **kw):
+    return jnp.expand_dims(data, pint(axis, 0))
+
+
+@register("squeeze")
+def _squeeze(data, axis=None, **kw):
+    return jnp.squeeze(data, paxis(axis))
+
+
+@register("swapaxes", aliases=("SwapAxis",))
+def _swapaxes(data, dim1=0, dim2=0, **kw):
+    return jnp.swapaxes(data, pint(dim1, 0), pint(dim2, 0))
+
+
+@register("slice", aliases=("crop",))
+def _slice(data, begin=None, end=None, step=None, **kw):
+    begin = ptuple(begin) or ()
+    end_raw = end
+    step = ptuple(step) or ()
+    # end may contain None entries
+    import ast as _ast
+    if isinstance(end_raw, str):
+        end_raw = _ast.literal_eval(end_raw)
+    end_list = list(end_raw) if end_raw is not None else []
+    idx = []
+    for i in range(data.ndim):
+        b = begin[i] if i < len(begin) else None
+        e = end_list[i] if i < len(end_list) else None
+        s = step[i] if i < len(step) and step[i] != 0 else None
+        idx.append(slice(b, e, s))
+    return data[tuple(idx)]
+
+
+@register("slice_axis")
+def _slice_axis(data, axis=0, begin=0, end=None, **kw):
+    axis = normalize_axis(pint(axis, 0), data.ndim)
+    b = pint(begin, 0)
+    e = None if (end is None or (isinstance(end, str) and end == "None")) else pint(end)
+    idx = [slice(None)] * data.ndim
+    idx[axis] = slice(b, e)
+    return data[tuple(idx)]
+
+
+@register("slice_like", num_inputs=2)
+def _slice_like(data, shape_like, axes=None, **kw):
+    axes = ptuple(axes)
+    idx = [slice(None)] * data.ndim
+    if not axes:
+        axes = tuple(range(shape_like.ndim))
+    for a in axes:
+        a = normalize_axis(a, data.ndim)
+        idx[a] = slice(0, shape_like.shape[a])
+    return data[tuple(idx)]
+
+
+@register("Concat", num_inputs=-1, aliases=("concat",))
+def _concat(*data, dim=1, num_args=None, **kw):
+    return jnp.concatenate(data, axis=pint(dim, 1))
+
+
+@register("stack", num_inputs=-1)
+def _stack(*data, axis=0, num_args=None, **kw):
+    return jnp.stack(data, axis=pint(axis, 0))
+
+
+def _split_num_outputs(attrs):
+    return pint(attrs.get("num_outputs"), 1)
+
+
+@register("SliceChannel", num_outputs=_split_num_outputs, aliases=("split",))
+def _split(data, num_outputs=1, axis=1, squeeze_axis=False, **kw):
+    num = pint(num_outputs, 1)
+    axis = normalize_axis(pint(axis, 1), data.ndim)
+    parts = jnp.split(data, num, axis=axis)
+    if pbool(squeeze_axis):
+        parts = [jnp.squeeze(p, axis=axis) for p in parts]
+    return tuple(parts) if num > 1 else parts[0]
+
+
+@register("tile")
+def _tile(data, reps=None, **kw):
+    return jnp.tile(data, ptuple(reps))
+
+
+@register("repeat")
+def _repeat(data, repeats=1, axis=None, **kw):
+    return jnp.repeat(data, pint(repeats, 1), axis=paxis(axis))
+
+
+@register("reverse", aliases=("flip",))
+def _reverse(data, axis=None, **kw):
+    ax = paxis(axis)
+    if not isinstance(ax, tuple):
+        ax = (ax,)
+    return jnp.flip(data, axis=ax)
+
+
+@register("Pad", aliases=("pad",))
+def _pad(data, mode="constant", pad_width=None, constant_value=0.0, **kw):
+    pw = ptuple(pad_width)
+    pairs = [(pw[2 * i], pw[2 * i + 1]) for i in range(len(pw) // 2)]
+    mode = mode if mode != "edge" else "edge"
+    if mode == "constant":
+        return jnp.pad(data, pairs, mode="constant",
+                       constant_values=pfloat(constant_value, 0.0))
+    return jnp.pad(data, pairs, mode="reflect" if mode == "reflect" else "edge")
+
+
+@register("depth_to_space")
+def _depth_to_space(data, block_size=1, **kw):
+    b = pint(block_size, 1)
+    n, c, h, w = data.shape
+    x = jnp.reshape(data, (n, b, b, c // (b * b), h, w))
+    x = jnp.transpose(x, (0, 3, 4, 1, 5, 2))
+    return jnp.reshape(x, (n, c // (b * b), h * b, w * b))
+
+
+@register("space_to_depth")
+def _space_to_depth(data, block_size=1, **kw):
+    b = pint(block_size, 1)
+    n, c, h, w = data.shape
+    x = jnp.reshape(data, (n, c, h // b, b, w // b, b))
+    x = jnp.transpose(x, (0, 3, 5, 1, 2, 4))
+    return jnp.reshape(x, (n, c * b * b, h // b, w // b))
+
+
+@register("diag")
+def _diag(data, k=0, axis1=0, axis2=1, **kw):
+    k = pint(k, 0)
+    if data.ndim == 1:
+        return jnp.diag(data, k)
+    return jnp.diagonal(data, offset=k, axis1=pint(axis1, 0), axis2=pint(axis2, 1))
+
+
+@register("shape_array", differentiable=False)
+def _shape_array(data, **kw):
+    return jnp.asarray(data.shape, dtype=jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)
+
+
+@register("size_array", differentiable=False)
+def _size_array(data, **kw):
+    return jnp.asarray([data.size], dtype=jnp.int64 if jax.config.jax_enable_x64 else jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# indexing (reference: indexing_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("take", num_inputs=2)
+def _take(a, indices, axis=0, mode="clip", **kw):
+    axis = pint(axis, 0)
+    mode = mode or "clip"
+    return jnp.take(a, indices.astype(jnp.int32), axis=axis,
+                    mode="clip" if mode == "clip" else "wrap")
+
+
+@register("batch_take", num_inputs=2)
+def _batch_take(a, indices, **kw):
+    return jnp.take_along_axis(
+        a, indices.astype(jnp.int32).reshape(-1, 1), axis=1)[:, 0]
+
+
+@register("pick", num_inputs=2)
+def _pick(data, index, axis=-1, keepdims=False, mode="clip", **kw):
+    axis = pint(axis, -1)
+    idx = jnp.expand_dims(index.astype(jnp.int32), axis=axis)
+    out = jnp.take_along_axis(data, idx, axis=axis)
+    if not pbool(keepdims):
+        out = jnp.squeeze(out, axis=axis)
+    return out
+
+
+@register("one_hot", differentiable=False)
+def _one_hot(indices, depth=0, on_value=1.0, off_value=0.0, dtype="float32", **kw):
+    return jax.nn.one_hot(indices.astype(jnp.int32), pint(depth, 0),
+                          dtype=pdtype(dtype)) * (pfloat(on_value, 1.0) - pfloat(off_value, 0.0)) \
+        + pfloat(off_value, 0.0)
+
+
+@register("gather_nd", num_inputs=2)
+def _gather_nd(data, indices, **kw):
+    idx = tuple(indices.astype(jnp.int32)[i] for i in range(indices.shape[0]))
+    return data[idx]
+
+
+@register("scatter_nd", num_inputs=2, differentiable=False)
+def _scatter_nd(data, indices, shape=None, **kw):
+    shape = ptuple(shape)
+    out = jnp.zeros(shape, dtype=data.dtype)
+    idx = tuple(indices.astype(jnp.int32)[i] for i in range(indices.shape[0]))
+    return out.at[idx].set(data)
+
+
+@register("where", num_inputs=3)
+def _where(condition, x, y, **kw):
+    if condition.ndim < x.ndim and condition.ndim == 1:
+        condition = condition.reshape((-1,) + (1,) * (x.ndim - 1))
+    return jnp.where(condition != 0, x, y)
+
+
+@register("boolean_mask", num_inputs=2)
+def _boolean_mask(data, index, axis=0, **kw):
+    # dynamic output shape: only usable eagerly (not under jit) — parity
+    # with reference contrib op which is also dynamic (SURVEY §5 long-ctx).
+    mask = np.asarray(index) != 0
+    return jnp.compress(mask, data, axis=pint(axis, 0))
+
+
+# ---------------------------------------------------------------------------
+# init ops (reference: init_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("_zeros", num_inputs=0, differentiable=False)
+def _zeros(shape=None, dtype="float32", ctx=None, **kw):
+    return jnp.zeros(ptuple(shape, default=()), dtype=pdtype(dtype))
+
+
+@register("_ones", num_inputs=0, differentiable=False)
+def _ones(shape=None, dtype="float32", ctx=None, **kw):
+    return jnp.ones(ptuple(shape, default=()), dtype=pdtype(dtype))
+
+
+@register("_full", num_inputs=0, differentiable=False)
+def _full(shape=None, value=0.0, dtype="float32", ctx=None, **kw):
+    return jnp.full(ptuple(shape, default=()), pfloat(value, 0.0), dtype=pdtype(dtype))
+
+
+@register("_arange", num_inputs=0, differentiable=False)
+def _arange(start=0.0, stop=None, step=1.0, repeat=1, infer_range=False,
+            dtype="float32", ctx=None, **kw):
+    stop = None if (stop is None or (isinstance(stop, str) and stop == "None")) else pfloat(stop)
+    out = jnp.arange(pfloat(start, 0.0), stop, pfloat(step, 1.0), dtype=pdtype(dtype))
+    r = pint(repeat, 1)
+    if r > 1:
+        out = jnp.repeat(out, r)
+    return out
+
+
+@register("_linspace", num_inputs=0, differentiable=False)
+def _linspace(start=0.0, stop=1.0, num=50, endpoint=True, dtype="float32", ctx=None, **kw):
+    return jnp.linspace(pfloat(start, 0.0), pfloat(stop, 1.0), pint(num, 50),
+                        endpoint=pbool(endpoint, True), dtype=pdtype(dtype))
+
+
+@register("_eye", num_inputs=0, differentiable=False)
+def _eye(N=0, M=0, k=0, dtype="float32", ctx=None, **kw):
+    M = pint(M, 0) or None
+    return jnp.eye(pint(N, 0), M, k=pint(k, 0), dtype=pdtype(dtype))
+
+
+# ---------------------------------------------------------------------------
+# ordering (reference: ordering_op.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("sort", differentiable=False)
+def _sort(data, axis=-1, is_ascend=True, **kw):
+    ax = paxis(axis, -1)
+    out = jnp.sort(data, axis=ax)
+    if not pbool(is_ascend, True):
+        out = jnp.flip(out, axis=ax if ax is not None else 0)
+    return out
+
+
+@register("argsort", differentiable=False)
+def _argsort(data, axis=-1, is_ascend=True, dtype="float32", **kw):
+    ax = paxis(axis, -1)
+    out = jnp.argsort(data, axis=ax)
+    if not pbool(is_ascend, True):
+        out = jnp.flip(out, axis=ax if ax is not None else 0)
+    return out.astype(pdtype(dtype))
+
+
+def _topk_num_outputs(attrs):
+    rt = attrs.get("ret_typ", "indices")
+    return 2 if rt == "both" else 1
+
+
+@register("topk", num_outputs=_topk_num_outputs, differentiable=False)
+def _topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32", **kw):
+    ax = paxis(axis, -1)
+    k = pint(k, 1)
+    is_ascend = pbool(is_ascend, False)
+    ret_typ = ret_typ or "indices"
+    x = data if not is_ascend else -data
+    ax_n = normalize_axis(ax, data.ndim)
+    xm = jnp.moveaxis(x, ax_n, -1)
+    vals, idxs = jax.lax.top_k(xm, k)
+    if is_ascend:
+        vals = -vals
+    vals = jnp.moveaxis(vals, -1, ax_n)
+    idxs = jnp.moveaxis(idxs, -1, ax_n)
+    if ret_typ == "value":
+        return vals
+    if ret_typ == "indices":
+        return idxs.astype(pdtype(dtype))
+    if ret_typ == "mask":
+        mask = jnp.zeros_like(jnp.moveaxis(data, ax_n, -1))
+        mask = mask.at[..., :].set(0)
+        oh = jax.nn.one_hot(jnp.moveaxis(idxs, ax_n, -1), data.shape[ax_n],
+                            dtype=data.dtype).sum(axis=-2)
+        return jnp.moveaxis(oh, -1, ax_n)
+    return vals, idxs.astype(pdtype(dtype))
+
+
+# ---------------------------------------------------------------------------
+# misc
+# ---------------------------------------------------------------------------
+
+
+@register("smooth_l1")
+def _smooth_l1(data, scalar=1.0, **kw):
+    s2 = pfloat(scalar, 1.0) ** 2
+    absd = jnp.abs(data)
+    return jnp.where(absd < 1.0 / s2, 0.5 * s2 * jnp.square(data), absd - 0.5 / s2)
+
+
+@register("log_softmax")
+def _log_softmax(data, axis=-1, temperature=None, **kw):
+    t = pfloat(temperature)
+    if t and t != 1.0:
+        data = data / t
+    return jax.nn.log_softmax(data, axis=paxis(axis, -1))
+
+
+@register("softmax")
+def _softmax_op(data, axis=-1, temperature=None, **kw):
+    t = pfloat(temperature)
+    if t and t != 1.0:
+        data = data / t
+    return jax.nn.softmax(data, axis=paxis(axis, -1))
+
+
+@register("softmin")
+def _softmin(data, axis=-1, **kw):
+    return jax.nn.softmax(-data, axis=paxis(axis, -1))
+
+
+@register("khatri_rao", num_inputs=-1)
+def _khatri_rao(*mats, **kw):
+    out = mats[0]
+    for m in mats[1:]:
+        out = jnp.einsum("ij,kj->ikj", out, m).reshape(-1, out.shape[1])
+    return out

@@ -1,0 +1,74 @@
+"""Attr-parsing helpers shared by op implementations.
+
+Reference parity: dmlc::Parameter / DMLC_DECLARE_FIELD structs parse
+string kwargs at the C ABI; here attrs may arrive as python objects (nd
+front-end) or strings (symbol json round-trip), so every op normalizes
+through these helpers.
+"""
+from __future__ import annotations
+
+import ast
+
+import numpy as np
+
+from ..base import dtype_str_to_np
+
+
+def pbool(v, default=False):
+    if v is None:
+        return default
+    if isinstance(v, str):
+        return v.lower() in ("1", "true", "yes")
+    return bool(v)
+
+
+def pint(v, default=None):
+    if v is None:
+        return default
+    return int(v)
+
+
+def pfloat(v, default=None):
+    if v is None:
+        return default
+    return float(v)
+
+
+def ptuple(v, ndim=None, default=None):
+    """Parse a shape-like attr: accepts tuple/list/int/str '(2, 2)'."""
+    if v is None:
+        return default
+    if isinstance(v, str):
+        v = v.strip()
+        if v in ("None", ""):
+            return default
+        v = ast.literal_eval(v)
+    if isinstance(v, (int, np.integer)):
+        v = (int(v),)
+    t = tuple(int(x) for x in v)
+    if ndim is not None and len(t) == 1 and ndim > 1:
+        t = t * ndim
+    return t
+
+
+def pdtype(v, default=np.float32):
+    if v is None:
+        return default
+    return dtype_str_to_np(v)
+
+
+def paxis(v, default=None):
+    """Parse an axis attr that may be int, tuple, None or their strings."""
+    if v is None or (isinstance(v, str) and v.strip() in ("None", "")):
+        return default
+    if isinstance(v, str):
+        v = ast.literal_eval(v.strip())
+    if isinstance(v, (list, tuple)):
+        return tuple(int(x) for x in v)
+    return int(v)
+
+
+def normalize_axis(axis, ndim):
+    if axis < 0:
+        axis += ndim
+    return axis

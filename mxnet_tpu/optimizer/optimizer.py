@@ -1,0 +1,588 @@
+"""Optimizers (reference parity: python/mxnet/optimizer/optimizer.py:46-1621
+— registry, lr/wd multipliers, MultiPrecision fp32 master weights, Updater).
+
+TPU-native: each update lowers to one fused XLA expression via the
+optimizer kernels in ops/optimizer_ops.py (reference: fused sgd/adam
+kernels in src/operator/optimizer_op.cc).  bf16 params + fp32 master
+copies (update_multi_precision) are the natural TPU mixed-precision path.
+"""
+from __future__ import annotations
+
+import math
+import pickle
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import NDArray, zeros, array, _invoke_nd
+
+__all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdaGrad", "RMSProp",
+           "AdaDelta", "Ftrl", "Adamax", "Nadam", "Signum", "SignSGD",
+           "SGLD", "FTML", "DCASGD", "LBSGD", "Test", "create", "register",
+           "get_updater", "Updater"]
+
+_OPT_REGISTRY = {}
+
+
+def register(klass):
+    _OPT_REGISTRY[klass.__name__.lower()] = klass
+    return klass
+
+
+class Optimizer:
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 sym=None, begin_num_update=0, multi_precision=False,
+                 param_dict=None):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.lr_mult = {}
+        self.wd_mult = {}
+        self.begin_num_update = begin_num_update
+        self.num_update = begin_num_update
+        self._index_update_count = {}
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.idx2name = dict(param_idx2name or {})
+        self.sym_info = (sym.attr_dict(), sym.list_arguments()) if sym is not None else ()
+        self.param_dict = param_dict or {}
+        self.set_lr_mult({})
+        self.set_wd_mult({})
+
+    # -- registry --------------------------------------------------------
+    @staticmethod
+    def register(klass):
+        return register(klass)
+
+    @staticmethod
+    def create_optimizer(name, **kwargs):
+        return create(name, **kwargs)
+
+    # -- state -----------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        weight_master_copy = None
+        if self.multi_precision and weight.dtype == np.float16:
+            weight_master_copy = weight.astype(np.float32)
+            return (self.create_state(index, weight_master_copy),
+                    weight_master_copy)
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == np.float16:
+            inner_state, weight32 = state
+            grad32 = grad.astype(np.float32)
+            self.update(index, weight32, grad32, inner_state)
+            weight._rebind(weight32._data.astype(weight._data.dtype))
+        else:
+            self.update(index, weight, grad, state)
+
+    # -- multipliers / schedules ----------------------------------------
+    def set_learning_rate(self, lr):
+        if self.lr_scheduler is not None:
+            raise MXNetError("LRScheduler of the optimizer has already been "
+                             "defined.")
+        self.lr = lr
+
+    def set_lr_mult(self, args_lr_mult):
+        self.lr_mult = {}
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__lr_mult__" in attr[name]:
+                    self.lr_mult[name] = float(attr[name]["__lr_mult__"])
+        self.lr_mult.update(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self.wd_mult = {}
+        for n in self.idx2name.values():
+            if not (n.endswith("_weight") or n.endswith("_gamma")):
+                self.wd_mult[n] = 0.0
+        if self.sym_info:
+            attr, arg_names = self.sym_info
+            for name in arg_names:
+                if name in attr and "__wd_mult__" in attr[name]:
+                    self.wd_mult[name] = float(attr[name]["__wd_mult__"])
+        self.wd_mult.update(args_wd_mult)
+
+    def _update_count(self, index):
+        if not isinstance(index, (list, tuple)):
+            index = [index]
+        for idx in index:
+            if idx not in self._index_update_count:
+                self._index_update_count[idx] = self.begin_num_update
+            self._index_update_count[idx] += 1
+            self.num_update = max(self._index_update_count[idx], self.num_update)
+
+    def _get_lr(self, index):
+        if self.lr_scheduler is not None:
+            lr = self.lr_scheduler(self.num_update)
+        else:
+            lr = self.lr
+        if index in self.param_dict:
+            p = self.param_dict[index]
+            lr *= getattr(p, "lr_mult", 1.0)
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.idx2name:
+            lr *= self.lr_mult.get(self.idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index):
+        wd = self.wd
+        if index in self.param_dict:
+            wd *= getattr(self.param_dict[index], "wd_mult", 1.0)
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.idx2name:
+            wd *= self.wd_mult.get(self.idx2name[index], 1.0)
+        return wd
+
+    def __getstate__(self):
+        d = self.__dict__.copy()
+        return d
+
+
+@register
+class SGD(Optimizer):
+    """SGD w/ momentum + optional multi-precision (fused kernel parity:
+    sgd_update/sgd_mom_update/mp_* in src/operator/optimizer_op.cc)."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
+                  clip_gradient=self.clip_gradient or -1.0)
+        if state is not None:
+            _invoke_nd("sgd_mom_update", [weight, grad, state],
+                       dict(kw, momentum=self.momentum))
+        else:
+            _invoke_nd("sgd_update", [weight, grad], kw)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and weight.dtype == np.float16:
+            inner, w32 = state
+            kw = dict(lr=self._get_lr(index), wd=self._get_wd(index),
+                      rescale_grad=self.rescale_grad,
+                      clip_gradient=self.clip_gradient or -1.0)
+            self._update_count(index)
+            kw["lr"] = self._get_lr(index)
+            if inner is not None:
+                _invoke_nd("mp_sgd_mom_update", [weight, grad, inner, w32],
+                           dict(kw, momentum=self.momentum))
+            else:
+                _invoke_nd("mp_sgd_update", [weight, grad, w32], kw)
+        else:
+            self.update(index, weight, grad, state)
+
+
+@register
+class LBSGD(SGD):
+    """Large-batch SGD w/ LARS-style scaling (reference :746)."""
+
+    def __init__(self, warmup_strategy="linear", warmup_epochs=5,
+                 batch_scale=1, updates_per_epoch=32, begin_epoch=0,
+                 num_epochs=60, **kwargs):
+        super().__init__(**kwargs)
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum != 0.0:
+            return zeros(weight.shape, dtype=weight.dtype)
+        return None
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = dict(lr=self._get_lr(index), wd=self._get_wd(index),
+                  rescale_grad=self.rescale_grad,
+                  clip_gradient=self.clip_gradient or -1.0)
+        if state is not None:
+            _invoke_nd("signum_update", [weight, grad, state],
+                       dict(kw, momentum=self.momentum, wd_lh=self.wd_lh))
+        else:
+            _invoke_nd("signsgd_update", [weight, grad], kw)
+
+
+SignSGD = Signum
+
+
+@register
+class FTML(Optimizer):
+    def __init__(self, beta1=0.6, beta2=0.999, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype),
+                zeros(weight.shape, dtype=weight.dtype),
+                zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        d, v, z = state
+        _invoke_nd("ftml_update", [weight, grad, d, v, z],
+                   dict(lr=self._get_lr(index), wd=self._get_wd(index),
+                        beta1=self.beta1, beta2=self.beta2,
+                        epsilon=self.epsilon, t=t,
+                        rescale_grad=self.rescale_grad,
+                        clip_grad=self.clip_gradient or -1.0))
+
+
+@register
+class DCASGD(Optimizer):
+    def __init__(self, momentum=0.0, lamda=0.04, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.weight_previous = {}
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        return ((None if self.momentum == 0.0 else
+                 zeros(weight.shape, dtype=weight.dtype)), weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        mom, prev = state
+        delta = self.lamda * g * g * (weight - prev)
+        if mom is not None:
+            mom._rebind((self.momentum * mom - lr * (g + wd * weight + delta))._data)
+            upd = mom
+            weight._rebind((weight + upd)._data)
+        else:
+            weight._rebind((weight - lr * (g + wd * weight + delta))._data)
+        prev._rebind(weight._data)
+
+
+@register
+class SGLD(Optimizer):
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        from .. import random as _rnd
+
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        noise = _rnd.normal(0, math.sqrt(lr), shape=weight.shape,
+                            dtype=weight.dtype)
+        weight._rebind((weight - lr / 2 * (g + wd * weight) + noise)._data)
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype),
+                zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index)
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr *= math.sqrt(coef2) / coef1
+        mean, var = state
+        _invoke_nd("adam_update", [weight, grad, mean, var],
+                   dict(lr=lr, wd=self._get_wd(index), beta1=self.beta1,
+                        beta2=self.beta2, epsilon=self.epsilon,
+                        rescale_grad=self.rescale_grad,
+                        clip_gradient=self.clip_gradient or -1.0))
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return zeros(weight.shape, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        g = g + wd * weight
+        state._rebind((state + g * g)._data)
+        weight._rebind((weight - lr * g / ((state ** 0.5)
+                                           + self.float_stable_eps))._data)
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1, self.gamma2 = gamma1, gamma2
+        self.centered = centered
+        self.epsilon = epsilon
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        if self.centered:
+            return (zeros(weight.shape, dtype=weight.dtype),
+                    zeros(weight.shape, dtype=weight.dtype),
+                    zeros(weight.shape, dtype=weight.dtype))
+        return (zeros(weight.shape, dtype=weight.dtype),)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = dict(lr=self._get_lr(index), wd=self._get_wd(index),
+                  gamma1=self.gamma1, epsilon=self.epsilon,
+                  rescale_grad=self.rescale_grad,
+                  clip_gradient=self.clip_gradient or -1.0,
+                  clip_weights=self.clip_weights or -1.0)
+        if not self.centered:
+            (n,) = state
+            _invoke_nd("rmsprop_update", [weight, grad, n], kw)
+        else:
+            n, g, delta = state
+            _invoke_nd("rmspropalex_update", [weight, grad, n, g, delta],
+                       dict(kw, gamma2=self.gamma2))
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.90, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho, self.epsilon = rho, epsilon
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype),
+                zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        acc_g, acc_delta = state
+        acc_g._rebind((self.rho * acc_g + (1 - self.rho) * g * g)._data)
+        delta = ((acc_delta + self.epsilon) ** 0.5) / \
+            ((acc_g + self.epsilon) ** 0.5) * g
+        acc_delta._rebind((self.rho * acc_delta
+                           + (1 - self.rho) * delta * delta)._data)
+        weight._rebind((weight - delta - wd * weight)._data)
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype),
+                zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        z, n = state
+        _invoke_nd("ftrl_update", [weight, grad, z, n],
+                   dict(lr=self._get_lr(index), wd=self._get_wd(index),
+                        lamda1=self.lamda1, beta=self.beta,
+                        rescale_grad=self.rescale_grad,
+                        clip_gradient=self.clip_gradient or -1.0))
+
+
+@register
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype),
+                zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index) / (1.0 - self.beta1 ** t)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        m, u = state
+        m._rebind((self.beta1 * m + (1.0 - self.beta1) * g)._data)
+        from .. import ndarray as _nd
+
+        u._rebind(_nd.broadcast_maximum(self.beta2 * u, g.abs())._data)
+        weight._rebind((weight - lr * m / u)._data)
+
+
+@register
+class Nadam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (zeros(weight.shape, dtype=weight.dtype),
+                zeros(weight.shape, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad + wd * weight
+        if self.clip_gradient:
+            g = g.clip(-self.clip_gradient, self.clip_gradient)
+        momentum_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        momentum_t_1 = self.beta1 * (1.0 - 0.5 * 0.96 ** ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * momentum_t
+        m_schedule_next = self.m_schedule * momentum_t_1
+        m, v = state
+        m._rebind((self.beta1 * m + (1.0 - self.beta1) * g)._data)
+        v._rebind((self.beta2 * v + (1.0 - self.beta2) * g * g)._data)
+        g_prime = g / (1.0 - self.m_schedule)
+        m_prime = m / (1.0 - m_schedule_next)
+        v_prime = v / (1.0 - self.beta2 ** t)
+        m_bar = (1.0 - momentum_t) * g_prime + momentum_t_1 * m_prime
+        weight._rebind((weight - lr * m_bar
+                        / ((v_prime ** 0.5) + self.epsilon))._data)
+
+
+@register
+class NAG(Optimizer):
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return zeros(weight.shape, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = dict(lr=self._get_lr(index), wd=self._get_wd(index),
+                  rescale_grad=self.rescale_grad,
+                  clip_gradient=self.clip_gradient or -1.0)
+        if state is not None:
+            _invoke_nd("nag_mom_update", [weight, grad, state],
+                       dict(kw, momentum=self.momentum))
+        else:
+            _invoke_nd("sgd_update", [weight, grad], kw)
+
+
+@register
+class Test(Optimizer):
+    def create_state(self, index, weight):
+        return zeros(weight.shape, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        weight._rebind((weight + grad * self.rescale_grad)._data)
+        state._rebind(weight._data)
+
+
+def create(name, **kwargs):
+    if isinstance(name, Optimizer):
+        return name
+    name = name.lower()
+    if name not in _OPT_REGISTRY:
+        raise MXNetError("optimizer %r not registered" % name)
+    return _OPT_REGISTRY[name](**kwargs)
+
+
+class Updater:
+    """Parity: optimizer.Updater (:1621) — kvstore-side update closure."""
+
+    def __init__(self, optimizer):
+        self.optimizer = optimizer
+        self.states = {}
+        self.states_synced = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = \
+                self.optimizer.create_state_multi_precision(index, weight)
+            self.states_synced[index] = True
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        def to_np(s):
+            if isinstance(s, NDArray):
+                return s.asnumpy()
+            if isinstance(s, (list, tuple)):
+                return tuple(to_np(x) for x in s)
+            return s
+
+        states = {k: to_np(v) for k, v in self.states.items()}
+        if dump_optimizer:
+            return pickle.dumps((states, self.optimizer))
+        return pickle.dumps(states)
+
+    def set_states(self, states):
+        states = pickle.loads(states)
+        if isinstance(states, tuple) and len(states) == 2 and \
+                isinstance(states[1], Optimizer):
+            states, self.optimizer = states
+
+        def to_nd(s):
+            if isinstance(s, np.ndarray):
+                return array(s)
+            if isinstance(s, tuple):
+                return tuple(to_nd(x) for x in s)
+            return s
+
+        self.states = {k: to_nd(v) for k, v in states.items()}
+        self.states_synced = dict.fromkeys(self.states, False)
+
+
+def get_updater(optimizer):
+    return Updater(optimizer)

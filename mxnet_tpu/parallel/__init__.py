@@ -1,0 +1,13 @@
+"""TPU-native parallelism (mesh/pjit/shard_map + ICI collectives).
+
+This package is the TPU-first replacement for the reference's entire
+distribution stack (SURVEY §2.3): kvstore allreduce -> sharding-induced
+psum; ps-lite multi-host -> jax.distributed; plus new capabilities the
+reference lacked (tensor parallelism, ring-attention sequence parallelism,
+microbatched pipeline parallelism).
+"""
+from .mesh import make_mesh, local_mesh, init_distributed, MeshConfig  # noqa: F401
+from .train import ShardedTrainer  # noqa: F401
+from .ring_attention import (ring_attention, ring_attention_sharded,  # noqa: F401
+                             local_attention)
+from .pipeline import pipeline_forward, gpipe_loss  # noqa: F401

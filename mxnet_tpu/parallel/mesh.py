@@ -1,0 +1,82 @@
+"""Device mesh construction + multi-host init.
+
+Reference counterpart: src/kvstore/ device topology handling
+(gpu_topology.h ComputeTreesFromRoot:1019 built reduction trees from
+PCIe/NVLink scans) and ps-lite's DMLC_* bootstrap.  TPU-native: the
+topology problem disappears — declare a jax.sharding.Mesh with named axes
+(dp/tp/pp/sp/ep) and XLA lays collectives on ICI; multi-host joins via
+jax.distributed.initialize from the same DMLC_*-style env the launcher
+sets."""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+__all__ = ["make_mesh", "init_distributed", "local_mesh", "MeshConfig"]
+
+
+class MeshConfig:
+    """Named axis sizes for a parallelism layout."""
+
+    def __init__(self, dp=1, tp=1, pp=1, sp=1, ep=1):
+        self.dp, self.tp, self.pp, self.sp, self.ep = dp, tp, pp, sp, ep
+
+    def axes(self):
+        return {k: v for k, v in
+                (("dp", self.dp), ("tp", self.tp), ("pp", self.pp),
+                 ("sp", self.sp), ("ep", self.ep)) if v > 1} or {"dp": 1}
+
+
+def init_distributed(coordinator=None, num_processes=None, process_id=None):
+    """Multi-host bootstrap (ps-lite scheduler parity). Reads the same
+    env contract tools/launch.py sets (DMLC_PS_ROOT_URI/DMLC_RANK/...)."""
+    import jax
+
+    coordinator = coordinator or os.environ.get("MXTPU_COORDINATOR") or (
+        "%s:%s" % (os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
+                   os.environ.get("MXTPU_COORD_PORT", "9191"))
+        if os.environ.get("DMLC_PS_ROOT_URI") else None)
+    if coordinator is None:
+        return False
+    num_processes = num_processes or int(os.environ.get(
+        "DMLC_NUM_WORKER", os.environ.get("MXTPU_NUM_PROCS", "1")))
+    process_id = process_id if process_id is not None else int(
+        os.environ.get("DMLC_RANK", os.environ.get("MXTPU_PROC_ID", "0")))
+    if num_processes <= 1:
+        return False
+    jax.distributed.initialize(coordinator_address=coordinator,
+                               num_processes=num_processes,
+                               process_id=process_id)
+    return True
+
+
+def make_mesh(axes=None, devices=None):
+    """Build a Mesh from named axis sizes, e.g. {'dp': 4, 'tp': 2}.
+
+    Axis order is fixed (dp, tp, pp, sp, ep) so dp neighbors sit farthest
+    apart and tp/sp ride the fastest ICI dimension — the standard layout
+    recipe (shard the heaviest-traffic axis innermost)."""
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if axes is None:
+        axes = {"dp": len(devices)}
+    order = [a for a in ("dp", "pp", "ep", "sp", "tp") if a in axes]
+    sizes = [axes[a] for a in order]
+    n = int(np.prod(sizes))
+    if n > len(devices):
+        raise ValueError("mesh needs %d devices, only %d available"
+                         % (n, len(devices)))
+    dev_array = np.asarray(devices[:n]).reshape(sizes)
+    return Mesh(dev_array, tuple(order))
+
+
+def local_mesh(dp=None):
+    """Mesh over all local devices with one 'dp' axis."""
+    import jax
+
+    devs = jax.devices()
+    return make_mesh({"dp": dp or len(devs)}, devs)

@@ -1,0 +1,86 @@
+"""Pipeline parallelism: microbatched stage execution over a 'pp' axis.
+
+Reference counterpart: manual inter-layer model parallelism via group2ctx
+contexts + _CrossDeviceCopy (graph_executor.cc:1325, example/model-parallel)
+— the reference has no microbatching.  TPU-native upgrade: GPipe-style
+schedule expressed with shard_map over the 'pp' mesh axis; activations hop
+stages via lax.ppermute (one ICI hop), microbatches fill the pipeline.
+"""
+from __future__ import annotations
+
+import functools
+
+__all__ = ["pipeline_forward", "gpipe_loss"]
+
+
+def pipeline_forward(stage_fn, x_microbatches, axis_name="pp"):
+    """Run a per-stage fn over a pipeline ring.
+
+    stage_fn(stage_idx, x) -> y   (same shape), applied on each device to
+    the microbatch currently resident; after each tick activations shift
+    to the next stage.  x_microbatches: (num_micro, mb, ...) — the LOCAL
+    shard on stage 0 carries real inputs; other stages ignore their input
+    (standard GPipe fill).  Returns the (num_micro, mb, ...) outputs as
+    produced by the LAST stage (valid after drain on stage n-1).
+
+    Must run inside shard_map with `axis_name` bound.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n_stage = lax.psum(1, axis_name)
+    stage = lax.axis_index(axis_name)
+    num_micro = x_microbatches.shape[0]
+    total_ticks = num_micro + n_stage - 1
+    perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+
+    mb_shape = x_microbatches.shape[1:]
+
+    def tick(carry, t):
+        state, outputs = carry
+        # stage 0 injects microbatch t (if any), others take the incoming
+        inject = jnp.where(t < num_micro,
+                           x_microbatches[jnp.minimum(t, num_micro - 1)],
+                           jnp.zeros(mb_shape, x_microbatches.dtype))
+        cur = jnp.where(stage == 0, inject, state)
+        out = stage_fn(stage, cur)
+        # last stage records its output at slot t - (n_stage - 1)
+        slot = t - (n_stage - 1)
+        record = (stage == n_stage - 1) & (slot >= 0)
+        outputs = lax.cond(
+            record,
+            lambda o: o.at[jnp.maximum(slot, 0)].set(out),
+            lambda o: o, outputs)
+        nxt = lax.ppermute(out, axis_name, perm)
+        return (nxt, outputs), None
+
+    outputs0 = jnp.zeros((num_micro,) + mb_shape, x_microbatches.dtype)
+    state0 = jnp.zeros(mb_shape, x_microbatches.dtype)
+    (state, outputs), _ = lax.scan(tick, (state0, outputs0),
+                                   jnp.arange(total_ticks))
+    return outputs
+
+
+def gpipe_loss(mesh, stage_fn, loss_fn, x, num_micro, axis_name="pp"):
+    """Convenience: split batch into microbatches, pipeline them, average
+    loss on the last stage, psum back to all stages."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    def inner(xb):
+        mbs = xb.reshape((num_micro, xb.shape[0] // num_micro)
+                         + xb.shape[1:])
+        outs = pipeline_forward(stage_fn, mbs, axis_name)
+        loss = loss_fn(outs.reshape(xb.shape[0], *outs.shape[2:]))
+        stage = lax.axis_index(axis_name)
+        n_stage = lax.psum(1, axis_name)
+        loss = jnp.where(stage == n_stage - 1, loss, 0.0)
+        return lax.psum(loss, axis_name)
+
+    fn = shard_map(inner, mesh=mesh, in_specs=P(),
+                   out_specs=P(), check_rep=False)
+    return fn(x)

@@ -1,0 +1,113 @@
+"""Ring attention: sequence/context parallelism over the mesh.
+
+The reference has NO sequence parallelism (SURVEY §2.3: long sequences
+handled only by bucketing + fused RNN kernels) — this is a new, TPU-first
+capability: shard the sequence axis across devices, rotate K/V blocks
+around the ring with lax.ppermute (one ICI hop per step), and keep a
+running max/denominator so softmax is computed exactly (online-softmax /
+flash-attention accumulation).  Memory per device is O(seq/devices), so
+context length scales linearly with the ring size.
+
+Usage: wrap `ring_attention(q, k, v, axis_name='sp')` inside a
+shard_map over a mesh with an 'sp' axis (see tests/test_parallel.py and
+__graft_entry__.dryrun_multichip).
+"""
+from __future__ import annotations
+
+import functools
+
+__all__ = ["ring_attention", "ring_attention_sharded", "local_attention"]
+
+
+def _block_attn(q, k, v, scale, causal_mask=None):
+    import jax.numpy as jnp
+
+    s = jnp.einsum("...qhd,...khd->...hqk", q, k) * scale
+    if causal_mask is not None:
+        s = jnp.where(causal_mask, s, -1e30)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("...hqk,...khd->...qhd", p, v)
+    return o, m, l
+
+
+def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None):
+    """Exact attention over a sequence sharded along `axis_name`.
+
+    q, k, v: (batch, seq_local, heads, dim) per-device blocks.
+    Must be called inside shard_map/pmap with `axis_name` bound.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n_dev = lax.psum(1, axis_name)
+    my_idx = lax.axis_index(axis_name)
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    seq_local = q.shape[1]
+
+    def make_mask(kv_idx):
+        if not causal:
+            return None
+        q_pos = my_idx * seq_local + jnp.arange(seq_local)
+        k_pos = kv_idx * seq_local + jnp.arange(seq_local)
+        # (1, h=1, q, k) broadcastable mask
+        return (q_pos[:, None] >= k_pos[None, :])[None, None, :, :]
+
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+    def body(carry, _):
+        o_acc, m_acc, l_acc, kv, kv_idx = carry
+        k_blk, v_blk = kv
+        o_blk, m_blk, l_blk = _block_attn(q, k_blk, v_blk, scale,
+                                          make_mask(kv_idx))
+        # online-softmax merge: rescale accumulators to the new max
+        m_new = jnp.maximum(m_acc, m_blk)
+        alpha = jnp.exp(m_acc - m_new)
+        beta = jnp.exp(m_blk - m_new)
+        # o_blk is unnormalized with max m_blk; o_acc with m_acc
+        l_new = l_acc * alpha + l_blk * beta
+        o_new = o_acc * jnp.moveaxis(alpha, -3, -2) + \
+            o_blk * jnp.moveaxis(beta, -3, -2)
+        kv_next = (lax.ppermute(k_blk, axis_name, perm),
+                   lax.ppermute(v_blk, axis_name, perm))
+        idx_next = (kv_idx - 1) % n_dev
+        return (o_new, m_new, l_new, kv_next, idx_next), None
+
+    neg_inf = jnp.full(q.shape[:1] + (q.shape[2], q.shape[1], 1), -1e30,
+                       q.dtype)  # (b, h, q, 1)
+    o0 = jnp.zeros_like(q)
+    l0 = jnp.zeros_like(neg_inf)
+    carry0 = (o0, neg_inf, l0, (k, v), my_idx)
+    (o, m, l, _kv, _idx), _ = jax.lax.scan(body, carry0, None, length=n_dev)
+    return o / jnp.moveaxis(l, -3, -2)
+
+
+def local_attention(q, k, v, causal=False, scale=None):
+    """Single-device reference attention (same layout) for testing."""
+    import jax.numpy as jnp
+
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    mask = None
+    if causal:
+        T = q.shape[1]
+        mask = (jnp.arange(T)[:, None] >=
+                jnp.arange(k.shape[1])[None, :])[None, None, :, :]
+    o, m, l = _block_attn(q, k, v, scale, mask)
+    return o / jnp.moveaxis(l, -3, -2)
+
+
+def ring_attention_sharded(mesh, q, k, v, axis_name="sp", causal=False):
+    """Convenience wrapper: shard_map ring_attention over `mesh` with the
+    sequence dim of q/k/v sharded along `axis_name`."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    spec = P(None, axis_name, None, None)
+    fn = shard_map(
+        functools.partial(ring_attention, axis_name=axis_name, causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_rep=False)
+    return fn(q, k, v)

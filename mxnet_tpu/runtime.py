@@ -1,0 +1,69 @@
+"""Runtime feature detection (reference parity: python/mxnet/runtime.py +
+src/libinfo.cc)."""
+from __future__ import annotations
+
+__all__ = ["Feature", "Features", "feature_list"]
+
+
+class Feature:
+    def __init__(self, name, enabled):
+        self.name = name
+        self.enabled = enabled
+
+    def __repr__(self):
+        return "%s %s" % ("✔" if self.enabled else "✖", self.name)
+
+
+def _detect():
+    import jax
+
+    feats = {
+        "CPU": True,
+        "TPU": any(d.platform == "tpu" for d in jax.devices()),
+        "CUDA": False,
+        "CUDNN": False,
+        "NCCL": False,
+        "TENSORRT": False,
+        "MKLDNN": False,
+        "XLA": True,
+        "PALLAS": True,
+        "BLAS_OPEN": True,
+        "LAPACK": True,
+        "OPENCV": _has("cv2"),
+        "DIST_KVSTORE": True,
+        "INT64_TENSOR_SIZE": True,
+        "SIGNAL_HANDLER": True,
+        "PROFILER": True,
+        "F16C": True,
+        "BF16": True,
+        "OPENMP": False,
+        "SSE": False,
+        "JEMALLOC": False,
+    }
+    return feats
+
+
+def _has(mod):
+    try:
+        __import__(mod)
+        return True
+    except ImportError:
+        return False
+
+
+class Features(dict):
+    def __init__(self):
+        super().__init__([(k, Feature(k, v)) for k, v in _detect().items()])
+
+    def __repr__(self):
+        return str(list(self.values()))
+
+    def is_enabled(self, feature_name):
+        feature_name = feature_name.upper()
+        if feature_name not in self:
+            raise RuntimeError("Feature '%s' is unknown" % feature_name)
+        return self[feature_name].enabled
+
+
+def feature_list():
+    return list(Features().values())

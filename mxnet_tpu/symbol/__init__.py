@@ -1,0 +1,6 @@
+"""mx.sym namespace (reference parity: python/mxnet/symbol/__init__.py)."""
+from .symbol import (Symbol, var, Variable, Group, load, load_json,  # noqa: F401
+                     zeros, ones, _invoke_sym)
+from . import register as _register
+
+_register.populate(globals())

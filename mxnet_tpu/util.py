@@ -1,0 +1,61 @@
+"""Misc utilities (reference parity: python/mxnet/util.py)."""
+from __future__ import annotations
+
+import functools
+import inspect
+
+__all__ = ["makedirs", "get_gpu_count", "get_gpu_memory", "use_np_shape",
+           "is_np_shape", "set_np_shape", "wraps_safely"]
+
+import os
+
+
+def makedirs(d):
+    os.makedirs(os.path.expanduser(d), exist_ok=True)
+
+
+def get_gpu_count():
+    from .context import num_gpus
+
+    return num_gpus()
+
+
+def get_gpu_memory(gpu_dev_id=0):
+    import jax
+
+    try:
+        d = jax.devices()[gpu_dev_id]
+        stats = d.memory_stats()
+        return (stats.get("bytes_in_use", 0), stats.get("bytes_limit", 0))
+    except Exception:
+        return (0, 0)
+
+
+_np_shape = False
+
+
+def set_np_shape(active):
+    global _np_shape
+    prev = _np_shape
+    _np_shape = bool(active)
+    return prev
+
+
+def is_np_shape():
+    return _np_shape
+
+
+def use_np_shape(func):
+    @functools.wraps(func)
+    def _with_np_shape(*args, **kwargs):
+        prev = set_np_shape(True)
+        try:
+            return func(*args, **kwargs)
+        finally:
+            set_np_shape(prev)
+
+    return _with_np_shape
+
+
+def wraps_safely(wrapped, assigned=functools.WRAPPER_ASSIGNMENTS):
+    return functools.wraps(wrapped, assigned=assigned)

@@ -1,0 +1,28 @@
+"""Test config: run on a virtual 8-device CPU mesh (SURVEY §4 — the
+reference's distributed tests fork local processes; here a forced host
+device count exercises the same sharding paths without TPU hardware)."""
+import os
+
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " "
+                               "--xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+import jax  # noqa: E402
+
+# env alone can be pre-empted by an externally registered accelerator
+# plugin; the config flag always wins
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    np.random.seed(0)
+    import mxnet_tpu as mx
+
+    mx.random.seed(0)
+    yield

@@ -1,0 +1,143 @@
+"""Autograd tests (modeled on tests/python/unittest/test_autograd.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_simple_grad():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * 2
+        z = y.sum()
+    z.backward()
+    assert_almost_equal(x.grad, 4 * x.asnumpy())
+
+
+def test_chain_grad():
+    x = nd.array(np.random.rand(3, 4))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(nd.sin(x)).sum()
+    y.backward()
+    assert_almost_equal(x.grad, np.exp(np.sin(x.asnumpy())) *
+                        np.cos(x.asnumpy()), rtol=1e-4, atol=1e-5)
+
+
+def test_multi_var():
+    a = nd.array([2.0])
+    b = nd.array([3.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = a * b + a
+    c.backward()
+    assert_almost_equal(a.grad, [4.0])
+    assert_almost_equal(b.grad, [2.0])
+
+
+def test_head_grad():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 3
+    y.backward(nd.array([10.0, 100.0]))
+    assert_almost_equal(x.grad, [30.0, 300.0])
+
+
+def test_pause_and_modes():
+    assert not autograd.is_recording()
+    assert not autograd.is_training()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+        with autograd.predict_mode():
+            assert not autograd.is_training()
+    with autograd.train_mode():
+        assert autograd.is_training()
+
+
+def test_detach():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x  # grad should flow only via the explicit x
+    z.backward()
+    assert_almost_equal(x.grad, [4.0])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = 2 * x
+        y.backward()
+    assert_almost_equal(x.grad, [6.0])
+
+
+def test_grad_function():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.sum(x * x)
+    (g,) = autograd.grad(y, [x], retain_graph=True)
+    assert_almost_equal(g, 2 * x.asnumpy())
+
+
+def test_custom_function():
+    class Sigmoid(autograd.Function):
+        def forward(self, x):
+            y = 1 / (1 + nd.exp(-x))
+            self.save_for_backward(y)
+            return y
+
+        def backward(self, dy):
+            (y,) = self.saved_tensors
+            return dy * y * (1 - y)
+
+    x = nd.array(np.random.uniform(-1, 1, 10))
+    x.attach_grad()
+    f = Sigmoid()
+    with autograd.record():
+        y = f(x)
+    y.backward()
+    sig = 1 / (1 + np.exp(-x.asnumpy()))
+    assert_almost_equal(x.grad, sig * (1 - sig), rtol=1e-4, atol=1e-5)
+
+
+def test_binary_op_grads():
+    x = nd.array(np.random.rand(3, 3) + 0.5)
+    y = nd.array(np.random.rand(3, 3) + 0.5)
+    x.attach_grad()
+    y.attach_grad()
+    with autograd.record():
+        z = nd.sum(x / y)
+    z.backward()
+    assert_almost_equal(x.grad, 1 / y.asnumpy(), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(y.grad, -x.asnumpy() / y.asnumpy() ** 2,
+                        rtol=1e-4, atol=1e-5)
+
+
+def test_broadcast_grad():
+    x = nd.array(np.random.rand(3, 4))
+    b = nd.array(np.random.rand(1, 4))
+    b.attach_grad()
+    with autograd.record():
+        z = nd.sum(nd.broadcast_add(x, b))
+    z.backward()
+    assert_almost_equal(b.grad, 3 * np.ones((1, 4)))
+
+
+def test_get_symbol():
+    x = nd.array([1.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.exp(x) * 2
+    sym = autograd.get_symbol(y)
+    assert sym is not None

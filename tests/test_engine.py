@@ -1,0 +1,50 @@
+"""Engine facade tests (modeled on tests/python/unittest/test_engine.py +
+test_exc_handling.py)."""
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, engine
+
+
+def test_bulk_scope():
+    assert engine.get().bulk_size == 0
+    with engine.bulk(16):
+        assert engine.get().bulk_size == 16
+        x = nd.ones((10,))
+        for _ in range(5):
+            x = x + 1
+    assert engine.get().bulk_size == 0
+    assert (x.asnumpy() == 6).all()
+
+
+def test_naive_engine_mode():
+    eng = engine.get()
+    old = eng._engine_type
+    eng.set_engine_type("NaiveEngine")
+    try:
+        assert eng.is_naive
+        y = nd.ones((4,)) * 3
+        assert (y.asnumpy() == 3).all()
+    finally:
+        eng.set_engine_type(old)
+
+
+def test_deferred_exception_rethrow():
+    eng = engine.get()
+    eng.record_exception(ValueError("async boom"))
+    with pytest.raises(ValueError, match="async boom"):
+        nd.waitall()
+    # state cleared after rethrow
+    nd.waitall()
+
+
+def test_exc_in_op_is_mxnet_error():
+    with pytest.raises(mx.MXNetError):
+        nd.Reshape(nd.ones((4,)), shape=(3,))  # size mismatch
+
+
+def test_wait_for_var():
+    x = nd.ones((1000, 1000))
+    y = nd.dot(x, x)
+    y.wait_to_read()
+    assert y.shape == (1000, 1000)

@@ -1,0 +1,211 @@
+"""Gluon tests (modeled on tests/python/unittest/test_gluon.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_parameter():
+    p = gluon.Parameter("weight", shape=(5, 5))
+    p.initialize(init=mx.init.One())
+    assert (p.data().asnumpy() == 1).all()
+    assert p.grad().shape == (5, 5)
+    p.set_data(nd.zeros((5, 5)))
+    assert (p.data().asnumpy() == 0).all()
+
+
+def test_deferred_init():
+    dense = nn.Dense(4)
+    dense.initialize()
+    with pytest.raises(mx.MXNetError):
+        dense.weight.data()
+    out = dense(nd.ones((2, 7)))
+    assert dense.weight.shape == (4, 7)
+    assert out.shape == (2, 4)
+
+
+def test_dense_forward():
+    layer = nn.Dense(3, in_units=4, use_bias=True)
+    layer.initialize()
+    x = nd.array(np.random.rand(2, 4))
+    out = layer(x)
+    w = layer.weight.data().asnumpy()
+    b = layer.bias.data().asnumpy()
+    assert_almost_equal(out, x.asnumpy() @ w.T + b, rtol=1e-5, atol=1e-5)
+
+
+def test_sequential():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(4))
+    net.initialize()
+    out = net(nd.ones((3, 10)))
+    assert out.shape == (3, 4)
+    assert len(net) == 2
+    assert isinstance(net[0], nn.Dense)
+
+
+def test_conv_block():
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, activation="relu"),
+            nn.MaxPool2D(2),
+            nn.Conv2D(4, 3, padding=1),
+            nn.GlobalAvgPool2D(),
+            nn.Flatten(),
+            nn.Dense(2))
+    net.initialize()
+    out = net(nd.ones((2, 3, 8, 8)))
+    assert out.shape == (2, 2)
+
+
+def test_batchnorm_layer():
+    layer = nn.BatchNorm()
+    layer.initialize()
+    x = nd.array(np.random.rand(4, 3, 2, 2))
+    with autograd.record():
+        out = layer(x)
+    assert out.shape == x.shape
+    # moving stats updated in train mode
+    mm = layer.running_mean.data().asnumpy()
+    assert not (mm == 0).all()
+    # eval mode uses running stats
+    out_eval = layer(x)
+    assert out_eval.shape == x.shape
+
+
+def test_hybridize_consistency():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net.initialize()
+    x = nd.array(np.random.rand(4, 6))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    compiled = net(x).asnumpy()
+    assert_almost_equal(eager, compiled, rtol=1e-5, atol=1e-6)
+    # second call uses the cache
+    compiled2 = net(x).asnumpy()
+    assert_almost_equal(compiled, compiled2)
+
+
+def test_hybridize_training():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(1))
+    net.initialize()
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.5})
+    x = nd.array(np.random.rand(32, 8))
+    y = nd.array(np.random.rand(32, 1))
+    loss_fn = gluon.loss.L2Loss()
+    losses = []
+    for _ in range(25):
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(32)
+        losses.append(loss.mean().asscalar())
+    assert losses[-1] < losses[0] * 0.5
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4), nn.Dense(2))
+    net.initialize()
+    x = nd.ones((1, 3))
+    ref = net(x).asnumpy()
+    fname = str(tmp_path / "net.params")
+    net.save_parameters(fname)
+
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(4), nn.Dense(2))
+    net2.load_parameters(fname)
+    assert_almost_equal(net2(x), ref)
+
+
+def test_export_symbolblock(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, activation="relu"), nn.Dense(2))
+    net.initialize()
+    x = nd.ones((1, 3))
+    ref = net(x).asnumpy()
+    path = str(tmp_path / "exported")
+    net.hybridize()
+    net(x)
+    net.export(path)
+    net2 = gluon.SymbolBlock.imports(path + "-symbol.json", ["data"],
+                                     path + "-0000.params")
+    out = net2(x).asnumpy()
+    assert_almost_equal(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_trainer_multi_step():
+    net = nn.Dense(1, in_units=3)
+    net.initialize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    x = nd.ones((4, 3))
+    with autograd.record():
+        loss = nd.sum(net(x))
+    loss.backward()
+    w_before = net.weight.data().asnumpy().copy()
+    trainer.step(4)
+    assert not np.allclose(w_before, net.weight.data().asnumpy())
+
+
+def test_losses():
+    pred = nd.array(np.random.rand(4, 5))
+    label = nd.array(np.random.randint(0, 5, 4).astype(np.float32))
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label)
+    assert l.shape == (4,)
+    logp = np.log(np.exp(pred.asnumpy()) /
+                  np.exp(pred.asnumpy()).sum(-1, keepdims=True))
+    ref = -logp[np.arange(4), label.asnumpy().astype(int)]
+    assert_almost_equal(l, ref, rtol=1e-4, atol=1e-5)
+
+    l2 = gluon.loss.L2Loss()(nd.array([2.0]), nd.array([1.0]))
+    assert_almost_equal(l2, [0.5])
+    l1 = gluon.loss.L1Loss()(nd.array([2.0]), nd.array([0.5]))
+    assert_almost_equal(l1, [1.5])
+    h = gluon.loss.HuberLoss()(nd.array([3.0]), nd.array([0.0]))
+    assert_almost_equal(h, [2.5])
+
+
+def test_block_repr_and_collect():
+    net = nn.HybridSequential(prefix="model_")
+    with net.name_scope():
+        net.add(nn.Dense(2))
+    params = net.collect_params()
+    assert all(k.startswith("model_") for k in params.keys())
+    assert "Dense" in repr(net)
+
+
+def test_embedding_layer():
+    emb = nn.Embedding(10, 4)
+    emb.initialize()
+    out = emb(nd.array([0, 5]))
+    assert out.shape == (2, 4)
+
+
+def test_dropout_layer():
+    d = nn.Dropout(0.5)
+    d.initialize()
+    x = nd.ones((100, 100))
+    out = d(x)  # inference: identity
+    assert_almost_equal(out, x.asnumpy())
+
+
+def test_clip_global_norm():
+    arrays = [nd.ones((2, 2)) * 3, nd.ones((2,)) * 4]
+    total = gluon.utils.clip_global_norm(arrays, 1.0)
+    assert total > 1.0
+    new_total = float(np.sqrt(sum((a.asnumpy() ** 2).sum()
+                                  for a in arrays)))
+    assert abs(new_total - 1.0) < 1e-4
+
+
+def test_split_and_load():
+    data = nd.array(np.random.rand(8, 3))
+    parts = gluon.utils.split_and_load(data, [mx.cpu(0)])
+    assert len(parts) == 1

@@ -1,0 +1,133 @@
+"""RNN layer/cell tests (modeled on tests/python/unittest/test_gluon_rnn.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.gluon import rnn
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+@pytest.mark.parametrize("mode,cls", [("lstm", rnn.LSTM), ("gru", rnn.GRU),
+                                      ("rnn", rnn.RNN)])
+def test_rnn_layer_shapes(mode, cls):
+    layer = cls(hidden_size=16, num_layers=2)
+    layer.initialize()
+    x = nd.array(np.random.rand(5, 3, 8))  # (T, N, C)
+    out = layer(x)
+    assert out.shape == (5, 3, 16)
+    states = layer.begin_state(batch_size=3)
+    out, new_states = layer(x, states)
+    assert out.shape == (5, 3, 16)
+    assert new_states[0].shape == (2, 3, 16)
+
+
+def test_bidirectional_layer():
+    layer = rnn.LSTM(hidden_size=8, num_layers=1, bidirectional=True)
+    layer.initialize()
+    x = nd.array(np.random.rand(4, 2, 6))
+    out = layer(x)
+    assert out.shape == (4, 2, 16)
+
+
+def test_ntc_layout():
+    layer = rnn.GRU(hidden_size=8, layout="NTC")
+    layer.initialize()
+    x = nd.array(np.random.rand(2, 5, 6))
+    out = layer(x)
+    assert out.shape == (2, 5, 8)
+
+
+def test_lstm_cell_unroll_matches_fused():
+    """Fused lax.scan LSTM vs explicit cell unroll with shared params."""
+    H, T, N, C = 8, 4, 2, 6
+    fused = rnn.LSTM(hidden_size=H, num_layers=1, input_size=C)
+    fused.initialize()
+    x = nd.array(np.random.rand(T, N, C).astype(np.float32))
+    out_fused = fused(x).asnumpy()
+
+    cell = rnn.LSTMCell(H, input_size=C)
+    cell.initialize()
+    # copy fused params into the cell
+    cell.i2h_weight.set_data(fused.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(fused.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(fused.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(fused.l0_h2h_bias.data())
+    states = [nd.zeros((N, H)), nd.zeros((N, H))]
+    outs = []
+    for t in range(T):
+        o, states = cell(x[t], states)
+        outs.append(o.asnumpy())
+    assert_almost_equal(np.stack(outs), out_fused, rtol=1e-4, atol=1e-5)
+
+
+def test_gru_cell_unroll_matches_fused():
+    H, T, N, C = 5, 3, 2, 4
+    fused = rnn.GRU(hidden_size=H, num_layers=1, input_size=C)
+    fused.initialize()
+    x = nd.array(np.random.rand(T, N, C).astype(np.float32))
+    out_fused = fused(x).asnumpy()
+    cell = rnn.GRUCell(H, input_size=C)
+    cell.initialize()
+    cell.i2h_weight.set_data(fused.l0_i2h_weight.data())
+    cell.h2h_weight.set_data(fused.l0_h2h_weight.data())
+    cell.i2h_bias.set_data(fused.l0_i2h_bias.data())
+    cell.h2h_bias.set_data(fused.l0_h2h_bias.data())
+    states = [nd.zeros((N, H))]
+    outs = []
+    for t in range(T):
+        o, states = cell(x[t], states)
+        outs.append(o.asnumpy())
+    assert_almost_equal(np.stack(outs), out_fused, rtol=1e-4, atol=1e-5)
+
+
+def test_cell_unroll_api():
+    cell = rnn.LSTMCell(4, input_size=3)
+    cell.initialize()
+    x = nd.array(np.random.rand(2, 5, 3))  # NTC
+    outputs, states = cell.unroll(5, x, layout="NTC", merge_outputs=True)
+    assert outputs.shape == (2, 5, 4)
+    assert len(states) == 2
+
+
+def test_sequential_rnn_cell():
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(4, input_size=3))
+    stack.add(rnn.LSTMCell(4, input_size=4))
+    stack.initialize()
+    states = stack.begin_state(batch_size=2)
+    out, new_states = stack(nd.ones((2, 3)), states)
+    assert out.shape == (2, 4)
+    assert len(new_states) == 4
+
+
+def test_rnn_training():
+    layer = rnn.LSTM(hidden_size=8, num_layers=1)
+    layer.initialize()
+    trainer = gluon.Trainer(layer.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    x = nd.array(np.random.rand(6, 4, 5).astype(np.float32))
+    y = nd.array(np.random.rand(6, 4, 8).astype(np.float32))
+    loss_fn = gluon.loss.L2Loss()
+    losses = []
+    for _ in range(15):
+        with autograd.record():
+            out = layer(x)
+            loss = loss_fn(out, y)
+        loss.backward()
+        trainer.step(4)
+        losses.append(loss.mean().asscalar())
+    assert losses[-1] < losses[0]
+
+
+def test_residual_and_dropout_cells():
+    base = rnn.GRUCell(6, input_size=6)
+    res = rnn.ResidualCell(base)
+    res.initialize()
+    states = res.begin_state(batch_size=2)
+    out, _ = res(nd.ones((2, 6)), states)
+    assert out.shape == (2, 6)
+
+    dc = rnn.DropoutCell(0.5)
+    out2, _ = dc(nd.ones((2, 6)), [])
+    assert out2.shape == (2, 6)

@@ -1,0 +1,153 @@
+"""IO tests (modeled on tests/python/unittest/test_io.py + test_recordio)."""
+import os
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, recordio
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_ndarray_iter():
+    data = np.random.rand(100, 3)
+    labels = np.arange(100, dtype=np.float32)
+    it = mx.io.NDArrayIter(data, labels, batch_size=10)
+    batches = list(it)
+    assert len(batches) == 10
+    assert batches[0].data[0].shape == (10, 3)
+    assert batches[0].label[0].shape == (10,)
+    assert_almost_equal(batches[0].data[0], data[:10])
+    it.reset()
+    assert len(list(it)) == 10
+
+
+def test_ndarray_iter_pad():
+    data = np.random.rand(25, 2)
+    it = mx.io.NDArrayIter(data, None, batch_size=10, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[-1].pad == 5
+    it2 = mx.io.NDArrayIter(data, None, batch_size=10,
+                            last_batch_handle="discard")
+    assert len(list(it2)) == 2
+
+
+def test_ndarray_iter_shuffle():
+    data = np.arange(100).reshape(100, 1).astype(np.float32)
+    it = mx.io.NDArrayIter(data, None, batch_size=100, shuffle=True)
+    batch = next(iter(it))
+    vals = batch.data[0].asnumpy().ravel()
+    assert not (vals == np.arange(100)).all()
+    assert sorted(vals.tolist()) == list(range(100))
+
+
+def test_provide_data_label():
+    it = mx.io.NDArrayIter(np.zeros((10, 4)), np.zeros(10), batch_size=5)
+    assert it.provide_data[0].name == "data"
+    assert it.provide_data[0].shape == (5, 4)
+    assert it.provide_label[0].name == "softmax_label"
+
+
+def test_recordio_roundtrip(tmp_path):
+    fname = str(tmp_path / "test.rec")
+    writer = recordio.MXRecordIO(fname, "w")
+    for i in range(5):
+        writer.write(b"record-%d" % i)
+    writer.close()
+    reader = recordio.MXRecordIO(fname, "r")
+    for i in range(5):
+        assert reader.read() == b"record-%d" % i
+    assert reader.read() is None
+    reader.close()
+
+
+def test_indexed_recordio(tmp_path):
+    fname = str(tmp_path / "test.rec")
+    idxname = str(tmp_path / "test.idx")
+    writer = recordio.MXIndexedRecordIO(idxname, fname, "w")
+    for i in range(10):
+        writer.write_idx(i, b"data-%d" % i)
+    writer.close()
+    reader = recordio.MXIndexedRecordIO(idxname, fname, "r")
+    assert reader.read_idx(7) == b"data-7"
+    assert reader.read_idx(2) == b"data-2"
+    assert len(reader.keys) == 10
+
+
+def test_irheader_pack_unpack():
+    header = recordio.IRHeader(0, 2.5, 7, 0)
+    packed = recordio.pack(header, b"payload")
+    h2, content = recordio.unpack(packed)
+    assert content == b"payload"
+    assert h2.label == 2.5
+    assert h2.id == 7
+    # multi-label
+    header = recordio.IRHeader(0, np.array([1.0, 2.0, 3.0]), 1, 0)
+    packed = recordio.pack(header, b"x")
+    h3, content = recordio.unpack(packed)
+    assert_almost_equal(h3.label, [1.0, 2.0, 3.0])
+
+
+def test_pack_img_roundtrip(tmp_path):
+    img = (np.random.rand(8, 8, 3) * 255).astype(np.uint8)
+    packed = recordio.pack_img(recordio.IRHeader(0, 1.0, 0, 0), img,
+                               quality=100, img_fmt=".png")
+    header, decoded = recordio.unpack_img(packed)
+    assert decoded.shape == (8, 8, 3)
+    assert header.label == 1.0
+    assert np.abs(decoded.astype(int) - img.astype(int)).max() <= 2
+
+
+def test_image_record_dataset(tmp_path):
+    from mxnet_tpu.gluon.data.vision import ImageRecordDataset
+
+    fname = str(tmp_path / "imgs.rec")
+    idxname = str(tmp_path / "imgs.idx")
+    writer = recordio.MXIndexedRecordIO(idxname, fname, "w")
+    for i in range(4):
+        img = (np.random.rand(4, 4, 3) * 255).astype(np.uint8)
+        writer.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, img_fmt=".png"))
+    writer.close()
+    ds = ImageRecordDataset(fname)
+    assert len(ds) == 4
+    img, label = ds[2]
+    assert img.shape == (4, 4, 3)
+    assert label == 2.0
+
+
+def test_csv_iter(tmp_path):
+    fname = str(tmp_path / "data.csv")
+    data = np.random.rand(20, 4)
+    np.savetxt(fname, data, delimiter=",")
+    lname = str(tmp_path / "label.csv")
+    np.savetxt(lname, np.arange(20), delimiter=",")
+    it = mx.io.CSVIter(data_csv=fname, data_shape=(4,), label_csv=lname,
+                       batch_size=5)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (5, 4)
+    assert_almost_equal(batch.data[0], data[:5], rtol=1e-5, atol=1e-6)
+
+
+def test_dataloader():
+    from mxnet_tpu.gluon.data import DataLoader, ArrayDataset
+
+    X = np.random.rand(30, 3).astype(np.float32)
+    y = np.arange(30).astype(np.float32)
+    ds = ArrayDataset(X, y)
+    loader = DataLoader(ds, batch_size=10)
+    batches = list(loader)
+    assert len(batches) == 3
+    xb, yb = batches[0]
+    assert xb.shape == (10, 3)
+    # multi-worker path
+    loader2 = DataLoader(ds, batch_size=10, num_workers=2)
+    batches2 = list(loader2)
+    assert len(batches2) == 3
+
+
+def test_prefetching_iter():
+    it = mx.io.NDArrayIter(np.random.rand(40, 2), np.zeros(40), batch_size=10)
+    pf = mx.io.PrefetchingIter(it)
+    count = sum(1 for _ in pf)
+    assert count == 4

@@ -1,0 +1,79 @@
+"""Metric tests (modeled on tests/python/unittest/test_metric.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_accuracy():
+    m = mx.metric.Accuracy()
+    pred = nd.array([[0.3, 0.7], [0.9, 0.1], [0.4, 0.6]])
+    label = nd.array([1, 0, 0])
+    m.update([label], [pred])
+    name, acc = m.get()
+    assert name == "accuracy"
+    assert abs(acc - 2.0 / 3) < 1e-6
+
+
+def test_topk():
+    m = mx.metric.TopKAccuracy(top_k=2)
+    pred = nd.array([[0.1, 0.2, 0.7], [0.5, 0.4, 0.1]])
+    label = nd.array([1, 2])
+    m.update([label], [pred])
+    _, acc = m.get()
+    assert abs(acc - 0.5) < 1e-6
+
+
+def test_mse_mae_rmse():
+    pred = nd.array([1.0, 2.0, 3.0])
+    label = nd.array([1.5, 2.0, 2.0])
+    m = mx.metric.MSE()
+    m.update([label], [pred])
+    assert abs(m.get()[1] - np.mean([0.25, 0, 1])) < 1e-6
+    m = mx.metric.MAE()
+    m.update([label], [pred])
+    assert abs(m.get()[1] - np.mean([0.5, 0, 1])) < 1e-6
+    m = mx.metric.RMSE()
+    m.update([label], [pred])
+    assert abs(m.get()[1] - np.sqrt(np.mean([0.25, 0, 1]))) < 1e-6
+
+
+def test_cross_entropy_and_perplexity():
+    pred = nd.array([[0.25, 0.75], [0.5, 0.5]])
+    label = nd.array([1, 0])
+    ce = mx.metric.CrossEntropy()
+    ce.update([label], [pred])
+    expect = -(np.log(0.75) + np.log(0.5)) / 2
+    assert abs(ce.get()[1] - expect) < 1e-6
+    p = mx.metric.Perplexity(ignore_label=None)
+    p.update([label], [pred])
+    assert abs(p.get()[1] - np.exp(expect)) < 1e-5
+
+
+def test_f1():
+    m = mx.metric.F1()
+    pred = nd.array([[0.3, 0.7], [0.8, 0.2], [0.4, 0.6]])
+    label = nd.array([1, 0, 1])
+    m.update([label], [pred])
+    assert m.get()[1] == 1.0
+
+
+def test_composite_and_create():
+    m = mx.metric.create(["acc", "mse"])
+    pred = nd.array([[0.3, 0.7]])
+    label = nd.array([1])
+    m.get_metric(0).update([label], [pred])
+    names, values = m.get()
+    assert "accuracy" in names
+
+
+def test_custom_metric():
+    m = mx.metric.np(lambda label, pred: np.abs(label - pred).sum())
+    m.update([nd.array([1.0])], [nd.array([3.0])])
+    assert abs(m.get()[1] - 2.0) < 1e-6
+
+
+def test_loss_metric():
+    m = mx.metric.Loss()
+    m.update(None, [nd.array([1.0, 2.0, 3.0])])
+    assert abs(m.get()[1] - 2.0) < 1e-6

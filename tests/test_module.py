@@ -1,0 +1,125 @@
+"""Module API tests (modeled on tests/python/unittest/test_module.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _toy_data(n=800, d=32, k=5, seed=0):
+    rng = np.random.RandomState(seed)
+    centers = rng.randn(k, d).astype(np.float32) * 3
+    labels = rng.randint(0, k, n)
+    X = centers[labels] + rng.randn(n, d).astype(np.float32)
+    return X, labels.astype(np.float32)
+
+
+def _mlp_sym(k=5):
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=32, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=k, name="fc2")
+    return mx.sym.SoftmaxOutput(net, mx.sym.var("softmax_label"),
+                                name="softmax")
+
+
+def test_module_fit_and_score():
+    X, y = _toy_data()
+    train = mx.io.NDArrayIter(X[:600], y[:600], batch_size=50, shuffle=True)
+    val = mx.io.NDArrayIter(X[600:], y[600:], batch_size=50)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(train, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.1}, num_epoch=3)
+    score = mod.score(val, "acc")
+    assert score[0][1] > 0.9
+
+
+def test_module_forward_backward():
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (10, 32))],
+             label_shapes=[("softmax_label", (10,))])
+    mod.init_params()
+    mod.init_optimizer()
+    batch = mx.io.DataBatch([mx.nd.ones((10, 32))],
+                            [mx.nd.zeros((10,))])
+    mod.forward(batch, is_train=True)
+    mod.backward()
+    out = mod.get_outputs()[0]
+    assert out.shape == (10, 5)
+    mod.update()
+
+
+def test_module_predict():
+    X, y = _toy_data(n=100)
+    it = mx.io.NDArrayIter(X, y, batch_size=10)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=False)
+    mod.init_params()
+    out = mod.predict(it)
+    assert out.shape == (100, 5)
+
+
+def test_module_checkpoint(tmp_path):
+    X, y = _toy_data(n=200)
+    it = mx.io.NDArrayIter(X, y, batch_size=20)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.fit(it, optimizer="sgd", num_epoch=1)
+    prefix = str(tmp_path / "ckpt")
+    mod.save_checkpoint(prefix, 1, save_optimizer_states=True)
+    mod2 = mx.mod.Module.load(prefix, 1)
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+              for_training=False)
+    it.reset()
+    p1 = mod.predict(it).asnumpy()
+    it.reset()
+    p2 = mod2.predict(it).asnumpy()
+    assert_almost_equal(p1, p2, rtol=1e-5, atol=1e-6)
+
+
+def test_module_get_set_params():
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 32))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    arg, aux = mod.get_params()
+    assert "fc1_weight" in arg
+    arg["fc1_weight"][:] = 0.5
+    mod.set_params(arg, aux)
+    arg2, _ = mod.get_params()
+    assert (arg2["fc1_weight"].asnumpy() == 0.5).all()
+
+
+def test_bucketing_module():
+    def sym_gen(seq_len):
+        data = mx.sym.var("data")
+        net = mx.sym.FullyConnected(data, num_hidden=8, name="fc")
+        net = mx.sym.SoftmaxOutput(net, mx.sym.var("softmax_label"),
+                                   name="softmax")
+        return net, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=10,
+                                 context=mx.cpu())
+    mod.bind(data_shapes=[("data", (4, 10))],
+             label_shapes=[("softmax_label", (4,))])
+    mod.init_params()
+    mod.init_optimizer()
+    b1 = mx.io.DataBatch([mx.nd.ones((4, 10))], [mx.nd.zeros((4,))],
+                         bucket_key=10,
+                         provide_data=[mx.io.DataDesc("data", (4, 10))],
+                         provide_label=[mx.io.DataDesc("softmax_label",
+                                                       (4,))])
+    mod.forward(b1, is_train=True)
+    mod.backward()
+    mod.update()
+    assert mod.get_outputs()[0].shape == (4, 8)
+
+
+def test_module_reshape():
+    mod = mx.mod.Module(_mlp_sym(), context=mx.cpu())
+    mod.bind(data_shapes=[("data", (10, 32))],
+             label_shapes=[("softmax_label", (10,))])
+    mod.init_params()
+    mod.init_optimizer()
+    batch = mx.io.DataBatch([mx.nd.ones((5, 32))], [mx.nd.zeros((5,))])
+    mod.forward(batch, is_train=True)
+    assert mod.get_outputs()[0].shape == (5, 5)

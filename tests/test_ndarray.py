@@ -1,0 +1,220 @@
+"""NDArray tests (modeled on tests/python/unittest/test_ndarray.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_creation():
+    a = nd.array([[1, 2], [3, 4]])
+    assert a.shape == (2, 2)
+    assert a.dtype == np.float32
+    assert a.size == 4
+    assert a.ndim == 2
+    b = nd.zeros((3, 4))
+    assert (b.asnumpy() == 0).all()
+    c = nd.ones((2, 3), dtype="int32")
+    assert c.dtype == np.int32
+    d = nd.full((2, 2), 7.5)
+    assert (d.asnumpy() == 7.5).all()
+    e = nd.arange(0, 10, 2)
+    assert_almost_equal(e, np.arange(0, 10, 2, dtype=np.float32))
+
+
+def test_arith_ops():
+    a = nd.array([[1.0, 2.0], [3.0, 4.0]])
+    b = nd.array([[5.0, 6.0], [7.0, 8.0]])
+    assert_almost_equal(a + b, np.array([[6, 8], [10, 12]]))
+    assert_almost_equal(a - b, np.array([[-4, -4], [-4, -4]]))
+    assert_almost_equal(a * b, np.array([[5, 12], [21, 32]]))
+    assert_almost_equal(b / a, np.array([[5, 3], [7 / 3, 2]]))
+    assert_almost_equal(a + 1, np.array([[2, 3], [4, 5]]))
+    assert_almost_equal(1 - a, np.array([[0, -1], [-2, -3]]))
+    assert_almost_equal(2 / a, 2 / a.asnumpy())
+    assert_almost_equal(a ** 2, a.asnumpy() ** 2)
+    assert_almost_equal(-a, -a.asnumpy())
+
+
+def test_inplace():
+    a = nd.ones((2, 2))
+    ref = a
+    a += 5
+    assert (ref.asnumpy() == 6).all()  # same handle observes the write
+    a *= 2
+    assert (ref.asnumpy() == 12).all()
+    a /= 4
+    assert (ref.asnumpy() == 3).all()
+
+
+def test_setitem():
+    a = nd.zeros((3, 3))
+    a[1] = 5.0
+    assert (a.asnumpy()[1] == 5).all()
+    a[0, 2] = 7.0
+    assert a.asnumpy()[0, 2] == 7
+    a[:] = 1.0
+    assert (a.asnumpy() == 1).all()
+    a[1:3] = 2.0
+    assert (a.asnumpy()[1:] == 2).all()
+    b = nd.zeros((2, 2))
+    b[:] = nd.array([[1, 2], [3, 4]])
+    assert_almost_equal(b, np.array([[1, 2], [3, 4]]))
+
+
+def test_indexing():
+    a = nd.array(np.arange(12).reshape(3, 4))
+    assert_almost_equal(a[1], np.arange(4) + 4)
+    assert_almost_equal(a[1:3], np.arange(12).reshape(3, 4)[1:3])
+    assert_almost_equal(a[:, 1], np.array([1, 5, 9]))
+    assert a[2, 3].asscalar() == 11
+
+
+def test_reshape_codes():
+    a = nd.zeros((2, 3, 4))
+    assert a.reshape((-1,)).shape == (24,)
+    assert a.reshape((0, -1)).shape == (2, 12)
+    assert a.reshape((-2,)).shape == (2, 3, 4)
+    assert a.reshape((-3, 4)).shape == (6, 4)
+    assert a.reshape((2, -4, -1, 3, 4)).shape[0] == 2
+    assert a.reshape(6, 4).shape == (6, 4)
+
+
+def test_reductions():
+    x = np.random.uniform(-1, 1, (3, 4, 5)).astype(np.float32)
+    a = nd.array(x)
+    assert_almost_equal(a.sum(), x.sum())
+    assert_almost_equal(a.sum(axis=1), x.sum(axis=1))
+    assert_almost_equal(a.mean(axis=(0, 2)), x.mean(axis=(0, 2)))
+    assert_almost_equal(a.max(axis=2), x.max(axis=2))
+    assert_almost_equal(a.min(), x.min())
+    assert_almost_equal(nd.sum(a, axis=1, keepdims=True),
+                        x.sum(axis=1, keepdims=True))
+    assert_almost_equal(a.norm(), np.sqrt((x ** 2).sum()))
+
+
+def test_dot():
+    x = np.random.rand(3, 4).astype(np.float32)
+    y = np.random.rand(4, 5).astype(np.float32)
+    assert_almost_equal(nd.dot(nd.array(x), nd.array(y)), x @ y)
+    assert_almost_equal(
+        nd.dot(nd.array(x), nd.array(y.T), transpose_b=True), x @ y)
+    assert_almost_equal(
+        nd.dot(nd.array(x.T), nd.array(y), transpose_a=True), x @ y)
+    bx = np.random.rand(2, 3, 4).astype(np.float32)
+    by = np.random.rand(2, 4, 5).astype(np.float32)
+    assert_almost_equal(nd.batch_dot(nd.array(bx), nd.array(by)), bx @ by)
+
+
+def test_broadcast():
+    a = nd.array(np.arange(6).reshape(2, 3))
+    b = nd.array(np.arange(3).reshape(1, 3))
+    assert_almost_equal(nd.broadcast_add(a, b),
+                        a.asnumpy() + b.asnumpy())
+    assert_almost_equal(a.broadcast_to((2, 3)), a.asnumpy())
+    c = nd.array([[1], [2]])
+    assert_almost_equal(c.broadcast_to((2, 3)),
+                        np.broadcast_to(c.asnumpy(), (2, 3)))
+
+
+def test_comparison():
+    a = nd.array([1, 2, 3])
+    b = nd.array([3, 2, 1])
+    assert_almost_equal(a == b, np.array([0, 1, 0]))
+    assert_almost_equal(a > b, np.array([0, 0, 1]))
+    assert_almost_equal(a <= b, np.array([1, 1, 0]))
+
+
+def test_matrix_manip():
+    x = np.arange(24).reshape(2, 3, 4).astype(np.float32)
+    a = nd.array(x)
+    assert_almost_equal(a.transpose(), x.T)
+    assert_almost_equal(a.transpose((1, 0, 2)), x.transpose(1, 0, 2))
+    assert_almost_equal(nd.expand_dims(a, axis=1), np.expand_dims(x, 1))
+    assert_almost_equal(a.flatten(), x.reshape(2, -1))
+    assert_almost_equal(nd.flip(a, axis=1), x[:, ::-1])
+    assert_almost_equal(nd.tile(a, (1, 2, 1)), np.tile(x, (1, 2, 1)))
+    assert_almost_equal(nd.repeat(a, 2, axis=0), np.repeat(x, 2, axis=0))
+    assert_almost_equal(a.swapaxes(0, 2), x.swapaxes(0, 2))
+    s = nd.concat(a, a, dim=1)
+    assert s.shape == (2, 6, 4)
+    st = nd.stack(a, a, axis=0)
+    assert st.shape == (2, 2, 3, 4)
+    parts = nd.split(a, num_outputs=3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 1, 4)
+
+
+def test_slice_ops():
+    x = np.arange(24).reshape(4, 6).astype(np.float32)
+    a = nd.array(x)
+    assert_almost_equal(nd.slice(a, begin=(1, 2), end=(3, 5)), x[1:3, 2:5])
+    assert_almost_equal(nd.slice_axis(a, axis=1, begin=1, end=4), x[:, 1:4])
+    b = nd.zeros((2, 3))
+    assert_almost_equal(nd.slice_like(a, b), x[:2, :3])
+
+
+def test_take_pick_onehot():
+    x = np.random.rand(5, 4).astype(np.float32)
+    a = nd.array(x)
+    idx = nd.array([0, 2], dtype="int32")
+    assert_almost_equal(nd.take(a, idx), x[[0, 2]])
+    picked = nd.pick(a, nd.array([0, 1, 2, 3, 0]), axis=1)
+    assert_almost_equal(picked, x[np.arange(5), [0, 1, 2, 3, 0]])
+    oh = nd.one_hot(nd.array([0, 2]), 4)
+    assert_almost_equal(oh, np.eye(4, dtype=np.float32)[[0, 2]])
+
+
+def test_ordering():
+    x = np.random.rand(4, 5).astype(np.float32)
+    a = nd.array(x)
+    assert_almost_equal(nd.sort(a, axis=1), np.sort(x, axis=1))
+    assert_almost_equal(nd.argsort(a, axis=1), np.argsort(x, axis=1))
+    assert_almost_equal(nd.argmax(a, axis=1), np.argmax(x, axis=1))
+    assert_almost_equal(nd.argmin(a, axis=0), np.argmin(x, axis=0))
+    topv = nd.topk(a, k=2, axis=1, ret_typ="value")
+    expect = -np.sort(-x, axis=1)[:, :2]
+    assert_almost_equal(topv, expect)
+
+
+def test_save_load(tmp_path):
+    fname = str(tmp_path / "nd.save")
+    a = nd.array([[1, 2], [3, 4]])
+    b = nd.arange(5)
+    nd.save(fname, [a, b])
+    out = nd.load(fname)
+    assert_almost_equal(out[0], a.asnumpy())
+    assert_almost_equal(out[1], b.asnumpy())
+    nd.save(fname, {"a": a, "b": b})
+    d = nd.load(fname)
+    assert set(d.keys()) == {"a", "b"}
+    assert_almost_equal(d["a"], a.asnumpy())
+
+
+def test_astype_copy():
+    a = nd.array([1.5, 2.5])
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+    c = a.copy()
+    c += 1
+    assert_almost_equal(a, np.array([1.5, 2.5]))
+    ctx_copy = a.copyto(mx.cpu())
+    assert_almost_equal(ctx_copy, a.asnumpy())
+
+
+def test_scalar_conversions():
+    a = nd.array([3.5])
+    assert float(a) == 3.5
+    assert int(a) == 3
+    assert a.asscalar() == 3.5
+    assert len(nd.zeros((5, 2))) == 5
+    with pytest.raises(mx.MXNetError):
+        bool(nd.zeros((2, 2)))
+
+
+def test_waitall_and_wait_to_read():
+    a = nd.ones((10, 10))
+    b = a * 2
+    b.wait_to_read()
+    nd.waitall()
+    assert (b.asnumpy() == 2).all()

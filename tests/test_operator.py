@@ -1,0 +1,274 @@
+"""Per-op numeric checks (modeled on tests/python/unittest/test_operator.py
+— forward vs numpy and gradient vs finite differences)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import (assert_almost_equal,
+                                  check_numeric_gradient)
+import scipy.special  # noqa: F401  (present in image? fallback below)
+
+
+def test_unary_forward():
+    x = np.random.uniform(0.1, 2.0, (3, 4)).astype(np.float32)
+    a = nd.array(x)
+    cases = {
+        "abs": np.abs, "square": np.square, "sqrt": np.sqrt,
+        "exp": np.exp, "log": np.log, "log2": np.log2, "log1p": np.log1p,
+        "sin": np.sin, "cos": np.cos, "tanh": np.tanh,
+        "ceil": np.ceil, "floor": np.floor, "sign": np.sign,
+        "reciprocal": np.reciprocal,
+        "rsqrt": lambda v: 1 / np.sqrt(v),
+    }
+    for name, ref in cases.items():
+        out = getattr(nd, name)(a)
+        assert_almost_equal(out, ref(x), rtol=1e-4, atol=1e-5,
+                            names=(name, "ref"))
+    assert_almost_equal(nd.relu(nd.array([-1.0, 2.0])), [0.0, 2.0])
+    assert_almost_equal(nd.sigmoid(nd.array([0.0])), [0.5])
+
+
+def test_clip_cast():
+    x = np.random.uniform(-5, 5, (10,)).astype(np.float32)
+    assert_almost_equal(nd.clip(nd.array(x), -2, 2), np.clip(x, -2, 2))
+    assert nd.Cast(nd.array(x), dtype="int32").dtype == np.int32
+
+
+def test_activation_ops():
+    x = np.random.uniform(-2, 2, (4, 5)).astype(np.float32)
+    a = nd.array(x)
+    assert_almost_equal(nd.Activation(a, act_type="relu"), np.maximum(x, 0))
+    assert_almost_equal(nd.Activation(a, act_type="tanh"), np.tanh(x))
+    assert_almost_equal(nd.Activation(a, act_type="softrelu"),
+                        np.log1p(np.exp(x)), rtol=1e-4, atol=1e-5)
+    assert_almost_equal(nd.LeakyReLU(a, act_type="leaky", slope=0.1),
+                        np.where(x > 0, x, 0.1 * x))
+
+
+def test_softmax_ops():
+    x = np.random.uniform(-2, 2, (3, 6)).astype(np.float32)
+    a = nd.array(x)
+    e = np.exp(x - x.max(axis=-1, keepdims=True))
+    sm = e / e.sum(axis=-1, keepdims=True)
+    assert_almost_equal(nd.softmax(a), sm, rtol=1e-4, atol=1e-6)
+    assert_almost_equal(nd.log_softmax(a), np.log(sm), rtol=1e-4, atol=1e-5)
+
+
+def test_fully_connected():
+    x = np.random.rand(2, 3, 4).astype(np.float32)
+    w = np.random.rand(5, 12).astype(np.float32)
+    b = np.random.rand(5).astype(np.float32)
+    out = nd.FullyConnected(nd.array(x), nd.array(w), nd.array(b),
+                            num_hidden=5)
+    expect = x.reshape(2, 12) @ w.T + b
+    assert_almost_equal(out, expect, rtol=1e-4, atol=1e-5)
+    out2 = nd.FullyConnected(nd.array(x), nd.array(
+        np.random.rand(5, 4).astype(np.float32)), num_hidden=5,
+        no_bias=True, flatten=False)
+    assert out2.shape == (2, 3, 5)
+
+
+def test_convolution_forward():
+    # compare against explicit correlation
+    x = np.random.rand(1, 2, 5, 5).astype(np.float32)
+    w = np.random.rand(3, 2, 3, 3).astype(np.float32)
+    b = np.zeros(3, dtype=np.float32)
+    out = nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                         kernel=(3, 3), num_filter=3).asnumpy()
+    assert out.shape == (1, 3, 3, 3)
+    ref = np.zeros_like(out)
+    for f in range(3):
+        for i in range(3):
+            for j in range(3):
+                ref[0, f, i, j] = np.sum(x[0, :, i:i + 3, j:j + 3] * w[f])
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_convolution_options():
+    x = nd.array(np.random.rand(2, 4, 8, 8).astype(np.float32))
+    w = nd.array(np.random.rand(6, 4, 3, 3).astype(np.float32))
+    b = nd.array(np.zeros(6, dtype=np.float32))
+    out = nd.Convolution(x, w, b, kernel=(3, 3), num_filter=6,
+                         stride=(2, 2), pad=(1, 1))
+    assert out.shape == (2, 6, 4, 4)
+    wg = nd.array(np.random.rand(4, 1, 3, 3).astype(np.float32))
+    outg = nd.Convolution(x, wg, b, kernel=(3, 3), num_filter=4,
+                          num_group=4, pad=(1, 1), no_bias=True)
+    assert outg.shape == (2, 4, 8, 8)
+
+
+def test_deconvolution():
+    x = nd.array(np.random.rand(1, 3, 4, 4).astype(np.float32))
+    w = nd.array(np.random.rand(3, 2, 3, 3).astype(np.float32))
+    out = nd.Deconvolution(x, w, kernel=(3, 3), num_filter=2,
+                           stride=(2, 2), no_bias=True)
+    assert out.shape == (1, 2, 9, 9)
+    # stride-1 deconv inverts shape of a valid conv
+    y = nd.Deconvolution(x, w, kernel=(3, 3), num_filter=2, no_bias=True)
+    assert y.shape == (1, 2, 6, 6)
+
+
+def test_pooling():
+    x = np.random.rand(1, 1, 4, 4).astype(np.float32)
+    out = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                     pool_type="max").asnumpy()
+    ref = x.reshape(1, 1, 2, 2, 2, 2).max(axis=(3, 5))
+    assert_almost_equal(out, ref)
+    outa = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                      pool_type="avg").asnumpy()
+    refa = x.reshape(1, 1, 2, 2, 2, 2).mean(axis=(3, 5))
+    assert_almost_equal(outa, refa, rtol=1e-5, atol=1e-6)
+    outg = nd.Pooling(nd.array(x), global_pool=True, pool_type="max",
+                      kernel=(1, 1))
+    assert outg.shape == (1, 1, 1, 1)
+    assert_almost_equal(outg.asnumpy().ravel(), [x.max()])
+
+
+def test_batchnorm():
+    x = np.random.rand(4, 3, 5, 5).astype(np.float32)
+    gamma = np.random.rand(3).astype(np.float32)
+    beta = np.random.rand(3).astype(np.float32)
+    out, mean, var = nd.BatchNorm(nd.array(x), nd.array(gamma),
+                                  nd.array(beta), nd.zeros(3), nd.ones(3),
+                                  fix_gamma=False, eps=1e-5)
+    m = x.mean(axis=(0, 2, 3))
+    v = x.var(axis=(0, 2, 3))
+    ref = (x - m[None, :, None, None]) / np.sqrt(v + 1e-5)[None, :, None, None]
+    ref = ref * gamma[None, :, None, None] + beta[None, :, None, None]
+    assert_almost_equal(out, ref, rtol=1e-3, atol=1e-4)
+    assert_almost_equal(mean, m, rtol=1e-4, atol=1e-5)
+
+
+def test_layernorm():
+    x = np.random.rand(4, 6).astype(np.float32)
+    g = np.ones(6, dtype=np.float32)
+    b = np.zeros(6, dtype=np.float32)
+    out = nd.LayerNorm(nd.array(x), nd.array(g), nd.array(b))
+    ref = (x - x.mean(-1, keepdims=True)) / np.sqrt(
+        x.var(-1, keepdims=True) + 1e-5)
+    assert_almost_equal(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_embedding():
+    w = np.random.rand(10, 4).astype(np.float32)
+    idx = np.array([[0, 5], [9, 1]], dtype=np.float32)
+    out = nd.Embedding(nd.array(idx), nd.array(w), input_dim=10,
+                       output_dim=4)
+    assert_almost_equal(out, w[idx.astype(int)])
+
+
+def test_sequence_ops():
+    x = np.random.rand(4, 2, 3).astype(np.float32)
+    lens = np.array([2, 4], dtype=np.float32)
+    masked = nd.SequenceMask(nd.array(x), nd.array(lens),
+                             use_sequence_length=True, value=-1.0).asnumpy()
+    assert (masked[2:, 0] == -1).all()
+    assert_almost_equal(masked[:, 1], x[:, 1])
+    last = nd.SequenceLast(nd.array(x), nd.array(lens),
+                           use_sequence_length=True)
+    assert_almost_equal(last.asnumpy()[0], x[1, 0])
+    assert_almost_equal(last.asnumpy()[1], x[3, 1])
+    rev = nd.SequenceReverse(nd.array(x), nd.array(lens),
+                             use_sequence_length=True).asnumpy()
+    assert_almost_equal(rev[0, 0], x[1, 0])
+    assert_almost_equal(rev[:, 1], x[::-1, 1])
+
+
+def test_gather_scatter():
+    x = np.random.rand(3, 4).astype(np.float32)
+    idx = np.array([[0, 2], [1, 3]], dtype=np.float32)
+    out = nd.gather_nd(nd.array(x), nd.array(idx))
+    assert_almost_equal(out, x[[0, 2], [1, 3]])
+    data = nd.array([9.0, 8.0])
+    s = nd.scatter_nd(data, nd.array(idx), shape=(3, 4))
+    ref = np.zeros((3, 4), np.float32)
+    ref[0, 1] = 9
+    ref[2, 3] = 8
+    assert_almost_equal(s, ref)
+
+
+def test_where():
+    cond = nd.array([1, 0])
+    x = nd.array([[1, 2], [3, 4]])
+    y = nd.array([[5, 6], [7, 8]])
+    assert_almost_equal(nd.where(cond, x, y), np.array([[1, 2], [7, 8]]))
+
+
+def test_grad_elemwise():
+    x = mx.sym.var("x")
+    y = mx.sym.var("y")
+    check_numeric_gradient(x * y + mx.sym.sin(x),
+                           {"x": np.random.rand(3, 3) + 0.5,
+                            "y": np.random.rand(3, 3) + 0.5})
+
+
+def test_grad_dot():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    check_numeric_gradient(mx.sym.dot(a, b),
+                           {"a": np.random.rand(3, 4),
+                            "b": np.random.rand(4, 2)})
+
+
+def test_grad_fc():
+    data = mx.sym.var("data")
+    out = mx.sym.FullyConnected(data, num_hidden=3, name="fc")
+    check_numeric_gradient(out, {"data": np.random.rand(2, 5),
+                                 "fc_weight": np.random.rand(3, 5),
+                                 "fc_bias": np.random.rand(3)},
+                           numeric_eps=1e-3, rtol=2e-2)
+
+
+def test_grad_softmax():
+    data = mx.sym.var("data")
+    out = mx.sym.softmax(data)
+    check_numeric_gradient(mx.sym.sum(out * out),
+                           {"data": np.random.rand(2, 4)},
+                           numeric_eps=1e-3, rtol=2e-2)
+
+
+def test_linalg_ops():
+    a = np.random.rand(3, 3).astype(np.float32)
+    spd = a @ a.T + 3 * np.eye(3, dtype=np.float32)
+    chol = nd.linalg_potrf(nd.array(spd)).asnumpy()
+    assert_almost_equal(chol @ chol.T, spd, rtol=1e-4, atol=1e-4)
+    x = np.random.rand(2, 3).astype(np.float32)
+    y = np.random.rand(3, 4).astype(np.float32)
+    g = nd.linalg_gemm2(nd.array(x), nd.array(y))
+    assert_almost_equal(g, x @ y, rtol=1e-5, atol=1e-5)
+
+
+def test_ctc_loss():
+    T, N, C = 10, 2, 5
+    data = np.random.uniform(-1, 1, (T, N, C)).astype(np.float32)
+    label = np.array([[1, 2, 0, 0], [2, 3, 4, 0]], dtype=np.float32)
+    loss = nd.CTCLoss(nd.array(data), nd.array(label)).asnumpy()
+    assert loss.shape == (N,)
+    assert (loss > 0).all()
+
+
+def test_upsampling():
+    x = np.random.rand(1, 2, 3, 3).astype(np.float32)
+    out = nd.UpSampling(nd.array(x), scale=2, sample_type="nearest")
+    assert out.shape == (1, 2, 6, 6)
+    assert_almost_equal(out.asnumpy()[0, 0, ::2, ::2], x[0, 0])
+
+
+def test_dropout_modes():
+    x = nd.ones((100, 100))
+    out = nd.Dropout(x, p=0.5)  # not in train mode -> identity
+    assert_almost_equal(out, x.asnumpy())
+    with mx.autograd.train_mode():
+        out = nd.Dropout(x, p=0.5)
+    frac = (out.asnumpy() == 0).mean()
+    assert 0.3 < frac < 0.7
+    # mean preserved approximately
+    assert abs(out.asnumpy().mean() - 1.0) < 0.1
+
+
+def test_smooth_l1():
+    x = np.array([-2.0, -0.5, 0.5, 2.0], dtype=np.float32)
+    out = nd.smooth_l1(nd.array(x), scalar=1.0).asnumpy()
+    ref = np.where(np.abs(x) < 1, 0.5 * x ** 2, np.abs(x) - 0.5)
+    assert_almost_equal(out, ref)

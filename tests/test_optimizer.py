@@ -1,0 +1,127 @@
+"""Optimizer tests (modeled on tests/python/unittest/test_optimizer.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import optimizer as opt
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _run_updates(optimizer, steps=5, shape=(4, 3), seed=0):
+    rng = np.random.RandomState(seed)
+    w = nd.array(rng.rand(*shape))
+    state = optimizer.create_state(0, w)
+    history = [w.asnumpy().copy()]
+    for _ in range(steps):
+        g = nd.array(rng.rand(*shape) - 0.5)
+        optimizer.update(0, w, g, state)
+        history.append(w.asnumpy().copy())
+    return history
+
+
+def test_sgd_matches_reference_math():
+    lr, wd = 0.1, 0.01
+    o = opt.SGD(learning_rate=lr, wd=wd)
+    rng = np.random.RandomState(0)
+    w_np = rng.rand(4, 3).astype(np.float32)
+    w = nd.array(w_np)
+    g_np = (rng.rand(4, 3) - 0.5).astype(np.float32)
+    o.update(0, w, nd.array(g_np), None)
+    expect = w_np - lr * (g_np + wd * w_np)
+    assert_almost_equal(w, expect, rtol=1e-5, atol=1e-6)
+
+
+def test_sgd_momentum():
+    lr, mom = 0.1, 0.9
+    o = opt.SGD(learning_rate=lr, momentum=mom)
+    rng = np.random.RandomState(0)
+    w_np = rng.rand(3).astype(np.float32)
+    w = nd.array(w_np.copy())
+    state = o.create_state(0, w)
+    mom_np = np.zeros(3, np.float32)
+    for _ in range(3):
+        g_np = (rng.rand(3) - 0.5).astype(np.float32)
+        o.update(0, w, nd.array(g_np), state)
+        mom_np = mom * mom_np - lr * g_np
+        w_np = w_np + mom_np
+    assert_almost_equal(w, w_np, rtol=1e-5, atol=1e-6)
+
+
+def test_adam_matches_reference_math():
+    lr = 0.01
+    o = opt.Adam(learning_rate=lr)
+    rng = np.random.RandomState(1)
+    w_np = rng.rand(5).astype(np.float32)
+    w = nd.array(w_np.copy())
+    state = o.create_state(0, w)
+    m = np.zeros(5, np.float32)
+    v = np.zeros(5, np.float32)
+    b1, b2, eps = 0.9, 0.999, 1e-8
+    for t in range(1, 4):
+        g_np = (rng.rand(5) - 0.5).astype(np.float32)
+        o.update(0, w, nd.array(g_np), state)
+        lr_t = lr * np.sqrt(1 - b2 ** t) / (1 - b1 ** t)
+        m = b1 * m + (1 - b1) * g_np
+        v = b2 * v + (1 - b2) * g_np ** 2
+        w_np = w_np - lr_t * m / (np.sqrt(v) + eps)
+    assert_almost_equal(w, w_np, rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.parametrize("name", ["sgd", "adam", "nag", "rmsprop",
+                                  "adagrad", "adadelta", "ftrl", "adamax",
+                                  "nadam", "signum", "ftml", "sgld",
+                                  "dcasgd"])
+def test_all_optimizers_decrease_simple_loss(name):
+    o = opt.create(name, learning_rate=0.05, rescale_grad=1.0)
+    target = np.zeros(8, np.float32)
+    w = nd.array(np.random.RandomState(2).rand(8) + 1.0)
+    state = o.create_state(0, w)
+    loss0 = float(((w.asnumpy() - target) ** 2).sum())
+    for _ in range(30):
+        g = nd.array(2 * (w.asnumpy() - target))
+        o.update(0, w, g, state)
+    loss1 = float(((w.asnumpy() - target) ** 2).sum())
+    assert loss1 < loss0
+
+
+def test_lr_scheduler():
+    from mxnet_tpu.lr_scheduler import FactorScheduler, MultiFactorScheduler
+
+    s = FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    assert s(5) == 1.0
+    assert s(11) == 0.5
+    s2 = MultiFactorScheduler(step=[5, 10], factor=0.1, base_lr=1.0)
+    assert s2(2) == 1.0
+    assert abs(s2(7) - 0.1) < 1e-8
+    assert abs(s2(12) - 0.01) < 1e-9
+
+
+def test_lr_wd_mult():
+    o = opt.SGD(learning_rate=1.0, param_idx2name={0: "w0", 1: "w1"})
+    o.set_lr_mult({"w0": 0.0})
+    w0 = nd.ones((2,))
+    w1 = nd.ones((2,))
+    g = nd.ones((2,))
+    o.update(0, w0, g, None)
+    o.update(1, w1, g, None)
+    assert_almost_equal(w0, np.ones(2))  # lr_mult 0 froze it
+    assert not np.allclose(w1.asnumpy(), np.ones(2))
+
+
+def test_updater_serialization():
+    o = opt.SGD(learning_rate=0.1, momentum=0.9)
+    u = opt.get_updater(o)
+    w = nd.ones((3,))
+    u(0, nd.ones((3,)), w)
+    states = u.get_states()
+    u2 = opt.get_updater(opt.SGD(learning_rate=0.1, momentum=0.9))
+    u2.set_states(states)
+    assert 0 in u2.states
+
+
+def test_clip_gradient():
+    o = opt.SGD(learning_rate=1.0, clip_gradient=0.5)
+    w = nd.zeros((2,))
+    o.update(0, w, nd.array([10.0, -10.0]), None)
+    assert_almost_equal(w, [-0.5, 0.5])

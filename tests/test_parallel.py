@@ -1,0 +1,157 @@
+"""Parallelism tests: mesh sharding, sharded trainer, ring attention,
+pipeline — on the virtual 8-device CPU mesh (SURVEY §4 dist-test pattern)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon
+from mxnet_tpu.gluon import nn
+from mxnet_tpu import parallel
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _devices():
+    import jax
+
+    return jax.devices()
+
+
+def test_make_mesh():
+    mesh = parallel.make_mesh({"dp": 4, "tp": 2})
+    assert mesh.shape["dp"] == 4
+    assert mesh.shape["tp"] == 2
+    mesh2 = parallel.local_mesh()
+    assert mesh2.devices.size == len(_devices())
+
+
+def test_sharded_trainer_dp():
+    mesh = parallel.make_mesh({"dp": 8})
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(1))
+    net.initialize()
+    loss_fn = gluon.loss.L2Loss()
+
+    def loss_adapter(out, label):
+        return loss_fn(out, label)
+
+    trainer = parallel.ShardedTrainer(net, loss_adapter, mesh=mesh,
+                                      optimizer="sgd",
+                                      optimizer_params={"learning_rate": 0.2})
+    rng = np.random.RandomState(0)
+    X = rng.rand(64, 8).astype(np.float32)
+    w = rng.rand(8, 1).astype(np.float32)
+    Y = X @ w
+    losses = []
+    for _ in range(30):
+        xs, ys = trainer.shard_batch(nd.array(X), nd.array(Y))
+        loss = trainer.step([xs], ys)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.2
+    trainer.sync_to_net()
+    pred = net(nd.array(X[:4])).asnumpy()
+    assert np.abs(pred - Y[:4]).mean() < np.abs(Y[:4]).mean()
+
+
+def test_sharded_trainer_matches_single_device():
+    """dp=8 sharded step must equal the math of a full-batch step."""
+    mesh = parallel.make_mesh({"dp": 8})
+    net = nn.Dense(1, in_units=4)
+    net.initialize(mx.init.One())
+    loss_fn = gluon.loss.L2Loss()
+    trainer = parallel.ShardedTrainer(net, lambda o, l: loss_fn(o, l),
+                                      mesh=mesh, optimizer="sgd",
+                                      optimizer_params={"learning_rate": 0.1})
+    X = np.ones((16, 4), np.float32)
+    Y = np.zeros((16, 1), np.float32)
+    xs, ys = trainer.shard_batch(nd.array(X), nd.array(Y))
+    trainer.step([xs], ys)
+    trainer.sync_to_net()
+    # manual: out=4 (w=1,b=0... bias init zero), loss=mean(0.5*(4)^2)
+    # dL/dw = mean over batch of (out-y)*x = 4*1 = 4 ; new w = 1 - .1*4
+    w = net.weight.data().asnumpy()
+    assert_almost_equal(w, np.full((1, 4), 1 - 0.4), rtol=1e-4, atol=1e-4)
+
+
+def test_tensor_parallel_spec():
+    from jax.sharding import PartitionSpec as P
+
+    mesh = parallel.make_mesh({"dp": 2, "tp": 4})
+
+    def spec_fn(name, shape):
+        if name.endswith("weight") and len(shape) == 2:
+            return P("tp", None)  # shard output dim
+        return None
+
+    net = nn.Dense(32, in_units=16)
+    net.initialize()
+    loss_fn = gluon.loss.L2Loss()
+    trainer = parallel.ShardedTrainer(net, lambda o, l: loss_fn(o, l),
+                                      mesh=mesh, optimizer="sgd",
+                                      param_spec_fn=spec_fn)
+    X = np.random.rand(8, 16).astype(np.float32)
+    Y = np.random.rand(8, 32).astype(np.float32)
+    xs, ys = trainer.shard_batch(nd.array(X), nd.array(Y))
+    loss1 = float(trainer.step([xs], ys))
+    loss2 = float(trainer.step([xs], ys))
+    assert loss2 < loss1
+
+
+def test_ring_attention_matches_local():
+    import jax.numpy as jnp
+
+    mesh = parallel.make_mesh({"sp": 8})
+    B, T, H, D = 2, 32, 4, 16
+    rng = np.random.RandomState(0)
+    q = jnp.asarray(rng.rand(B, T, H, D).astype(np.float32))
+    k = jnp.asarray(rng.rand(B, T, H, D).astype(np.float32))
+    v = jnp.asarray(rng.rand(B, T, H, D).astype(np.float32))
+    ref = parallel.local_attention(q, k, v)
+    out = parallel.ring_attention_sharded(mesh, q, k, v, axis_name="sp")
+    assert_almost_equal(np.asarray(out), np.asarray(ref), rtol=1e-4,
+                        atol=1e-4)
+
+
+def test_ring_attention_causal():
+    import jax.numpy as jnp
+
+    mesh = parallel.make_mesh({"sp": 4})
+    B, T, H, D = 1, 16, 2, 8
+    rng = np.random.RandomState(1)
+    q = jnp.asarray(rng.rand(B, T, H, D).astype(np.float32))
+    k = jnp.asarray(rng.rand(B, T, H, D).astype(np.float32))
+    v = jnp.asarray(rng.rand(B, T, H, D).astype(np.float32))
+    ref = parallel.local_attention(q, k, v, causal=True)
+    out = parallel.ring_attention_sharded(mesh, q, k, v, axis_name="sp",
+                                          causal=True)
+    assert_almost_equal(np.asarray(out), np.asarray(ref), rtol=1e-4,
+                        atol=1e-4)
+
+
+def test_pipeline_forward():
+    import jax.numpy as jnp
+
+    mesh = parallel.make_mesh({"pp": 4})
+
+    def stage_fn(stage, x):
+        return x + 1.0  # each stage adds one
+
+    def loss_fn(y):
+        return jnp.mean(y)
+
+    x = jnp.ones((8, 4), jnp.float32)
+    loss = parallel.gpipe_loss(mesh, stage_fn, loss_fn, x, num_micro=2,
+                               axis_name="pp")
+    # 4 stages each add 1 -> mean = 1 + 4 = 5
+    assert abs(float(loss) - 5.0) < 1e-5
+
+
+def test_kvstore_vs_mesh_equivalence():
+    """kvstore 'device' aggregation equals psum over dp shards."""
+    grads = [nd.array(np.full((2, 2), float(i + 1))) for i in range(4)]
+    kv = mx.kvstore.create("device")
+    kv.init("g", nd.zeros((2, 2)))
+    kv._updater = lambda k, g, w: w._rebind(g._data)  # store the sum
+    kv.push("g", grads)
+    out = nd.zeros((2, 2))
+    kv.pull("g", out=out)
+    assert_almost_equal(out, np.full((2, 2), 10.0))

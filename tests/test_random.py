@@ -1,0 +1,62 @@
+"""RNG tests (modeled on tests/python/unittest/test_random.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_seed_reproducibility():
+    mx.random.seed(42)
+    a = mx.random.uniform(0, 1, shape=(10,)).asnumpy()
+    mx.random.seed(42)
+    b = mx.random.uniform(0, 1, shape=(10,)).asnumpy()
+    assert (a == b).all()
+    c = mx.random.uniform(0, 1, shape=(10,)).asnumpy()
+    assert not (b == c).all()
+
+
+def test_uniform_range():
+    x = mx.random.uniform(-5, 5, shape=(10000,)).asnumpy()
+    assert x.min() >= -5 and x.max() <= 5
+    assert abs(x.mean()) < 0.2
+
+
+def test_normal_moments():
+    x = mx.random.normal(2.0, 3.0, shape=(20000,)).asnumpy()
+    assert abs(x.mean() - 2.0) < 0.1
+    assert abs(x.std() - 3.0) < 0.1
+
+
+def test_randint():
+    x = mx.random.randint(0, 10, shape=(1000,)).asnumpy()
+    assert x.min() >= 0 and x.max() < 10
+    assert x.dtype == np.int32
+
+
+def test_samplers_shapes():
+    assert mx.random.exponential(1.0, shape=(5, 5)).shape == (5, 5)
+    assert mx.random.gamma(2.0, 1.0, shape=(3,)).shape == (3,)
+    assert mx.random.poisson(4.0, shape=(7,)).shape == (7,)
+    assert mx.random.randn(2, 3).shape == (2, 3)
+
+
+def test_shuffle():
+    x = nd.arange(0, 100)
+    y = mx.random.shuffle(x).asnumpy()
+    assert sorted(y.tolist()) == list(range(100))
+    assert not (y == np.arange(100)).all()
+
+
+def test_multinomial():
+    probs = nd.array([0.0, 0.0, 1.0])
+    s = mx.random.multinomial(probs, shape=(20,)).asnumpy()
+    assert (s == 2).all()
+
+
+def test_nd_sample_ops():
+    out = nd._random_uniform(low=0, high=1, shape=(4, 4))
+    assert out.shape == (4, 4)
+    mu = nd.array([[0.0], [10.0]])
+    sig = nd.array([[1.0], [1.0]])
+    s = nd._sample_normal(mu, sig, shape=(100,)).asnumpy()
+    assert s.shape == (2, 1, 100)

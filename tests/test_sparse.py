@@ -1,0 +1,79 @@
+"""Sparse NDArray tests (modeled on tests/python/unittest/test_sparse_ndarray.py
+— scoped to the storage/round-trip surface per SURVEY §7 hard-part 7)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray import sparse
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def test_csr_creation_and_roundtrip():
+    dense = np.array([[0, 1, 0], [2, 0, 3]], dtype=np.float32)
+    csr = sparse.csr_matrix(nd.array(dense))
+    assert csr.stype == "csr"
+    assert_almost_equal(csr.asnumpy(), dense)
+    assert_almost_equal(csr.indptr, [0, 1, 3])
+    assert_almost_equal(csr.indices, [1, 0, 2])
+    assert_almost_equal(csr.data, [1, 2, 3])
+    back = csr.tostype("default")
+    assert back.stype == "default"
+    assert_almost_equal(back, dense)
+
+
+def test_csr_from_components():
+    csr = sparse.csr_matrix(([1.0, 2.0], [0, 2], [0, 1, 2]), shape=(2, 3))
+    expect = np.array([[1, 0, 0], [0, 0, 2]], dtype=np.float32)
+    assert_almost_equal(csr.asnumpy(), expect)
+
+
+def test_row_sparse_creation():
+    dense = np.zeros((4, 3), dtype=np.float32)
+    dense[1] = 1
+    dense[3] = 2
+    rsp = sparse.row_sparse_array(nd.array(dense))
+    assert rsp.stype == "row_sparse"
+    assert_almost_equal(rsp.indices, [1, 3])
+    assert_almost_equal(rsp.asnumpy(), dense)
+
+
+def test_row_sparse_from_components():
+    rsp = sparse.row_sparse_array(
+        ([[1.0, 1.0], [2.0, 2.0]], [0, 2]), shape=(3, 2))
+    expect = np.array([[1, 1], [0, 0], [2, 2]], dtype=np.float32)
+    assert_almost_equal(rsp.asnumpy(), expect)
+
+
+def test_cast_storage():
+    dense = nd.array(np.eye(3, dtype=np.float32))
+    csr = dense.tostype("csr")
+    rsp = dense.tostype("row_sparse")
+    assert csr.stype == "csr" and rsp.stype == "row_sparse"
+    assert_almost_equal(csr.asnumpy(), np.eye(3))
+    assert_almost_equal(rsp.asnumpy(), np.eye(3))
+
+
+def test_sparse_dot():
+    dense = np.random.rand(3, 4).astype(np.float32)
+    rhs = np.random.rand(4, 2).astype(np.float32)
+    csr = sparse.csr_matrix(nd.array(dense))
+    out = sparse.dot(csr, nd.array(rhs))
+    assert_almost_equal(out, dense @ rhs, rtol=1e-5, atol=1e-5)
+
+
+def test_retain():
+    dense = np.arange(12).reshape(4, 3).astype(np.float32)
+    rsp = sparse.row_sparse_array(nd.array(dense))
+    kept = sparse.retain(rsp, nd.array([0, 2]))
+    expect = dense.copy()
+    expect[[1, 3]] = 0
+    assert_almost_equal(kept.asnumpy(), expect)
+
+
+def test_rand_ndarray_sparse():
+    from mxnet_tpu.test_utils import rand_ndarray
+
+    arr = rand_ndarray((10, 5), stype="csr", density=0.3)
+    assert arr.stype == "csr"
+    nnz_frac = (arr.asnumpy() != 0).mean()
+    assert nnz_frac < 0.8

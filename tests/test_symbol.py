@@ -1,0 +1,117 @@
+"""Symbol tests (modeled on tests/python/unittest/test_symbol.py)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+def _mlp():
+    data = mx.sym.var("data")
+    net = mx.sym.FullyConnected(data, num_hidden=10, name="fc1")
+    net = mx.sym.Activation(net, act_type="relu")
+    net = mx.sym.FullyConnected(net, num_hidden=4, name="fc2")
+    return net
+
+
+def test_compose_and_arguments():
+    net = _mlp()
+    assert net.list_arguments() == ["data", "fc1_weight", "fc1_bias",
+                                    "fc2_weight", "fc2_bias"]
+    assert net.list_outputs() == ["fc2_output"]
+
+
+def test_infer_shape():
+    net = _mlp()
+    arg_shapes, out_shapes, aux_shapes = net.infer_shape(data=(8, 6))
+    assert arg_shapes == [(8, 6), (10, 6), (10,), (4, 10), (4,)]
+    assert out_shapes == [(8, 4)]
+    assert aux_shapes == []
+
+
+def test_infer_shape_partial():
+    data = mx.sym.var("data")
+    out = mx.sym.FullyConnected(data, num_hidden=2, name="fc")
+    arg_shapes, out_shapes, _ = out.infer_shape_partial()
+    assert out_shapes[0] is None
+
+
+def test_symbol_arith():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    c = (a + b * 2) / (a - 1)
+    exe = c.bind(ctx=mx.cpu(), args={"a": mx.nd.array([4.0]),
+                                     "b": mx.nd.array([3.0])})
+    exe.forward()
+    assert_almost_equal(exe.outputs[0], [(4 + 6) / 3.0])
+
+
+def test_json_roundtrip():
+    net = _mlp()
+    js = net.tojson()
+    net2 = mx.sym.load_json(js)
+    assert net2.list_arguments() == net.list_arguments()
+    assert net2.list_outputs() == net.list_outputs()
+    s1, o1, _ = net.infer_shape(data=(2, 3))
+    s2, o2, _ = net2.infer_shape(data=(2, 3))
+    assert o1 == o2
+
+
+def test_save_load(tmp_path):
+    net = _mlp()
+    fname = str(tmp_path / "sym.json")
+    net.save(fname)
+    net2 = mx.sym.load(fname)
+    assert net2.list_arguments() == net.list_arguments()
+
+
+def test_group_and_getitem():
+    a = mx.sym.var("a")
+    b = a * 2
+    c = a + 1
+    g = mx.sym.Group([b, c])
+    assert len(g.list_outputs()) == 2
+    first = g[0]
+    assert len(first.list_outputs()) == 1
+
+
+def test_get_internals():
+    net = _mlp()
+    internals = net.get_internals()
+    names = internals.list_outputs()
+    assert any("fc1" in n for n in names)
+    feat = internals["fc1_output"]
+    arg_shapes, out_shapes, _ = feat.infer_shape(data=(2, 6))
+    assert out_shapes == [(2, 6)] or out_shapes == [(2, 10)]
+
+
+def test_aux_states_bn():
+    data = mx.sym.var("data")
+    out = mx.sym.BatchNorm(data, name="bn")
+    assert set(out.list_auxiliary_states()) == {"bn_moving_mean",
+                                                "bn_moving_var"}
+    args = out.list_arguments()
+    assert "bn_gamma" in args and "bn_moving_mean" not in args
+
+
+def test_attr_and_var_shape():
+    a = mx.sym.var("a", shape=(3, 4), lr_mult=2.0)
+    assert a.attr("__shape__") == str((3, 4))
+    d = a.attr_dict()
+    assert d["a"]["__lr_mult__"] == "2.0"
+
+
+def test_multi_output_indexing():
+    data = mx.sym.var("data")
+    parts = mx.sym.SliceChannel(data, num_outputs=3, axis=1, name="split")
+    assert len(parts.list_outputs()) == 3
+    p0 = parts[0]
+    exe = p0.bind(ctx=mx.cpu(),
+                  args={"data": mx.nd.array(np.arange(6).reshape(1, 6))})
+    exe.forward()
+    assert exe.outputs[0].shape == (1, 2)
+
+
+def test_eval():
+    a = mx.sym.var("a")
+    out = (a * 3).eval(a=mx.nd.array([1.0, 2.0]))
+    assert_almost_equal(out[0], [3.0, 6.0])
