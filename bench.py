@@ -9,7 +9,8 @@ one XLA program per step (fwd+bwd+update, donated buffers), bf16 compute
 with fp32 params — the TPU-native equivalent of the reference's
 Module + kvstore('device') training loop.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line on stdout: {"metric", "value", "unit",
+"vs_baseline"}.  Progress goes to stderr.
 """
 import json
 import os
@@ -18,8 +19,16 @@ import time
 
 import numpy as np
 
+_T0 = time.time()
+
+
+def log(msg):
+    print("[bench %6.1fs] %s" % (time.time() - _T0, msg), file=sys.stderr,
+          flush=True)
+
 
 def main():
+    log("importing jax/mxnet_tpu")
     import jax
 
     import mxnet_tpu as mx
@@ -28,16 +37,18 @@ def main():
 
     batch = int(os.environ.get("BENCH_BATCH", "128"))
     steps = int(os.environ.get("BENCH_STEPS", "20"))
-    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "2"))
     on_tpu = any(d.platform != "cpu" for d in jax.devices())
     if not on_tpu:
         # keep CPU smoke runs fast
         batch = min(batch, 16)
         steps = min(steps, 3)
         warmup = 1
+    log("devices=%s batch=%d steps=%d" % (jax.devices(), batch, steps))
 
     net = vision.resnet50_v1(classes=1000)
     net.initialize(mx.init.Xavier())
+    log("model built + host-initialized")
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
 
     trainer = parallel.ShardedTrainer(
@@ -48,17 +59,20 @@ def main():
     rng = np.random.RandomState(0)
     x = nd.array(rng.rand(batch, 3, 224, 224).astype(np.float32))
     y = nd.array(rng.randint(0, 1000, batch).astype(np.float32))
+    log("synthetic batch ready; compiling train step")
 
     # warmup/compile
-    for _ in range(warmup):
+    for i in range(warmup):
         loss = trainer.step([x], y)
-    jax.block_until_ready(loss)
+        jax.block_until_ready(loss)
+        log("warmup step %d done (loss=%.4f)" % (i, float(loss)))
 
     t0 = time.perf_counter()
-    for _ in range(steps):
+    for i in range(steps):
         loss = trainer.step([x], y)
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
+    log("%d steps in %.3fs" % (steps, dt))
 
     ips = batch * steps / dt
     baseline = 364.0  # V100 fp16 train img/s @ bs128 (BASELINE.md)

@@ -113,24 +113,28 @@ class _TapeRef:
 
 class _TapeNode:
     __slots__ = ("info", "attrs", "input_refs", "input_arrays",
-                 "output_refs", "custom_backward")
+                 "output_refs", "custom_backward", "rng_key")
 
-    def __init__(self, info, attrs, input_refs, input_arrays, custom_backward=None):
+    def __init__(self, info, attrs, input_refs, input_arrays,
+                 custom_backward=None, rng_key=None):
         self.info = info
         self.attrs = attrs
         self.input_refs = input_refs
         self.input_arrays = input_arrays
         self.output_refs = []
         self.custom_backward = custom_backward
+        self.rng_key = rng_key  # forward's PRNG key, replayed in backward
 
 
-def record_op(info, attrs, nd_inputs, nd_outputs, custom_backward=None):
+def record_op(info, attrs, nd_inputs, nd_outputs, custom_backward=None,
+              rng_key=None):
     """Append an op to the tape if any input participates in grad flow."""
     input_refs = [x._tape_ref for x in nd_inputs]
     if not any(r is not None for r in input_refs):
         return
     node = _TapeNode(info, dict(attrs), input_refs,
-                     [x._data for x in nd_inputs], custom_backward)
+                     [x._data for x in nd_inputs], custom_backward,
+                     rng_key=rng_key)
     for i, out in enumerate(nd_outputs):
         ref = _TapeRef(producer=node, out_index=i, array=out._data)
         node.output_refs.append(ref)
@@ -214,9 +218,20 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
                 in_grads = node.custom_backward(out_grads)
             else:
                 info, attrs = node.info, node.attrs
+                rng_key = node.rng_key
 
                 def f(*arrs):
-                    return info.fn(*arrs, **attrs)
+                    if rng_key is None:
+                        return info.fn(*arrs, **attrs)
+                    # replay the forward's exact randomness (e.g. the
+                    # Dropout mask) instead of drawing a fresh key
+                    from . import random as _random
+
+                    _random.push_trace_key(rng_key)
+                    try:
+                        return info.fn(*arrs, **attrs)
+                    finally:
+                        _random.pop_trace_key()
 
                 _, vjp_fn = jax.vjp(f, *node.input_arrays)
                 multi = len(node.output_refs) > 1

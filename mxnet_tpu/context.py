@@ -129,8 +129,21 @@ def num_tpus():
     return len(Context._accelerators())
 
 
+def default_context():
+    """The implicit context: the accelerator when one is present.
+
+    TPU-native departure from the reference (which defaults to cpu):
+    on a TPU host the chip is the default compute device — data created
+    without an explicit ctx lands in HBM and eager/jit programs run on
+    the MXU, mirroring jax's own default-backend rule.  `mx.cpu()` still
+    pins host placement explicitly."""
+    if Context._accelerators():
+        return Context("tpu", 0)
+    return Context("cpu", 0)
+
+
 def current_context():
     stack = getattr(_context_stack, "stack", None)
     if stack:
         return stack[-1]
-    return Context("cpu", 0)
+    return default_context()

@@ -103,7 +103,8 @@ class Engine:
         if self.is_naive:
             import jax
 
-            jax.block_until_ready(data)
+            if not isinstance(data, jax.core.Tracer):
+                jax.block_until_ready(data)
         return data
 
 

@@ -48,6 +48,44 @@ def _is_tracing():
     return getattr(_trace_state, "active", False)
 
 
+def _abstract_eval_forward(block, args):
+    """Finish deferred parameter inits by abstract-evaluating the forward.
+
+    TPU-native replacement for an eager warm-up pass: jax.eval_shape runs
+    the whole forward with abstract values — shapes propagate, deferred
+    params initialize (host numpy + device_put), but no device program is
+    traced or compiled.  On TPU an eager warm-up would be hundreds of
+    one-op compilations (the round-1 bench timeout); this is milliseconds.
+    Counterpart of the reference's shape-inference pass
+    (src/executor/infer_graph_attr_pass.cc:647).
+    """
+    import jax
+    import numpy as _np
+
+    from ..ndarray.ndarray import NDArray as _ND
+
+    raws = [a._data if isinstance(a, _ND) else a for a in args]
+
+    def probe(*xs):
+        prev_sink = getattr(_aux_sink, "sink", None)
+        prev_tr = getattr(_trace_state, "active", False)
+        _aux_sink.sink = []  # discard moving-stat updates (tracers)
+        _trace_state.active = True
+        try:
+            out = block.forward(*[_ND(x) for x in xs])
+        finally:
+            _aux_sink.sink = prev_sink
+            _trace_state.active = prev_tr
+        outs = out if isinstance(out, (list, tuple)) else [out]
+        return tuple(o._data for o in outs)
+
+    specs = [jax.ShapeDtypeStruct(tuple(_np.shape(r)) if not hasattr(r, "shape")
+                                  else tuple(r.shape),
+                                  getattr(r, "dtype", _np.float32))
+             for r in raws]
+    return jax.eval_shape(probe, *specs)
+
+
 class _BlockScope:
     _current = threading.local()
 
@@ -496,12 +534,12 @@ class HybridBlock(Block):
         raise MXNetError("forward expects NDArray or Symbol, got %r" % type(x))
 
     def _warm_up(self, *args):
-        """One eager pass to finish deferred inits everywhere."""
+        """Finish deferred inits everywhere without device compute."""
         prev = self._active
         self._active = False
         try:
             with autograd.pause():
-                self.forward(*args)
+                _abstract_eval_forward(self, args)
         finally:
             self._active = prev
 

@@ -127,9 +127,20 @@ class Parameter:
                 "Parameter '%s' has not been initialized yet because "
                 "initialization was deferred (shape=%s)." % (self.name,
                                                              self._shape))
-        with autograd.pause():
+        import jax
+        import numpy as _np
+
+        # ensure_compile_time_eval: deferred init may be triggered from
+        # inside a trace (eval_shape warm-up / CachedOp); param values
+        # must be concrete arrays, never tracers.  Initial buffers are
+        # host numpy — no per-param device program or transfer; the first
+        # compiled step uploads all params in one batch.
+        with autograd.pause(), jax.ensure_compile_time_eval():
             if data is None:
-                data = zeros(self._shape, dtype=self.dtype, ctx=ctx[0])
+                from ..ndarray.ndarray import NDArray as _ND
+
+                data = _ND(_np.zeros(self._shape,
+                                     dtype=_np.dtype(self.dtype)))
                 desc = initializer.InitDesc(self.name, {})
                 chosen = init if init is not None else (
                     self.init if self.init is not None else default_init)
@@ -148,9 +159,13 @@ class Parameter:
         if self._grad_req == "null":
             self._grad = None
             return
+        import numpy as _np
+
+        from ..ndarray.ndarray import NDArray as _ND
+
         self._grad = OrderedDict()
         for ctx, d in self._data.items():
-            g = zeros(d.shape, dtype=d.dtype, ctx=ctx)
+            g = _ND(_np.zeros(d.shape, dtype=_np.dtype(d.dtype)))
             self._grad[ctx] = g
             autograd.mark_variables([d], [g], grad_reqs=self._grad_req)
 

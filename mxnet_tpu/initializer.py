@@ -77,11 +77,15 @@ class Initializer:
         else:
             self._init_default(desc, arr)
 
-    # helpers write via rebind (in-place semantics)
+    # helpers write via rebind (in-place semantics).  The value stays a
+    # HOST numpy array: per-param device transfers over the TPU tunnel
+    # cost ~0.4s each (161 params = the round-1 65s init stall); leaving
+    # the buffer on host lets the first jitted step transfer all params
+    # in one batched XLA argument upload.
     @staticmethod
     def _set(arr, value):
-        arr._rebind(array(np.asarray(value, dtype=np.float32)
-                          ).astype(arr.dtype)._data.reshape(arr.shape))
+        npv = np.asarray(value).astype(np.dtype(arr.dtype)).reshape(arr.shape)
+        arr._rebind(npv)
 
     def _init_zero(self, _, arr):
         self._set(arr, np.zeros(arr.shape))
@@ -141,7 +145,8 @@ class Uniform(Initializer):
         self.scale = scale
 
     def _init_weight(self, _, arr):
-        _random.uniform(-self.scale, self.scale, shape=arr.shape, out=arr)
+        self._set(arr, _random.host_rng().uniform(
+            -self.scale, self.scale, arr.shape))
 
     _init_default = _init_weight
 
@@ -153,7 +158,7 @@ class Normal(Initializer):
         self.sigma = sigma
 
     def _init_weight(self, _, arr):
-        _random.normal(0, self.sigma, shape=arr.shape, out=arr)
+        self._set(arr, _random.host_rng().normal(0, self.sigma, arr.shape))
 
     _init_default = _init_weight
 
@@ -207,9 +212,9 @@ class Xavier(Initializer):
             raise MXNetError("Incorrect factor type")
         scale = np.sqrt(self.magnitude / factor)
         if self.rnd_type == "uniform":
-            _random.uniform(-scale, scale, shape=shape, out=arr)
+            self._set(arr, _random.host_rng().uniform(-scale, scale, shape))
         elif self.rnd_type == "gaussian":
-            _random.normal(0, scale, shape=shape, out=arr)
+            self._set(arr, _random.host_rng().normal(0, scale, shape))
         else:
             raise MXNetError("Unknown random type")
 

@@ -144,8 +144,9 @@ class Accuracy(EvalMetric):
         labels, preds = check_label_shapes(labels, preds, True)
         for label, pred_label in zip(labels, preds):
             pred_np = _as_np(pred_label)
-            if pred_np.ndim > 1 and pred_np.shape[-1 if self.axis == 1 else self.axis] > 1 \
-                    and pred_np.ndim != _as_np(label).ndim:
+            # reference: argmax whenever pred and label shapes differ
+            # (python/mxnet/metric.py Accuracy.update)
+            if pred_np.shape != _as_np(label).shape:
                 pred_np = numpy.argmax(pred_np, axis=self.axis)
             label_np = _as_np(label).astype("int32").flat
             pred_np = pred_np.astype("int32").flat

@@ -97,7 +97,9 @@ class NDArray:
 
     @property
     def T(self):
-        return NDArray(self._data.T, self._ctx)
+        if self.ndim < 2:
+            return _invoke_nd("_copy", [self], {})
+        return _invoke_nd("transpose", [self], {})
 
     # ------------------------------------------------------------------
     # mutation: rebind + version bump (the in-place story)
@@ -140,10 +142,13 @@ class NDArray:
         _engine.get().wait_for_var(self._data)
 
     def astype(self, dtype, copy=True):
-        return NDArray(self._data.astype(dtype_str_to_np(dtype)), self._ctx)
+        if not copy and np.dtype(self._data.dtype) == np.dtype(
+                dtype_str_to_np(dtype) if isinstance(dtype, str) else dtype):
+            return self
+        return _invoke_nd("Cast", [self], {"dtype": dtype})
 
     def copy(self):
-        return NDArray(self._data + 0, self._ctx)
+        return _invoke_nd("_copy", [self], {})
 
     def copyto(self, other):
         import jax
@@ -350,14 +355,40 @@ class NDArray:
             return tuple(k._data if isinstance(k, NDArray) else k for k in key)
         return key
 
+    @staticmethod
+    def _key_has_arrays(key):
+        if _is_jax_array(key) or isinstance(key, np.ndarray):
+            return True
+        if isinstance(key, tuple):
+            return any(_is_jax_array(k) or isinstance(k, np.ndarray)
+                       for k in key)
+        return False
+
     def __getitem__(self, key):
+        from .. import autograd
+
         key = self._conv_index(key)
-        if isinstance(key, (int, np.integer)) or (
-                _is_jax_array(key) and getattr(key, "ndim", 1) == 0):
-            return NDArray(self._data[key], self._ctx)
+        if not self._key_has_arrays(key):
+            return _invoke_nd("_index_static", [self], {"key": key})
+        if not isinstance(key, tuple):
+            return _invoke_nd("_index_array",
+                              [self, NDArray(_jnp().asarray(key))], {})
+        # tuple mixing arrays and slices: not taped (rare path)
+        if autograd.is_recording() and self._tape_ref is not None:
+            raise MXNetError(
+                "mixed array/slice indexing is not differentiable; "
+                "call .detach() first or index with a single array")
         return NDArray(self._data[key], self._ctx)
 
     def __setitem__(self, key, value):
+        from .. import autograd
+
+        if autograd.is_recording() and self._tape_ref is not None:
+            # parity: reference raises on in-place writes to arrays in a
+            # recorded graph (version check in imperative autograd)
+            raise MXNetError(
+                "in-place assignment to an NDArray that is part of a "
+                "recorded computation is not supported; use .detach()")
         jnp = _jnp()
         key = self._conv_index(key)
         if isinstance(value, NDArray):
@@ -526,6 +557,126 @@ def _array_kwarg_order(info):
     return _SIG_CACHE[info.name]
 
 
+# ---------------------------------------------------------------------------
+# eager dispatch: per-op jit cache
+#
+# The reference keeps eager ops cheap with the dependency engine + cached
+# kernels (src/imperative/imperative.cc:89).  The TPU-native counterpart:
+# every eager op call dispatches through a cached jax.jit program keyed on
+# (op, static attrs); XLA's own per-shape executable cache then makes
+# repeated same-shape calls microseconds instead of a fresh trace+compile.
+# Ops with data-dependent output shapes fail jit once and are blacklisted
+# to direct (op-by-op) dispatch.
+# ---------------------------------------------------------------------------
+
+_EAGER_JIT_CACHE = {}
+# ops never worth a jit trace: zero-FLOP indexing where the index value
+# itself would key the cache (every distinct slice = a fresh compile)
+_EAGER_JIT_SKIP = {"_index_static"}
+
+
+def _trace_state_clean():
+    """True when no jax trace (jit/vjp/eval_shape) is in progress."""
+    try:
+        from jax._src.core import trace_state_clean
+    except ImportError:  # future jax: public location
+        from jax.core import trace_state_clean
+    return trace_state_clean()
+
+
+def _freeze_attrs(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(_freeze_attrs(x) for x in v)
+    if isinstance(v, dict):
+        return tuple(sorted((k, _freeze_attrs(x)) for k, x in v.items()))
+    if isinstance(v, np.ndarray):
+        return (v.shape, str(v.dtype), v.tobytes())
+    return v
+
+
+def _eager_apply(info, raw, attrs, rng=None):
+    """Run an op's jax fn, through the per-op jit cache when eager.
+
+    `rng` (a PRNG key) is supplied by the caller when the call is being
+    recorded on the autograd tape, so the backward replay can re-draw the
+    same randomness (Dropout's backward mask must equal the forward's).
+    """
+    import jax
+
+    from .. import random as _random
+
+    def _direct():
+        if rng is not None:
+            _random.push_trace_key(rng)
+            try:
+                return info.fn(*raw, **attrs)
+            finally:
+                _random.pop_trace_key()
+        return info.fn(*raw, **attrs)
+
+    if info.name in _EAGER_JIT_SKIP or not _trace_state_clean():
+        # inside an outer trace (CachedOp / ShardedTrainer / eval_shape):
+        # inline directly, the outer jit owns compilation
+        return _direct()
+    from .. import autograd
+
+    try:
+        # ambient train/predict mode is read inside some op fns (Dropout,
+        # BatchNorm) and baked into the trace — it must key the cache
+        ckey = (info.name, autograd.is_training(), _freeze_attrs(attrs))
+        hash(ckey)
+    except TypeError:
+        return _direct()
+    takes_key = info.uses_rng or rng is not None
+    jitted = _EAGER_JIT_CACHE.get((ckey, takes_key))
+    if jitted is None:
+        fn, static_attrs = info.fn, dict(attrs)
+
+        if takes_key:
+            def _wrapped(key, arrays):
+                _random.push_trace_key(key)
+                try:
+                    return fn(*arrays, **static_attrs)
+                finally:
+                    _random.pop_trace_key()
+        else:
+            # deterministic op: no key argument, no per-call stream split
+            def _wrapped(arrays):
+                return fn(*arrays, **static_attrs)
+
+        jitted = jax.jit(_wrapped)
+        _EAGER_JIT_CACHE[(ckey, takes_key)] = jitted
+    try:
+        if takes_key:
+            return jitted(rng if rng is not None else _random.next_key(),
+                          tuple(raw))
+        return jitted(tuple(raw))
+    except Exception:
+        _EAGER_JIT_CACHE.pop((ckey, takes_key), None)
+        # distinguish "op is not jittable" (fallback succeeds -> blacklist)
+        # from an ordinary user error (fallback raises the real error)
+        result = _direct()
+        _EAGER_JIT_SKIP.add(info.name)
+        return result
+
+
+_f64_warned = False
+
+
+def _warn_f64_downcast():
+    """One-time warning: the reference preserves numpy float64; here it is
+    downcast to float32 (jax x64 is off by default on TPU)."""
+    global _f64_warned
+    if not _f64_warned:
+        _f64_warned = True
+        import warnings
+
+        warnings.warn(
+            "mx.nd.array: float64 input downcast to float32 (TPU-native "
+            "default; pass dtype='float64' with jax_enable_x64 to keep "
+            "double precision)", stacklevel=3)
+
+
 def _invoke_nd(op_name, inputs, attrs, out=None):
     from .. import autograd
 
@@ -544,8 +695,16 @@ def _invoke_nd(op_name, inputs, attrs, out=None):
     nd_inputs = [x if isinstance(x, NDArray) else _as_nd(x) for x in inputs]
     raw = [x._data for x in nd_inputs]
 
+    # a recorded rng-op pins its key so the backward replay re-draws the
+    # identical randomness (Dropout's grad mask == its forward mask)
+    rng = None
+    if info.uses_rng and autograd.is_recording() and info.differentiable:
+        from .. import random as _random
+
+        rng = _random.next_key()
+
     try:
-        result = info.fn(*raw, **attrs)
+        result = _eager_apply(info, raw, attrs, rng=rng)
     except Exception as e:
         raise MXNetError("error in operator %s: %s" % (op_name, e)) from e
 
@@ -570,7 +729,7 @@ def _invoke_nd(op_name, inputs, attrs, out=None):
 
     # autograd tape
     if autograd.is_recording() and info.differentiable:
-        autograd.record_op(info, attrs, nd_inputs, outputs)
+        autograd.record_op(info, attrs, nd_inputs, outputs, rng_key=rng)
 
     if out is not None:
         if isinstance(out, (list, tuple)):
@@ -604,12 +763,14 @@ def array(source_array, ctx=None, dtype=None):
             dtype = np.float32 if npv.dtype.kind in "fiub" and \
                 npv.dtype != np.bool_ else npv.dtype
         else:
+            if npv.dtype == np.float64:
+                _warn_f64_downcast()
             dtype = np.float32 if npv.dtype == np.float64 else npv.dtype
     npv = npv.astype(dtype_str_to_np(dtype) if isinstance(dtype, str) else dtype)
     import jax
 
     ctx = ctx or current_context()
-    return NDArray(jax.device_put(jnp.asarray(npv), ctx.jax_device), ctx)
+    return NDArray(jax.device_put(npv, ctx.jax_device), ctx)
 
 
 def empty(shape, ctx=None, dtype=None):
@@ -649,8 +810,8 @@ def stack(*arrays, axis=0, **kw):
 
 
 def moveaxis(tensor, source, destination):
-    jnp = _jnp()
-    return NDArray(jnp.moveaxis(tensor._data, source, destination), tensor._ctx)
+    return _invoke_nd("moveaxis", [tensor],
+                      {"source": source, "destination": destination})
 
 
 def onehot_encode(indices, out):

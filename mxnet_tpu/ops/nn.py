@@ -312,7 +312,7 @@ def lrn(data, alpha=1e-4, beta=0.75, knorm=2.0, nsize=5, **kw):
 # ---------------------------------------------------------------------------
 
 
-@register("Dropout")
+@register("Dropout", uses_rng=True)
 def dropout(data, p=0.5, mode="training", axes=None, cudnn_off=None, **kw):
     from .. import autograd
 
@@ -600,7 +600,7 @@ def _rnn_cell_step(mode, H):
     return step
 
 
-@register("RNN", num_inputs=-1, num_outputs=_rnn_num_outputs)
+@register("RNN", num_inputs=-1, num_outputs=_rnn_num_outputs, uses_rng=True)
 def rnn(data, parameters, state, state_cell=None, state_size=None,
         num_layers=1, bidirectional=False, mode="lstm", p=0.0,
         state_outputs=False, projection_size=None, lstm_state_clip_min=None,

@@ -16,7 +16,7 @@ def _shape(shape):
     return s if s is not None else (1,)
 
 
-@register("_random_uniform", num_inputs=0, differentiable=False,
+@register("_random_uniform", uses_rng=True, num_inputs=0, differentiable=False,
           aliases=("uniform", "random_uniform"))
 def _uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None, **kw):
     return jax.random.uniform(_random.next_key(), _shape(shape),
@@ -24,42 +24,42 @@ def _uniform(low=0.0, high=1.0, shape=None, dtype="float32", ctx=None, **kw):
                               maxval=pfloat(high, 1.0))
 
 
-@register("_random_normal", num_inputs=0, differentiable=False,
+@register("_random_normal", uses_rng=True, num_inputs=0, differentiable=False,
           aliases=("normal", "random_normal"))
 def _normal(loc=0.0, scale=1.0, shape=None, dtype="float32", ctx=None, **kw):
     return jax.random.normal(_random.next_key(), _shape(shape),
                              dtype=pdtype(dtype)) * pfloat(scale, 1.0) + pfloat(loc, 0.0)
 
 
-@register("_random_randint", num_inputs=0, differentiable=False,
+@register("_random_randint", uses_rng=True, num_inputs=0, differentiable=False,
           aliases=("random_randint",))
 def _randint(low=0, high=1, shape=None, dtype="int32", ctx=None, **kw):
     return jax.random.randint(_random.next_key(), _shape(shape),
                               pint(low, 0), pint(high, 1), dtype=pdtype(dtype))
 
 
-@register("_random_exponential", num_inputs=0, differentiable=False,
+@register("_random_exponential", uses_rng=True, num_inputs=0, differentiable=False,
           aliases=("random_exponential",))
 def _exponential(lam=1.0, shape=None, dtype="float32", ctx=None, **kw):
     return jax.random.exponential(_random.next_key(), _shape(shape),
                                   dtype=pdtype(dtype)) / pfloat(lam, 1.0)
 
 
-@register("_random_gamma", num_inputs=0, differentiable=False,
+@register("_random_gamma", uses_rng=True, num_inputs=0, differentiable=False,
           aliases=("random_gamma",))
 def _gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None, **kw):
     return jax.random.gamma(_random.next_key(), pfloat(alpha, 1.0),
                             _shape(shape), dtype=pdtype(dtype)) * pfloat(beta, 1.0)
 
 
-@register("_random_poisson", num_inputs=0, differentiable=False,
+@register("_random_poisson", uses_rng=True, num_inputs=0, differentiable=False,
           aliases=("random_poisson",))
 def _poisson(lam=1.0, shape=None, dtype="float32", ctx=None, **kw):
     return jax.random.poisson(_random.next_key(), pfloat(lam, 1.0),
                               _shape(shape)).astype(pdtype(dtype))
 
 
-@register("_random_negative_binomial", num_inputs=0, differentiable=False,
+@register("_random_negative_binomial", uses_rng=True, num_inputs=0, differentiable=False,
           aliases=("random_negative_binomial",))
 def _neg_binomial(k=1, p=1.0, shape=None, dtype="float32", ctx=None, **kw):
     lam = jax.random.gamma(_random.next_key(), pint(k, 1), _shape(shape)) \
@@ -68,7 +68,7 @@ def _neg_binomial(k=1, p=1.0, shape=None, dtype="float32", ctx=None, **kw):
                               _shape(shape)).astype(pdtype(dtype))
 
 
-@register("_random_generalized_negative_binomial", num_inputs=0,
+@register("_random_generalized_negative_binomial", uses_rng=True, num_inputs=0,
           differentiable=False, aliases=("random_generalized_negative_binomial",))
 def _gen_neg_binomial(mu=1.0, alpha=1.0, shape=None, dtype="float32", ctx=None, **kw):
     mu, alpha = pfloat(mu, 1.0), pfloat(alpha, 1.0)
@@ -78,7 +78,7 @@ def _gen_neg_binomial(mu=1.0, alpha=1.0, shape=None, dtype="float32", ctx=None, 
                               _shape(shape)).astype(pdtype(dtype))
 
 
-@register("_sample_multinomial", num_inputs=1, differentiable=False,
+@register("_sample_multinomial", uses_rng=True, num_inputs=1, differentiable=False,
           aliases=("sample_multinomial",))
 def _multinomial(data, shape=None, get_prob=False, dtype="int32", **kw):
     s = ptuple(shape, default=())
@@ -96,13 +96,13 @@ def _multinomial(data, shape=None, get_prob=False, dtype="int32", **kw):
     return out.astype(pdtype(dtype))
 
 
-@register("_shuffle", num_inputs=1, differentiable=False, aliases=("shuffle",))
+@register("_shuffle", uses_rng=True, num_inputs=1, differentiable=False, aliases=("shuffle",))
 def _shuffle(data, **kw):
     return jax.random.permutation(_random.next_key(), data, axis=0)
 
 
 # _sample_* row-wise distribution-parameter variants
-@register("_sample_uniform", num_inputs=2, differentiable=False)
+@register("_sample_uniform", uses_rng=True, num_inputs=2, differentiable=False)
 def _sample_uniform(low, high, shape=None, dtype="float32", **kw):
     s = ptuple(shape, default=())
     u = jax.random.uniform(_random.next_key(), low.shape + (s or ()),
@@ -112,7 +112,7 @@ def _sample_uniform(low, high, shape=None, dtype="float32", **kw):
     return ex + u * (exh - ex)
 
 
-@register("_sample_normal", num_inputs=2, differentiable=False)
+@register("_sample_normal", uses_rng=True, num_inputs=2, differentiable=False)
 def _sample_normal(mu, sigma, shape=None, dtype="float32", **kw):
     s = ptuple(shape, default=())
     z = jax.random.normal(_random.next_key(), mu.shape + (s or ()),

@@ -44,11 +44,12 @@ class OpInfo:
 
     __slots__ = (
         "name", "fn", "num_inputs", "num_outputs", "differentiable",
-        "mutate_inputs", "doc", "aliases",
+        "mutate_inputs", "doc", "aliases", "uses_rng",
     )
 
     def __init__(self, name, fn, num_inputs=1, num_outputs=1,
-                 differentiable=True, mutate_inputs=(), doc=None):
+                 differentiable=True, mutate_inputs=(), doc=None,
+                 uses_rng=False):
         self.name = name
         self.fn = fn
         self.num_inputs = num_inputs
@@ -57,6 +58,7 @@ class OpInfo:
         self.mutate_inputs = tuple(mutate_inputs)
         self.doc = doc or (fn.__doc__ if fn else None)
         self.aliases = []
+        self.uses_rng = uses_rng  # fn draws from the framework PRNG stream
 
     def n_outputs(self, attrs=None):
         if callable(self.num_outputs):
@@ -68,12 +70,12 @@ class OpInfo:
 
 
 def register(name, num_inputs=1, num_outputs=1, differentiable=True,
-             mutate_inputs=(), aliases=()):
+             mutate_inputs=(), aliases=(), uses_rng=False):
     """Decorator: register a jax-traceable function as an operator."""
 
     def _reg(fn):
         info = OpInfo(name, fn, num_inputs, num_outputs, differentiable,
-                      mutate_inputs)
+                      mutate_inputs, uses_rng=uses_rng)
         if name in _OP_REGISTRY:
             raise MXNetError("op %r already registered" % name)
         _OP_REGISTRY[name] = info

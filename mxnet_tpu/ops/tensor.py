@@ -134,9 +134,30 @@ def _clip(data, a_min=None, a_max=None, **kw):
     return jnp.clip(data, pfloat(a_min), pfloat(a_max))
 
 
-@register("Cast", aliases=("cast",), differentiable=False)
+@register("Cast", aliases=("cast",))
 def _cast(data, dtype="float32", **kw):
+    # differentiable: grad casts back to the input dtype (reference
+    # treats Cast as identity-backward, src/operator/tensor/elemwise_unary_op.h)
     return data.astype(pdtype(dtype))
+
+
+@register("_index_static")
+def _index_static(data, key=None, **kw):
+    """Basic indexing (ints/slices/Ellipsis/None), taped for autograd —
+    reference records __getitem__ as differentiable slice ops
+    (python/mxnet/ndarray/ndarray.py:507)."""
+    return data[key]
+
+
+@register("_index_array", num_inputs=2)
+def _index_array(data, idx, **kw):
+    """Advanced indexing by an integer/boolean array, taped."""
+    return data[idx]
+
+
+@register("moveaxis")
+def _moveaxis(data, source=0, destination=0, **kw):
+    return jnp.moveaxis(data, source, destination)
 
 
 register("zeros_like", differentiable=False)(lambda data, **kw: jnp.zeros_like(data))

@@ -141,17 +141,25 @@ class ShardedTrainer:
                 for p in self._params:
                     p.data()
             except Exception:
-                import numpy as _np
-                import jax.numpy as jnp
-
+                # finish deferred shapes by abstract evaluation — no
+                # device compute (the round-1 eager warm-up was a ~100s
+                # compile storm on TPU)
                 with _ag.pause():
-                    # warm up on single-device host copies (inputs may
-                    # already be mesh-sharded)
-                    self.net(*[NDArray(jnp.asarray(_np.asarray(
-                        x._data if isinstance(x, NDArray) else x)))
-                        for x in example_inputs])
-        self.param_arrays = [p.data()._data for p in self._params]
+                    _block_mod._abstract_eval_forward(
+                        self.net, list(example_inputs))
+        # one batched host→HBM upload (params may still be host numpy
+        # from the initializer); also keeps the jit signature stable so
+        # the step compiles exactly once.  Mesh runs re-place below.
+        arrays = [p.data()._data for p in self._params]
+        if self.mesh is None:
+            # explicit device => committed arrays; jit outputs are also
+            # committed, so the step's input signature never changes and
+            # XLA compiles the program exactly once
+            dev = jax.devices()[0]
+            arrays = list(jax.device_put(arrays, dev))
+        self.param_arrays = arrays
         self._trainable = [p.grad_req != "null" for p in self._params]
+        self._param_index = {id(p): i for i, p in enumerate(self._params)}
         train_arrays = [a for a, t in zip(self.param_arrays, self._trainable)
                         if t]
         if self._opt_name == "sgd":
@@ -160,6 +168,11 @@ class ShardedTrainer:
             self.opt_state = adam_init(train_arrays)
         if self.mesh is not None:
             self._shard_params(jax, NamedSharding, P)
+        else:
+            # commit optimizer state like the params (see above)
+            dev = jax.devices()[0]
+            self.opt_state = jax.tree_util.tree_map(
+                lambda a: jax.device_put(a, dev), self.opt_state)
 
     # -- sharding placement ----------------------------------------------
     def _param_sharding(self, P, NamedSharding, p, arr):
@@ -244,10 +257,10 @@ class ShardedTrainer:
                 autograd.set_training(prev_t)
                 _random.pop_trace_key()
 
-        meta = {}
         opt_name = self._opt_name
         lr, wd, momentum = self._lr, self._wd, self._momentum
         beta1, beta2, eps = self._beta1, self._beta2, self._eps
+        pidx = self._param_index
 
         def step(param_arrays, opt_state, inputs, label, rng):
             def lf(train_params):
@@ -266,8 +279,6 @@ class ShardedTrainer:
                             if trainable[i]]
             (loss, aux), grads = jax.value_and_grad(lf, has_aux=True)(
                 train_params)
-            meta["aux_params"] = [p for (p, _v) in aux]
-            aux_vals = [v for (_p, v) in aux]
             if opt_name == "sgd":
                 new_train, new_state = _sgd_update(train_params, grads,
                                                    opt_state, lr, momentum, wd)
@@ -283,11 +294,16 @@ class ShardedTrainer:
                     ti += 1
                 else:
                     new_params.append(p)
-            return new_params, new_state, loss, aux_vals
+            # moving-stat (aux) updates fused into the same program —
+            # cast back to storage dtype inside the jit, so no per-aux
+            # eager dispatch/compile happens on the host afterwards
+            for p, v in aux:
+                i = pidx[id(p)]
+                new_params[i] = v.astype(new_params[i].dtype)
+            return new_params, new_state, loss
 
         donate = (0, 1) if self._donate else ()
         self._step_fn = jax.jit(step, donate_argnums=donate)
-        self._meta = meta
 
     def step(self, inputs, label):
         """Run one compiled train step. inputs: list of NDArray/jax arrays
@@ -301,13 +317,8 @@ class ShardedTrainer:
         if self._step_fn is None:
             self._build(len(raw_in))
         rng = _random.next_key()
-        self.param_arrays, self.opt_state, loss, aux_vals = self._step_fn(
+        self.param_arrays, self.opt_state, loss = self._step_fn(
             self.param_arrays, self.opt_state, tuple(raw_in), raw_label, rng)
-        # moving-stat params updated outside the diff'd path
-        for p, v in zip(self._meta.get("aux_params", []), aux_vals):
-            idx = self._params.index(p)
-            self.param_arrays[idx] = v if not hasattr(v, "astype") else \
-                v.astype(self.param_arrays[idx].dtype)
         return loss
 
     def sync_to_net(self):

@@ -33,6 +33,22 @@ def seed(seed_state, ctx="all"):
 
     st = _get_state()
     st.key = jax.random.PRNGKey(int(seed_state))
+    st.np_rng = None
+    st.np_seed = int(seed_state)
+
+
+def host_rng():
+    """Host-side numpy RNG sharing the framework seed.
+
+    Weight initialization runs here (pure host work + one device_put per
+    param) instead of launching a device sampling program per parameter —
+    the reference initializes on CPU too (python/mxnet/initializer.py)."""
+    import numpy as _np
+
+    st = _get_state()
+    if getattr(st, "np_rng", None) is None:
+        st.np_rng = _np.random.RandomState(getattr(st, "np_seed", 0))
+    return st.np_rng
 
 
 def next_key():
@@ -43,6 +59,13 @@ def next_key():
     if getattr(st, "trace_keys", None):
         st.trace_keys[-1], sub = jax.random.split(st.trace_keys[-1])
         return sub
+    from .ndarray.ndarray import _trace_state_clean
+
+    if not _trace_state_clean():
+        # inside a foreign trace with no trace key pushed: derive a key
+        # without storing a tracer into the global stream
+        st.fold_count = getattr(st, "fold_count", 0) + 1
+        return jax.random.fold_in(st.key, st.fold_count)
     st.key, sub = jax.random.split(st.key)
     return sub
 

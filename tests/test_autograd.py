@@ -141,3 +141,44 @@ def test_get_symbol():
         y = nd.exp(x) * 2
     sym = autograd.get_symbol(y)
     assert sym is not None
+
+
+def test_view_ops_are_taped():
+    """Views/copies must carry gradients (reference records slice/_copy/
+    transpose/Cast as differentiable ops)."""
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    x.attach_grad()
+    with autograd.record():
+        y = (x[0] * 2).sum() + (x.T * 3).sum() + x.copy().sum() \
+            + x.astype("float32").sum()
+    y.backward()
+    g = x.grad.asnumpy()
+    expected = np.full((2, 3), 3 + 1 + 1, dtype=np.float32)
+    expected[0] += 2
+    assert np.allclose(g, expected), g
+
+
+def test_array_index_taped():
+    x = nd.array(np.arange(8, dtype=np.float32))
+    x.attach_grad()
+    idx = nd.array(np.array([1, 3], dtype=np.int32))
+    with autograd.record():
+        y = x[idx].sum()
+    y.backward()
+    g = x.grad.asnumpy()
+    exp = np.zeros(8, np.float32)
+    exp[[1, 3]] = 1
+    assert np.allclose(g, exp), g
+
+
+def test_setitem_in_record_raises():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * 2
+        try:
+            x[0] = 5.0
+            raised = False
+        except mx.MXNetError:
+            raised = True
+    assert raised

@@ -66,3 +66,21 @@ def test_resnet_hybridize_and_train_step():
     loss.backward()
     trainer.step(2)
     assert np.isfinite(loss.asnumpy()).all()
+
+
+def test_eager_resnet50_forward_is_fast():
+    """The per-op jit cache must keep un-hybridized (eager) dispatch usable:
+    one warm bs1 ResNet-50 forward in well under a second (round-1 regression:
+    ~97s per forward without the cache)."""
+    import time
+
+    net = vision.resnet50_v1(classes=10)
+    net.initialize()
+    x = nd.array(np.random.rand(1, 3, 224, 224).astype(np.float32))
+    out = net(x)          # cold: fills the per-op cache
+    out.wait_to_read()
+    t0 = time.time()
+    out = net(x)
+    out.wait_to_read()
+    warm = time.time() - t0
+    assert warm < 5.0, "warm eager ResNet-50 forward took %.2fs" % warm
