@@ -1,10 +1,28 @@
 """Gluon contrib (reference parity: python/mxnet/gluon/contrib/ —
 Concurrent/HybridConcurrent/Identity, SyncBatchNorm wrapper)."""
-from ..model_zoo.vision.squeezenet import HybridConcurrent  # noqa: F401
 from ..block import HybridBlock
 from .. import nn as _nn
 
 __all__ = ["HybridConcurrent", "Concurrent", "Identity", "SyncBatchNorm"]
+
+
+class HybridConcurrent(HybridBlock):
+    """Run child blocks on the same input and concat the outputs
+    (reference: gluon/contrib/nn/basic_layers.py HybridConcurrent)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+        self._order = []
+
+    def add(self, *blocks):
+        for block in blocks:
+            self.register_child(block)
+            self._order.append(block)
+
+    def hybrid_forward(self, F, x):
+        outs = [block(x) for block in self._order]
+        return F.concat(*outs, dim=self.axis)
 
 
 class Concurrent(HybridConcurrent):

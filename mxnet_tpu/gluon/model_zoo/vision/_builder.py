@@ -1,0 +1,63 @@
+"""Shared builders for the vision model zoo.
+
+The zoo is expressed as data: per-architecture spec tables interpreted by
+a handful of composition helpers, instead of hand-unrolled layer lists.
+Behavioral parity targets the reference zoo
+(python/mxnet/gluon/model_zoo/vision/) — same factory names, same
+`.features` / `.output` split, same classifier head shapes — but the
+construction code is original and TPU-trivial: every model lowers to one
+XLA program under hybridize()/jit.
+"""
+from __future__ import annotations
+
+from ... import nn
+from ...block import HybridBlock
+
+__all__ = ["conv_block", "Classifier", "stack"]
+
+
+def conv_block(channels, kernel, stride=1, pad=None, groups=1, act="relu",
+               use_bn=True, bias=False, bn_eps=1e-5, relu6=False):
+    """conv → [BN] → [activation] as one HybridSequential.
+
+    `pad=None` means SAME-style padding for odd kernels (k//2).
+    `relu6` clips the activation at 6 (mobilenet family).
+    """
+    if pad is None:
+        pad = kernel // 2
+    seq = nn.HybridSequential(prefix="")
+    seq.add(nn.Conv2D(channels, kernel_size=kernel, strides=stride,
+                      padding=pad, groups=groups, use_bias=bias))
+    if use_bn:
+        seq.add(nn.BatchNorm(epsilon=bn_eps))
+    if act:
+        if relu6:
+            seq.add(nn.HybridLambda(
+                lambda F, x: F.clip(F.relu(x), 0.0, 6.0), prefix="relu6_"))
+        else:
+            seq.add(nn.Activation(act))
+    return seq
+
+
+def stack(*layers):
+    """Compose layers/blocks into a HybridSequential."""
+    seq = nn.HybridSequential(prefix="")
+    for layer in layers:
+        seq.add(layer)
+    return seq
+
+
+class Classifier(HybridBlock):
+    """features → output, the zoo-wide network shape.
+
+    Subclasses (or factories) fill `self.features` (a HybridSequential)
+    and `self.output` (usually Dense).  Matches the reference zoo's
+    attribute contract so fine-tuning code that swaps `.output` works.
+    """
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+
+    def hybrid_forward(self, F, x):
+        x = self.features(x)
+        return self.output(x)

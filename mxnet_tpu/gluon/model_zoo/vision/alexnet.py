@@ -1,43 +1,41 @@
-"""AlexNet (reference parity: gluon/model_zoo/vision/alexnet.py)."""
-from ...block import HybridBlock
+"""AlexNet (Krizhevsky et al.).
+
+Behavioral parity: python/mxnet/gluon/model_zoo/vision/alexnet.py; the
+conv trunk is a spec table interpreted in one loop.
+"""
+from __future__ import annotations
+
 from ... import nn
+from ._builder import Classifier
 
 __all__ = ["AlexNet", "alexnet"]
 
+# (channels, kernel, stride, pad, pool_after?)
+_TRUNK = [(64, 11, 4, 2, True), (192, 5, 1, 2, True),
+          (384, 3, 1, 1, False), (256, 3, 1, 1, False),
+          (256, 3, 1, 1, True)]
 
-class AlexNet(HybridBlock):
+
+class AlexNet(Classifier):
     def __init__(self, classes=1000, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            with self.features.name_scope():
-                self.features.add(nn.Conv2D(64, kernel_size=11, strides=4,
-                                            padding=2, activation="relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-                self.features.add(nn.Conv2D(192, kernel_size=5, padding=2,
-                                            activation="relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-                self.features.add(nn.Conv2D(384, kernel_size=3, padding=1,
-                                            activation="relu"))
-                self.features.add(nn.Conv2D(256, kernel_size=3, padding=1,
-                                            activation="relu"))
-                self.features.add(nn.Conv2D(256, kernel_size=3, padding=1,
-                                            activation="relu"))
-                self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-                self.features.add(nn.Flatten())
-                self.features.add(nn.Dense(4096, activation="relu"))
-                self.features.add(nn.Dropout(0.5))
-                self.features.add(nn.Dense(4096, activation="relu"))
-                self.features.add(nn.Dropout(0.5))
+            f = nn.HybridSequential(prefix="")
+            for ch, k, s, p, pool in _TRUNK:
+                f.add(nn.Conv2D(ch, kernel_size=k, strides=s, padding=p,
+                                activation="relu"))
+                if pool:
+                    f.add(nn.MaxPool2D(pool_size=3, strides=2))
+            f.add(nn.Flatten())
+            for _ in range(2):
+                f.add(nn.Dense(4096, activation="relu"))
+                f.add(nn.Dropout(rate=0.5))
+            self.features = f
             self.output = nn.Dense(classes)
-
-    def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
 
 
 def alexnet(pretrained=False, ctx=None, root=None, **kwargs):
+    """Parity: model_zoo.vision.alexnet."""
     net = AlexNet(**kwargs)
     if pretrained:
         from ..model_store import get_model_file

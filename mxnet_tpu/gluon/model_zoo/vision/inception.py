@@ -1,161 +1,151 @@
-"""Inception V3 (reference parity: gluon/model_zoo/vision/inception.py)."""
+"""Inception v3 (Szegedy et al. 1512.00567).
+
+Behavioral parity: python/mxnet/gluon/model_zoo/vision/inception.py.
+Each inception module is a *branch table*: a list of conv-chain specs
+(or a pool marker) concatenated on channels — one generic module class
+interprets every variant (A/B/C/D/E), instead of one builder per letter.
+"""
+from __future__ import annotations
+
 from ...block import HybridBlock
 from ... import nn
-from .squeezenet import HybridConcurrent
+from ._builder import Classifier
 
 __all__ = ["Inception3", "inception_v3"]
 
 
-def _make_basic_conv(**kwargs):
-    out = nn.HybridSequential(prefix="")
-    out.add(nn.Conv2D(use_bias=False, **kwargs))
-    out.add(nn.BatchNorm(epsilon=0.001))
-    out.add(nn.Activation("relu"))
-    return out
+def _conv(ch, kernel, stride=1, pad=0):
+    """conv-BN-relu with possibly asymmetric kernels (e.g. 1x7)."""
+    seq = nn.HybridSequential(prefix="")
+    seq.add(nn.Conv2D(ch, kernel_size=kernel, strides=stride, padding=pad,
+                      use_bias=False))
+    seq.add(nn.BatchNorm(epsilon=0.001))
+    seq.add(nn.Activation("relu"))
+    return seq
 
 
-def _make_branch(use_pool, *conv_settings):
-    out = nn.HybridSequential(prefix="")
-    if use_pool == "avg":
-        out.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
-    elif use_pool == "max":
-        out.add(nn.MaxPool2D(pool_size=3, strides=2))
-    setting_names = ["channels", "kernel_size", "strides", "padding"]
-    for setting in conv_settings:
-        kwargs = {}
-        for i, value in enumerate(setting):
-            if value is not None:
-                kwargs[setting_names[i]] = value
-        out.add(_make_basic_conv(**kwargs))
-    return out
+class _Module(HybridBlock):
+    """Concat of branches; each branch is a chain of conv specs
+    (ch, kernel, stride, pad) or the string 'avgpool'/'maxpool'."""
 
-
-def _make_A(pool_features, prefix):
-    out = HybridConcurrent(axis=1, prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (64, 1, None, None)))
-        out.add(_make_branch(None, (48, 1, None, None), (64, 5, None, 2)))
-        out.add(_make_branch(None, (64, 1, None, None), (96, 3, None, 1),
-                             (96, 3, None, 1)))
-        out.add(_make_branch("avg", (pool_features, 1, None, None)))
-    return out
-
-
-def _make_B(prefix):
-    out = HybridConcurrent(axis=1, prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (384, 3, 2, None)))
-        out.add(_make_branch(None, (64, 1, None, None), (96, 3, None, 1),
-                             (96, 3, 2, None)))
-        out.add(_make_branch("max"))
-    return out
-
-
-def _make_C(channels_7x7, prefix):
-    out = HybridConcurrent(axis=1, prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (192, 1, None, None)))
-        out.add(_make_branch(None, (channels_7x7, 1, None, None),
-                             (channels_7x7, (1, 7), None, (0, 3)),
-                             (192, (7, 1), None, (3, 0))))
-        out.add(_make_branch(None, (channels_7x7, 1, None, None),
-                             (channels_7x7, (7, 1), None, (3, 0)),
-                             (channels_7x7, (1, 7), None, (0, 3)),
-                             (channels_7x7, (7, 1), None, (3, 0)),
-                             (192, (1, 7), None, (0, 3))))
-        out.add(_make_branch("avg", (192, 1, None, None)))
-    return out
-
-
-def _make_D(prefix):
-    out = HybridConcurrent(axis=1, prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (192, 1, None, None), (320, 3, 2, None)))
-        out.add(_make_branch(None, (192, 1, None, None),
-                             (192, (1, 7), None, (0, 3)),
-                             (192, (7, 1), None, (3, 0)),
-                             (192, 3, 2, None)))
-        out.add(_make_branch("max"))
-    return out
-
-
-class _SplitConcat(HybridBlock):
-    def __init__(self, stem, b1, b2, **kwargs):
+    def __init__(self, branches, **kwargs):
         super().__init__(**kwargs)
-        self.stem = stem
-        self.b1 = b1
-        self.b2 = b2
+        self._n = len(branches)
+        with self.name_scope():
+            for i, chain in enumerate(branches):
+                seq = nn.HybridSequential(prefix="branch%d_" % i)
+                for step in chain:
+                    if step == "avgpool":
+                        seq.add(nn.AvgPool2D(pool_size=3, strides=1,
+                                             padding=1))
+                    elif step == "maxpool":
+                        seq.add(nn.MaxPool2D(pool_size=3, strides=2))
+                    else:
+                        seq.add(_conv(*step))
+                setattr(self, "branch%d" % i, seq)
 
     def hybrid_forward(self, F, x):
-        x = self.stem(x) if self.stem is not None else x
-        return F.Concat(self.b1(x), self.b2(x), dim=1)
+        outs = [getattr(self, "branch%d" % i)(x) for i in range(self._n)]
+        return F.concat(*outs, dim=1)
 
 
-def _make_E(prefix):
-    out = HybridConcurrent(axis=1, prefix=prefix)
-    with out.name_scope():
-        out.add(_make_branch(None, (320, 1, None, None)))
-        out.add(_SplitConcat(_make_basic_conv(channels=384, kernel_size=1),
-                             _make_basic_conv(channels=384, kernel_size=(1, 3),
-                                              padding=(0, 1)),
-                             _make_basic_conv(channels=384, kernel_size=(3, 1),
-                                              padding=(1, 0))))
-        out.add(_SplitConcat(
-            nn.HybridSequential()
-            if False else _make_branch(None, (448, 1, None, None),
-                                       (384, 3, None, 1))[0]
-            if False else _stack(_make_basic_conv(channels=448, kernel_size=1),
-                                 _make_basic_conv(channels=384, kernel_size=3,
-                                                  padding=1)),
-            _make_basic_conv(channels=384, kernel_size=(1, 3), padding=(0, 1)),
-            _make_basic_conv(channels=384, kernel_size=(3, 1), padding=(1, 0))))
-        out.add(_make_branch("avg", (192, 1, None, None)))
-    return out
+def _a(pool_ch):  # 35x35 modules
+    return _Module([
+        [(64, 1)],
+        [(48, 1), (64, 5, 1, 2)],
+        [(64, 1), (96, 3, 1, 1), (96, 3, 1, 1)],
+        ["avgpool", (pool_ch, 1)],
+    ])
 
 
-def _stack(*blocks):
-    out = nn.HybridSequential(prefix="")
-    for b in blocks:
-        out.add(b)
-    return out
+def _b():  # 35->17 reduction
+    return _Module([
+        [(384, 3, 2)],
+        [(64, 1), (96, 3, 1, 1), (96, 3, 2)],
+        ["maxpool"],
+    ])
 
 
-class Inception3(HybridBlock):
+def _c(mid):  # 17x17 modules with factorized 7x7
+    return _Module([
+        [(192, 1)],
+        [(mid, 1), (mid, (1, 7), 1, (0, 3)), (192, (7, 1), 1, (3, 0))],
+        [(mid, 1), (mid, (7, 1), 1, (3, 0)), (mid, (1, 7), 1, (0, 3)),
+         (mid, (7, 1), 1, (3, 0)), (192, (1, 7), 1, (0, 3))],
+        ["avgpool", (192, 1)],
+    ])
+
+
+def _d():  # 17->8 reduction
+    return _Module([
+        [(192, 1), (320, 3, 2)],
+        [(192, 1), (192, (1, 7), 1, (0, 3)), (192, (7, 1), 1, (3, 0)),
+         (192, 3, 2)],
+        ["maxpool"],
+    ])
+
+
+class _SplitBranch(HybridBlock):
+    """E-module sub-branch: a stem then two parallel convs concatenated
+    (the 3x3 -> {1x3, 3x1} expansion)."""
+
+    def __init__(self, stem_specs, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.stem = nn.HybridSequential(prefix="stem_")
+            for spec in stem_specs:
+                self.stem.add(_conv(*spec))
+            self.left = _conv(384, (1, 3), 1, (0, 1))
+            self.right = _conv(384, (3, 1), 1, (1, 0))
+
+    def hybrid_forward(self, F, x):
+        x = self.stem(x)
+        return F.concat(self.left(x), self.right(x), dim=1)
+
+
+class _E(HybridBlock):  # 8x8 modules
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.branch0 = _conv(320, 1)
+            self.branch1 = _SplitBranch([(384, 1)])
+            self.branch2 = _SplitBranch([(448, 1), (384, 3, 1, 1)])
+            self.branch3 = nn.HybridSequential(prefix="branch3_")
+            self.branch3.add(nn.AvgPool2D(pool_size=3, strides=1, padding=1))
+            self.branch3.add(_conv(192, 1))
+
+    def hybrid_forward(self, F, x):
+        return F.concat(self.branch0(x), self.branch1(x), self.branch2(x),
+                        self.branch3(x), dim=1)
+
+
+class Inception3(Classifier):
     def __init__(self, classes=1000, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            self.features.add(_make_basic_conv(channels=32, kernel_size=3,
-                                               strides=2))
-            self.features.add(_make_basic_conv(channels=32, kernel_size=3))
-            self.features.add(_make_basic_conv(channels=64, kernel_size=3,
-                                               padding=1))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-            self.features.add(_make_basic_conv(channels=80, kernel_size=1))
-            self.features.add(_make_basic_conv(channels=192, kernel_size=3))
-            self.features.add(nn.MaxPool2D(pool_size=3, strides=2))
-            self.features.add(_make_A(32, "A1_"))
-            self.features.add(_make_A(64, "A2_"))
-            self.features.add(_make_A(64, "A3_"))
-            self.features.add(_make_B("B_"))
-            self.features.add(_make_C(128, "C1_"))
-            self.features.add(_make_C(160, "C2_"))
-            self.features.add(_make_C(160, "C3_"))
-            self.features.add(_make_C(192, "C4_"))
-            self.features.add(_make_D("D_"))
-            self.features.add(_make_E("E1_"))
-            self.features.add(_make_E("E2_"))
-            self.features.add(nn.AvgPool2D(pool_size=8))
-            self.features.add(nn.Dropout(0.5))
+            f = nn.HybridSequential(prefix="")
+            f.add(_conv(32, 3, 2))
+            f.add(_conv(32, 3))
+            f.add(_conv(64, 3, 1, 1))
+            f.add(nn.MaxPool2D(pool_size=3, strides=2))
+            f.add(_conv(80, 1))
+            f.add(_conv(192, 3))
+            f.add(nn.MaxPool2D(pool_size=3, strides=2))
+            for pool_ch in (32, 64, 64):
+                f.add(_a(pool_ch))
+            f.add(_b())
+            for mid in (128, 160, 160, 192):
+                f.add(_c(mid))
+            f.add(_d())
+            f.add(_E(), _E())
+            f.add(nn.AvgPool2D(pool_size=8))
+            f.add(nn.Dropout(0.5))
+            self.features = f
             self.output = nn.Dense(classes)
-
-    def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
 
 
 def inception_v3(pretrained=False, ctx=None, root=None, **kwargs):
+    """Parity: model_zoo.vision.inception_v3 (input 299x299)."""
     net = Inception3(**kwargs)
     if pretrained:
         from ..model_store import get_model_file

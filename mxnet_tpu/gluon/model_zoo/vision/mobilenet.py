@@ -1,169 +1,148 @@
-"""MobileNet v1/v2 (reference parity: gluon/model_zoo/vision/mobilenet.py)."""
+"""MobileNet v1 (1704.04861) and v2 (1801.04381), width multipliers
+1.0/0.75/0.5/0.25.
+
+Behavioral parity: python/mxnet/gluon/model_zoo/vision/mobilenet.py.
+v1 is a (channels, stride) table of depthwise-separable units; v2 a
+(expansion, channels, stride) table of inverted residuals.
+"""
+from __future__ import annotations
+
 from ...block import HybridBlock
 from ... import nn
+from ._builder import Classifier, conv_block
 
 __all__ = ["MobileNet", "MobileNetV2", "mobilenet1_0", "mobilenet0_75",
            "mobilenet0_5", "mobilenet0_25", "mobilenet_v2_1_0",
            "mobilenet_v2_0_75", "mobilenet_v2_0_5", "mobilenet_v2_0_25",
            "get_mobilenet", "get_mobilenet_v2"]
 
-
-def _add_conv(out, channels=1, kernel=1, stride=1, pad=0, num_group=1,
-              active=True, relu6=False):
-    out.add(nn.Conv2D(channels, kernel, stride, pad, groups=num_group,
-                      use_bias=False))
-    out.add(nn.BatchNorm(scale=True))
-    if active:
-        out.add(RELU6() if relu6 else nn.Activation("relu"))
-
-
-class RELU6(HybridBlock):
-    def hybrid_forward(self, F, x):
-        return F.clip(x, 0, 6)
-
-
-def _add_conv_dw(out, dw_channels, channels, stride, relu6=False):
-    _add_conv(out, channels=dw_channels, kernel=3, stride=stride, pad=1,
-              num_group=dw_channels, relu6=relu6)
-    _add_conv(out, channels=channels, relu6=relu6)
+# v1: (output channels @ multiplier 1.0, stride) per separable unit
+_V1_UNITS = [(64, 1), (128, 2), (128, 1), (256, 2), (256, 1), (512, 2),
+             (512, 1), (512, 1), (512, 1), (512, 1), (512, 1), (1024, 2),
+             (1024, 1)]
+# v2: (expansion t, output channels, stride) per inverted-residual unit
+_V2_UNITS = [(1, 16, 1),
+             (6, 24, 2), (6, 24, 1),
+             (6, 32, 2), (6, 32, 1), (6, 32, 1),
+             (6, 64, 2), (6, 64, 1), (6, 64, 1), (6, 64, 1),
+             (6, 96, 1), (6, 96, 1), (6, 96, 1),
+             (6, 160, 2), (6, 160, 1), (6, 160, 1),
+             (6, 320, 1)]
 
 
-class LinearBottleneck(HybridBlock):
-    def __init__(self, in_channels, channels, t, stride, **kwargs):
+def _sep_unit(in_ch, out_ch, stride):
+    """Depthwise 3x3 + pointwise 1x1 (the v1 building block)."""
+    from ._builder import stack
+
+    return stack(conv_block(in_ch, 3, stride, groups=in_ch),
+                 conv_block(out_ch, 1))
+
+
+class _InvertedResidual(HybridBlock):
+    """v2 unit: 1x1 expand (relu6) -> 3x3 depthwise (relu6) -> 1x1
+    project (linear); identity add when stride 1 and widths match."""
+
+    def __init__(self, expansion, in_ch, out_ch, stride, **kwargs):
         super().__init__(**kwargs)
-        self.use_shortcut = stride == 1 and in_channels == channels
+        self._residual = stride == 1 and in_ch == out_ch
+        mid = in_ch * expansion
         with self.name_scope():
-            self.out = nn.HybridSequential()
-            _add_conv(self.out, in_channels * t, relu6=True)
-            _add_conv(self.out, in_channels * t, kernel=3, stride=stride,
-                      pad=1, num_group=in_channels * t, relu6=True)
-            _add_conv(self.out, channels, active=False, relu6=True)
+            body = nn.HybridSequential(prefix="")
+            if expansion != 1:
+                body.add(conv_block(mid, 1, relu6=True))
+            body.add(conv_block(mid, 3, stride, groups=mid, relu6=True))
+            body.add(conv_block(out_ch, 1, act=None))
+            self.body = body
 
     def hybrid_forward(self, F, x):
-        out = self.out(x)
-        if self.use_shortcut:
-            out = out + x
-        return out
+        out = self.body(x)
+        return x + out if self._residual else out
 
 
-class MobileNet(HybridBlock):
+def _scaled(ch, multiplier):
+    return max(1, int(ch * multiplier))
+
+
+class MobileNet(Classifier):
     def __init__(self, multiplier=1.0, classes=1000, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            with self.features.name_scope():
-                _add_conv(self.features, channels=int(32 * multiplier),
-                          kernel=3, pad=1, stride=2)
-                dw_channels = [int(x * multiplier) for x in
-                               [32, 64] + [128] * 2 + [256] * 2
-                               + [512] * 6 + [1024]]
-                channels = [int(x * multiplier) for x in
-                            [64] + [128] * 2 + [256] * 2 + [512] * 6
-                            + [1024] * 2]
-                strides = [1, 2] * 3 + [1] * 5 + [2, 1]
-                for dwc, c, s in zip(dw_channels, channels, strides):
-                    _add_conv_dw(self.features, dw_channels=dwc, channels=c,
-                                 stride=s)
-                self.features.add(nn.GlobalAvgPool2D())
-                self.features.add(nn.Flatten())
+            f = nn.HybridSequential(prefix="")
+            in_ch = _scaled(32, multiplier)
+            f.add(conv_block(in_ch, 3, 2))
+            for ch, stride in _V1_UNITS:
+                out_ch = _scaled(ch, multiplier)
+                f.add(_sep_unit(in_ch, out_ch, stride))
+                in_ch = out_ch
+            f.add(nn.GlobalAvgPool2D())
+            f.add(nn.Flatten())
+            self.features = f
             self.output = nn.Dense(classes)
 
-    def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
 
-
-class MobileNetV2(HybridBlock):
+class MobileNetV2(Classifier):
     def __init__(self, multiplier=1.0, classes=1000, **kwargs):
         super().__init__(**kwargs)
         with self.name_scope():
-            self.features = nn.HybridSequential(prefix="features_")
-            with self.features.name_scope():
-                _add_conv(self.features, int(32 * multiplier), kernel=3,
-                          stride=2, pad=1, relu6=True)
-                in_channels_group = [int(x * multiplier) for x in
-                                     [32] + [16] + [24] * 2 + [32] * 3
-                                     + [64] * 4 + [96] * 3 + [160] * 3]
-                channels_group = [int(x * multiplier) for x in
-                                  [16] + [24] * 2 + [32] * 3 + [64] * 4
-                                  + [96] * 3 + [160] * 3 + [320]]
-                ts = [1] + [6] * 16
-                strides = [1, 2] * 2 + [1, 1, 2] + [1] * 6 + [2] + [1] * 3
-                for in_c, c, t, s in zip(in_channels_group, channels_group,
-                                         ts, strides):
-                    self.features.add(LinearBottleneck(
-                        in_channels=in_c, channels=c, t=t, stride=s))
-                last_channels = int(1280 * multiplier) if multiplier > 1.0 \
-                    else 1280
-                _add_conv(self.features, last_channels, relu6=True)
-                self.features.add(nn.GlobalAvgPool2D())
-            self.output = nn.HybridSequential(prefix="output_")
-            with self.output.name_scope():
-                self.output.add(
-                    nn.Conv2D(classes, 1, use_bias=False, prefix="pred_"),
-                    nn.Flatten())
-
-    def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
+            f = nn.HybridSequential(prefix="")
+            in_ch = _scaled(32, multiplier)
+            f.add(conv_block(in_ch, 3, 2, relu6=True))
+            for t, ch, stride in _V2_UNITS:
+                out_ch = _scaled(ch, multiplier)
+                f.add(_InvertedResidual(t, in_ch, out_ch, stride))
+                in_ch = out_ch
+            last = _scaled(1280, multiplier) if multiplier > 1.0 else 1280
+            f.add(conv_block(last, 1, relu6=True))
+            f.add(nn.GlobalAvgPool2D())
+            self.features = f
+            # v2 head: 1x1 conv classifier then flatten
+            out = nn.HybridSequential(prefix="output_")
+            with out.name_scope():
+                out.add(nn.Conv2D(classes, 1, use_bias=False, prefix="pred_"))
+                out.add(nn.Flatten())
+            self.output = out
 
 
-def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None, **kwargs):
+def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None,
+                  **kwargs):
+    """Parity: model_zoo.vision.get_mobilenet."""
     net = MobileNet(multiplier, **kwargs)
     if pretrained:
         from ..model_store import get_model_file
 
-        version_suffix = "{0:.2f}".format(multiplier)
-        if version_suffix in ("1.00", "0.50"):
-            version_suffix = version_suffix[:-1]
-        net.load_parameters(get_model_file("mobilenet%s" % version_suffix,
-                                           root=root), ctx=ctx)
+        ver = ("%.2f" % multiplier).rstrip("0").rstrip(".")
+        net.load_parameters(get_model_file("mobilenet%s" % ver, root=root),
+                            ctx=ctx)
     return net
 
 
 def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, root=None,
                      **kwargs):
+    """Parity: model_zoo.vision.get_mobilenet_v2."""
     net = MobileNetV2(multiplier, **kwargs)
     if pretrained:
         from ..model_store import get_model_file
 
-        version_suffix = "{0:.2f}".format(multiplier)
-        if version_suffix in ("1.00", "0.50"):
-            version_suffix = version_suffix[:-1]
-        net.load_parameters(get_model_file("mobilenetv2_%s" % version_suffix,
-                                           root=root), ctx=ctx)
+        ver = ("%.2f" % multiplier).rstrip("0").rstrip(".")
+        net.load_parameters(get_model_file("mobilenetv2_%s" % ver, root=root),
+                            ctx=ctx)
     return net
 
 
-def mobilenet1_0(**kwargs):
-    return get_mobilenet(1.0, **kwargs)
+def _factory(maker, multiplier, name):
+    def make(**kwargs):
+        return maker(multiplier, **kwargs)
+
+    make.__name__ = name
+    make.__doc__ = "%s at width multiplier %s." % (name, multiplier)
+    return make
 
 
-def mobilenet0_75(**kwargs):
-    return get_mobilenet(0.75, **kwargs)
-
-
-def mobilenet0_5(**kwargs):
-    return get_mobilenet(0.5, **kwargs)
-
-
-def mobilenet0_25(**kwargs):
-    return get_mobilenet(0.25, **kwargs)
-
-
-def mobilenet_v2_1_0(**kwargs):
-    return get_mobilenet_v2(1.0, **kwargs)
-
-
-def mobilenet_v2_0_75(**kwargs):
-    return get_mobilenet_v2(0.75, **kwargs)
-
-
-def mobilenet_v2_0_5(**kwargs):
-    return get_mobilenet_v2(0.5, **kwargs)
-
-
-def mobilenet_v2_0_25(**kwargs):
-    return get_mobilenet_v2(0.25, **kwargs)
+mobilenet1_0 = _factory(get_mobilenet, 1.0, "mobilenet1_0")
+mobilenet0_75 = _factory(get_mobilenet, 0.75, "mobilenet0_75")
+mobilenet0_5 = _factory(get_mobilenet, 0.5, "mobilenet0_5")
+mobilenet0_25 = _factory(get_mobilenet, 0.25, "mobilenet0_25")
+mobilenet_v2_1_0 = _factory(get_mobilenet_v2, 1.0, "mobilenet_v2_1_0")
+mobilenet_v2_0_75 = _factory(get_mobilenet_v2, 0.75, "mobilenet_v2_0_75")
+mobilenet_v2_0_5 = _factory(get_mobilenet_v2, 0.5, "mobilenet_v2_0_5")
+mobilenet_v2_0_25 = _factory(get_mobilenet_v2, 0.25, "mobilenet_v2_0_25")

@@ -1,9 +1,15 @@
-"""ResNet v1/v2 (reference parity: python/mxnet/gluon/model_zoo/vision/
-resnet.py — 18/34/50/101/152, BasicBlock/Bottleneck, v1 and v2 pre-act)."""
+"""ResNet v1/v2 (He et al. 1512.03385, 1603.05027).
+
+Behavioral parity: python/mxnet/gluon/model_zoo/vision/resnet.py — same
+factory names, same layer counts/channel schedule, same `.features` /
+`.output` contract.  Construction here is a spec table interpreted by a
+single unified residual unit, not per-variant block classes.
+"""
 from __future__ import annotations
 
-from ...block import HybridBlock
 from ... import nn
+from ...block import HybridBlock
+from ._builder import Classifier, conv_block
 
 __all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
            "BottleneckV1", "BottleneckV2", "resnet18_v1", "resnet34_v1",
@@ -12,242 +18,167 @@ __all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
            "get_resnet"]
 
 
-def _conv3x3(channels, stride, in_channels):
-    return nn.Conv2D(channels, kernel_size=3, strides=stride, padding=1,
-                     use_bias=False, in_channels=in_channels)
-
-
-class BasicBlockV1(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
-        super().__init__(**kwargs)
-        self.body = nn.HybridSequential(prefix="")
-        self.body.add(_conv3x3(channels, stride, in_channels))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels, 1, channels))
-        self.body.add(nn.BatchNorm())
-        if downsample:
-            self.downsample = nn.HybridSequential(prefix="")
-            self.downsample.add(nn.Conv2D(channels, kernel_size=1,
-                                          strides=stride, use_bias=False,
-                                          in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.body(x)
-        if self.downsample:
-            residual = self.downsample(residual)
-        x = F.Activation(residual + x, act_type="relu")
-        return x
-
-
-class BottleneckV1(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
-        super().__init__(**kwargs)
-        self.body = nn.HybridSequential(prefix="")
-        self.body.add(nn.Conv2D(channels // 4, kernel_size=1, strides=stride))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(_conv3x3(channels // 4, 1, channels // 4))
-        self.body.add(nn.BatchNorm())
-        self.body.add(nn.Activation("relu"))
-        self.body.add(nn.Conv2D(channels, kernel_size=1, strides=1))
-        self.body.add(nn.BatchNorm())
-        if downsample:
-            self.downsample = nn.HybridSequential(prefix="")
-            self.downsample.add(nn.Conv2D(channels, kernel_size=1,
-                                          strides=stride, use_bias=False,
-                                          in_channels=in_channels))
-            self.downsample.add(nn.BatchNorm())
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.body(x)
-        if self.downsample:
-            residual = self.downsample(residual)
-        x = F.Activation(x + residual, act_type="relu")
-        return x
-
-
-class BasicBlockV2(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
-        super().__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = _conv3x3(channels, stride, in_channels)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels, 1, channels)
-        if downsample:
-            self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
-                                        in_channels=in_channels)
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.bn1(x)
-        x = F.Activation(x, act_type="relu")
-        if self.downsample:
-            residual = self.downsample(x)
-        x = self.conv1(x)
-        x = self.bn2(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv2(x)
-        return x + residual
-
-
-class BottleneckV2(HybridBlock):
-    def __init__(self, channels, stride, downsample=False, in_channels=0,
-                 **kwargs):
-        super().__init__(**kwargs)
-        self.bn1 = nn.BatchNorm()
-        self.conv1 = nn.Conv2D(channels // 4, kernel_size=1, strides=1,
-                               use_bias=False)
-        self.bn2 = nn.BatchNorm()
-        self.conv2 = _conv3x3(channels // 4, stride, channels // 4)
-        self.bn3 = nn.BatchNorm()
-        self.conv3 = nn.Conv2D(channels, kernel_size=1, strides=1,
-                               use_bias=False)
-        if downsample:
-            self.downsample = nn.Conv2D(channels, 1, stride, use_bias=False,
-                                        in_channels=in_channels)
-        else:
-            self.downsample = None
-
-    def hybrid_forward(self, F, x):
-        residual = x
-        x = self.bn1(x)
-        x = F.Activation(x, act_type="relu")
-        if self.downsample:
-            residual = self.downsample(x)
-        x = self.conv1(x)
-        x = self.bn2(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv2(x)
-        x = self.bn3(x)
-        x = F.Activation(x, act_type="relu")
-        x = self.conv3(x)
-        return x + residual
-
-
-class ResNetV1(HybridBlock):
-    def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 **kwargs):
-        super().__init__(**kwargs)
-        assert len(layers) == len(channels) - 1
-        with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            if thumbnail:
-                self.features.add(_conv3x3(channels[0], 1, 0))
-            else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                            use_bias=False))
-                self.features.add(nn.BatchNorm())
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, 1))
-            for i, num_layer in enumerate(layers):
-                stride = 1 if i == 0 else 2
-                self.features.add(self._make_layer(
-                    block, num_layer, channels[i + 1], stride, i + 1,
-                    in_channels=channels[i]))
-            self.features.add(nn.GlobalAvgPool2D())
-            self.output = nn.Dense(classes, in_units=channels[-1])
-
-    def _make_layer(self, block, layers, channels, stride, stage_index,
-                    in_channels=0):
-        layer = nn.HybridSequential(prefix="stage%d_" % stage_index)
-        with layer.name_scope():
-            layer.add(block(channels, stride, channels != in_channels,
-                            in_channels=in_channels, prefix=""))
-            for _ in range(layers - 1):
-                layer.add(block(channels, 1, False, in_channels=channels,
-                                prefix=""))
-        return layer
-
-    def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
-
-
-class ResNetV2(HybridBlock):
-    def __init__(self, block, layers, channels, classes=1000, thumbnail=False,
-                 **kwargs):
-        super().__init__(**kwargs)
-        assert len(layers) == len(channels) - 1
-        with self.name_scope():
-            self.features = nn.HybridSequential(prefix="")
-            self.features.add(nn.BatchNorm(scale=False, center=False))
-            if thumbnail:
-                self.features.add(_conv3x3(channels[0], 1, 0))
-            else:
-                self.features.add(nn.Conv2D(channels[0], 7, 2, 3,
-                                            use_bias=False))
-                self.features.add(nn.BatchNorm())
-                self.features.add(nn.Activation("relu"))
-                self.features.add(nn.MaxPool2D(3, 2, 1))
-            in_channels = channels[0]
-            for i, num_layer in enumerate(layers):
-                stride = 1 if i == 0 else 2
-                self.features.add(self._make_layer(
-                    block, num_layer, channels[i + 1], stride, i + 1,
-                    in_channels=in_channels))
-                in_channels = channels[i + 1]
-            self.features.add(nn.BatchNorm())
-            self.features.add(nn.Activation("relu"))
-            self.features.add(nn.GlobalAvgPool2D())
-            self.features.add(nn.Flatten())
-            self.output = nn.Dense(classes, in_units=in_channels)
-
-    def _make_layer(self, block, layers, channels, stride, stage_index,
-                    in_channels=0):
-        layer = nn.HybridSequential(prefix="stage%d_" % stage_index)
-        with layer.name_scope():
-            layer.add(block(channels, stride, channels != in_channels,
-                            in_channels=in_channels, prefix=""))
-            for _ in range(layers - 1):
-                layer.add(block(channels, 1, False, in_channels=channels,
-                                prefix=""))
-        return layer
-
-    def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
-
-
-resnet_spec = {
-    18: ("basic_block", [2, 2, 2, 2], [64, 64, 128, 256, 512]),
-    34: ("basic_block", [3, 4, 6, 3], [64, 64, 128, 256, 512]),
-    50: ("bottle_neck", [3, 4, 6, 3], [64, 256, 512, 1024, 2048]),
-    101: ("bottle_neck", [3, 4, 23, 3], [64, 256, 512, 1024, 2048]),
-    152: ("bottle_neck", [3, 8, 36, 3], [64, 256, 512, 1024, 2048]),
+# depth -> (bottleneck?, units per stage)
+_UNITS = {
+    18: (False, [2, 2, 2, 2]),
+    34: (False, [3, 4, 6, 3]),
+    50: (True, [3, 4, 6, 3]),
+    101: (True, [3, 4, 23, 3]),
+    152: (True, [3, 8, 36, 3]),
 }
-resnet_net_versions = [ResNetV1, ResNetV2]
-resnet_block_versions = [
-    {"basic_block": BasicBlockV1, "bottle_neck": BottleneckV1},
-    {"basic_block": BasicBlockV2, "bottle_neck": BottleneckV2},
-]
+_STAGE_WIDTHS = [64, 128, 256, 512]
+
+
+class _Unit(HybridBlock):
+    """One residual unit, covering all four (version, bottleneck) combos.
+
+    v1: relu(x + body(x)) with post-activation convs
+    v2: pre-activation (BN-relu first; the projection shortcut taps the
+        pre-activated tensor)
+    """
+
+    def __init__(self, channels, stride, version, bottleneck,
+                 match_dims, **kwargs):
+        super().__init__(**kwargs)
+        self._version = version
+        mid = channels // 4 if bottleneck else channels
+        with self.name_scope():
+            self.body = nn.HybridSequential(prefix="")
+            if version == 2:
+                self.pre = nn.HybridSequential(prefix="")
+                self.pre.add(nn.BatchNorm(), nn.Activation("relu"))
+            # conv plan: bottleneck = 1x1/s -> 3x3 -> 1x1;
+            # basic = 3x3/s -> 3x3.  v1 puts BN(+relu) after each conv
+            # (final relu fused with the add); v2 before.
+            if bottleneck:
+                plan = [(mid, 1, stride), (mid, 3, 1), (channels, 1, 1)]
+            else:
+                plan = [(mid, 3, stride), (channels, 3, 1)]
+            for i, (ch, k, s) in enumerate(plan):
+                last = i == len(plan) - 1
+                if version == 1:
+                    self.body.add(conv_block(ch, k, s,
+                                             act=None if last else "relu"))
+                else:
+                    if i > 0:  # first conv is fed by self.pre
+                        self.body.add(nn.BatchNorm(), nn.Activation("relu"))
+                    self.body.add(nn.Conv2D(ch, kernel_size=k, strides=s,
+                                            padding=k // 2, use_bias=False))
+            if match_dims:
+                self.shortcut = None
+            elif version == 1:
+                self.shortcut = conv_block(channels, 1, stride, act=None)
+            else:
+                self.shortcut = nn.Conv2D(channels, kernel_size=1,
+                                          strides=stride, use_bias=False)
+
+    def hybrid_forward(self, F, x):
+        if self._version == 2:
+            pre = self.pre(x)
+            res = x if self.shortcut is None else self.shortcut(pre)
+            return res + self.body(pre)
+        res = x if self.shortcut is None else self.shortcut(x)
+        return F.relu(res + self.body(x))
+
+
+# API-compat aliases for the reference's four block classes
+class BasicBlockV1(_Unit):
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 **kwargs):
+        super().__init__(channels, stride, 1, False, not downsample, **kwargs)
+
+
+class BottleneckV1(_Unit):
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 **kwargs):
+        super().__init__(channels, stride, 1, True, not downsample, **kwargs)
+
+
+class BasicBlockV2(_Unit):
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 **kwargs):
+        super().__init__(channels, stride, 2, False, not downsample, **kwargs)
+
+
+class BottleneckV2(_Unit):
+    def __init__(self, channels, stride, downsample=False, in_channels=0,
+                 **kwargs):
+        super().__init__(channels, stride, 2, True, not downsample, **kwargs)
+
+
+class _ResNet(Classifier):
+    """Interpret the spec: stem, 4 unit stages, pooled classifier."""
+
+    def __init__(self, version, depth, classes=1000, thumbnail=False,
+                 **kwargs):
+        super().__init__(**kwargs)
+        bottleneck, units = _UNITS[depth]
+        expansion = 4 if bottleneck else 1
+        with self.name_scope():
+            f = nn.HybridSequential(prefix="")
+            if thumbnail:  # CIFAR-style 3x3 stem, no pooling
+                f.add(nn.Conv2D(64, kernel_size=3, strides=1, padding=1,
+                                use_bias=False))
+                if version == 1:
+                    f.add(nn.BatchNorm(), nn.Activation("relu"))
+            else:
+                if version == 1:
+                    f.add(conv_block(64, 7, 2, 3))
+                else:
+                    f.add(nn.BatchNorm(scale=False, center=False))
+                    f.add(nn.Conv2D(64, kernel_size=7, strides=2, padding=3,
+                                    use_bias=False))
+                f.add(nn.MaxPool2D(pool_size=3, strides=2, padding=1))
+            in_ch = 64
+            for si, (width, n) in enumerate(zip(_STAGE_WIDTHS, units)):
+                out_ch = width * expansion
+                for ui in range(n):
+                    stride = 2 if (ui == 0 and si > 0) else 1
+                    f.add(_Unit(out_ch, stride, version, bottleneck,
+                                match_dims=(stride == 1 and in_ch == out_ch)))
+                    in_ch = out_ch
+            if version == 2:
+                f.add(nn.BatchNorm(), nn.Activation("relu"))
+            f.add(nn.GlobalAvgPool2D())
+            f.add(nn.Flatten())
+            self.features = f
+            self.output = nn.Dense(classes, in_units=in_ch)
+
+
+def _depth_for(block, layers):
+    bottleneck = block in (BottleneckV1, BottleneckV2)
+    for depth, (b, units) in _UNITS.items():
+        if b == bottleneck and units == list(layers):
+            return depth
+    raise ValueError("unsupported resnet layout %s" % (layers,))
+
+
+class ResNetV1(_ResNet):
+    """Reference-signature constructor (block class + explicit layout)."""
+
+    def __init__(self, block, layers, channels, classes=1000,
+                 thumbnail=False, **kwargs):
+        super().__init__(1, _depth_for(block, layers), classes=classes,
+                         thumbnail=thumbnail, **kwargs)
+
+
+class ResNetV2(_ResNet):
+    def __init__(self, block, layers, channels, classes=1000,
+                 thumbnail=False, **kwargs):
+        super().__init__(2, _depth_for(block, layers), classes=classes,
+                         thumbnail=thumbnail, **kwargs)
 
 
 def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
                **kwargs):
-    assert num_layers in resnet_spec, \
-        "Invalid number of layers: %d. Options are %s" % (
-            num_layers, str(resnet_spec.keys()))
-    block_type, layers, channels = resnet_spec[num_layers]
-    assert 1 <= version <= 2, \
-        "Invalid resnet version: %d. Options are 1 and 2." % version
-    resnet_class = resnet_net_versions[version - 1]
-    block_class = resnet_block_versions[version - 1][block_type]
-    net = resnet_class(block_class, layers, channels, **kwargs)
+    """Parity: model_zoo.vision.get_resnet."""
+    if num_layers not in _UNITS:
+        raise ValueError("Invalid number of layers: %d. Options are %s" % (
+            num_layers, sorted(_UNITS)))
+    if version not in (1, 2):
+        raise ValueError("Invalid resnet version: %d. Options are 1 and 2."
+                         % version)
+    net = _ResNet(version, num_layers, **kwargs)
     if pretrained:
         from ..model_store import get_model_file
 
@@ -256,41 +187,22 @@ def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
     return net
 
 
-def resnet18_v1(**kwargs):
-    return get_resnet(1, 18, **kwargs)
+def _factory(version, depth):
+    def make(**kwargs):
+        return get_resnet(version, depth, **kwargs)
+
+    make.__name__ = "resnet%d_v%d" % (depth, version)
+    make.__doc__ = "ResNet-%d v%d factory." % (depth, version)
+    return make
 
 
-def resnet34_v1(**kwargs):
-    return get_resnet(1, 34, **kwargs)
-
-
-def resnet50_v1(**kwargs):
-    return get_resnet(1, 50, **kwargs)
-
-
-def resnet101_v1(**kwargs):
-    return get_resnet(1, 101, **kwargs)
-
-
-def resnet152_v1(**kwargs):
-    return get_resnet(1, 152, **kwargs)
-
-
-def resnet18_v2(**kwargs):
-    return get_resnet(2, 18, **kwargs)
-
-
-def resnet34_v2(**kwargs):
-    return get_resnet(2, 34, **kwargs)
-
-
-def resnet50_v2(**kwargs):
-    return get_resnet(2, 50, **kwargs)
-
-
-def resnet101_v2(**kwargs):
-    return get_resnet(2, 101, **kwargs)
-
-
-def resnet152_v2(**kwargs):
-    return get_resnet(2, 152, **kwargs)
+resnet18_v1 = _factory(1, 18)
+resnet34_v1 = _factory(1, 34)
+resnet50_v1 = _factory(1, 50)
+resnet101_v1 = _factory(1, 101)
+resnet152_v1 = _factory(1, 152)
+resnet18_v2 = _factory(2, 18)
+resnet34_v2 = _factory(2, 34)
+resnet50_v2 = _factory(2, 50)
+resnet101_v2 = _factory(2, 101)
+resnet152_v2 = _factory(2, 152)

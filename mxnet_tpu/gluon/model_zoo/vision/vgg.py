@@ -1,100 +1,71 @@
-"""VGG 11/13/16/19 (+BN) (reference parity: gluon/model_zoo/vision/vgg.py)."""
-from ...block import HybridBlock
+"""VGG 11/13/16/19, with and without BatchNorm (Simonyan & Zisserman).
+
+Behavioral parity: python/mxnet/gluon/model_zoo/vision/vgg.py (same
+factories / layer schedule); built from a width table interpreted in one
+loop rather than transcribed layer lists.
+"""
+from __future__ import annotations
+
 from ... import nn
-from .... import initializer as init
+from ._builder import Classifier
 
-__all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19", "vgg11_bn", "vgg13_bn",
-           "vgg16_bn", "vgg19_bn", "get_vgg"]
+__all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19", "vgg11_bn",
+           "vgg13_bn", "vgg16_bn", "vgg19_bn", "get_vgg"]
+
+# depth -> convs per stage; stage widths are fixed
+_STAGES = {11: [1, 1, 2, 2, 2], 13: [2, 2, 2, 2, 2],
+           16: [2, 2, 3, 3, 3], 19: [2, 2, 4, 4, 4]}
+_WIDTHS = [64, 128, 256, 512, 512]
 
 
-class VGG(HybridBlock):
+class VGG(Classifier):
     def __init__(self, layers, filters, classes=1000, batch_norm=False,
                  **kwargs):
         super().__init__(**kwargs)
-        assert len(layers) == len(filters)
         with self.name_scope():
-            self.features = self._make_features(layers, filters, batch_norm)
-            self.features.add(nn.Dense(4096, activation="relu",
-                                       weight_initializer="normal",
-                                       bias_initializer="zeros"))
-            self.features.add(nn.Dropout(rate=0.5))
-            self.features.add(nn.Dense(4096, activation="relu",
-                                       weight_initializer="normal",
-                                       bias_initializer="zeros"))
-            self.features.add(nn.Dropout(rate=0.5))
-            self.output = nn.Dense(classes, weight_initializer="normal",
-                                   bias_initializer="zeros")
-
-    def _make_features(self, layers, filters, batch_norm):
-        featurizer = nn.HybridSequential(prefix="")
-        for i, num in enumerate(layers):
-            for _ in range(num):
-                featurizer.add(nn.Conv2D(filters[i], kernel_size=3, padding=1,
-                                         weight_initializer=init.Xavier(
-                                             rnd_type="gaussian",
-                                             factor_type="out", magnitude=2),
-                                         bias_initializer="zeros"))
-                if batch_norm:
-                    featurizer.add(nn.BatchNorm())
-                featurizer.add(nn.Activation("relu"))
-            featurizer.add(nn.MaxPool2D(strides=2))
-        return featurizer
-
-    def hybrid_forward(self, F, x):
-        x = self.features(x)
-        x = self.output(x)
-        return x
-
-
-vgg_spec = {11: ([1, 1, 2, 2, 2], [64, 128, 256, 512, 512]),
-            13: ([2, 2, 2, 2, 2], [64, 128, 256, 512, 512]),
-            16: ([2, 2, 3, 3, 3], [64, 128, 256, 512, 512]),
-            19: ([2, 2, 4, 4, 4], [64, 128, 256, 512, 512])}
+            f = nn.HybridSequential(prefix="")
+            for reps, width in zip(layers, filters):
+                for _ in range(reps):
+                    f.add(nn.Conv2D(width, kernel_size=3, padding=1))
+                    if batch_norm:
+                        f.add(nn.BatchNorm())
+                    f.add(nn.Activation("relu"))
+                f.add(nn.MaxPool2D(strides=2))
+            for _ in range(2):  # fc6/fc7 with dropout
+                f.add(nn.Dense(4096, activation="relu"))
+                f.add(nn.Dropout(rate=0.5))
+            self.features = f
+            self.output = nn.Dense(classes)
 
 
 def get_vgg(num_layers, pretrained=False, ctx=None, root=None, **kwargs):
-    layers, filters = vgg_spec[num_layers]
-    net = VGG(layers, filters, **kwargs)
+    """Parity: model_zoo.vision.get_vgg."""
+    net = VGG(_STAGES[num_layers], _WIDTHS, **kwargs)
     if pretrained:
         from ..model_store import get_model_file
 
-        batch_norm_suffix = "_bn" if kwargs.get("batch_norm") else ""
+        suffix = "_bn" if kwargs.get("batch_norm") else ""
         net.load_parameters(get_model_file(
-            "vgg%d%s" % (num_layers, batch_norm_suffix), root=root), ctx=ctx)
+            "vgg%d%s" % (num_layers, suffix), root=root), ctx=ctx)
     return net
 
 
-def vgg11(**kwargs):
-    return get_vgg(11, **kwargs)
+def _factory(depth, bn):
+    def make(**kwargs):
+        if bn:
+            kwargs["batch_norm"] = True
+        return get_vgg(depth, **kwargs)
+
+    make.__name__ = "vgg%d%s" % (depth, "_bn" if bn else "")
+    make.__doc__ = "VGG-%d%s factory." % (depth, " +BN" if bn else "")
+    return make
 
 
-def vgg13(**kwargs):
-    return get_vgg(13, **kwargs)
-
-
-def vgg16(**kwargs):
-    return get_vgg(16, **kwargs)
-
-
-def vgg19(**kwargs):
-    return get_vgg(19, **kwargs)
-
-
-def vgg11_bn(**kwargs):
-    kwargs["batch_norm"] = True
-    return get_vgg(11, **kwargs)
-
-
-def vgg13_bn(**kwargs):
-    kwargs["batch_norm"] = True
-    return get_vgg(13, **kwargs)
-
-
-def vgg16_bn(**kwargs):
-    kwargs["batch_norm"] = True
-    return get_vgg(16, **kwargs)
-
-
-def vgg19_bn(**kwargs):
-    kwargs["batch_norm"] = True
-    return get_vgg(19, **kwargs)
+vgg11 = _factory(11, False)
+vgg13 = _factory(13, False)
+vgg16 = _factory(16, False)
+vgg19 = _factory(19, False)
+vgg11_bn = _factory(11, True)
+vgg13_bn = _factory(13, True)
+vgg16_bn = _factory(16, True)
+vgg19_bn = _factory(19, True)
