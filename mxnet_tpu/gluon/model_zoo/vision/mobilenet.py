@@ -48,8 +48,9 @@ class _InvertedResidual(HybridBlock):
         mid = in_ch * expansion
         with self.name_scope():
             body = nn.HybridSequential(prefix="")
-            if expansion != 1:
-                body.add(conv_block(mid, 1, relu6=True))
+            # the expansion 1x1 is present even at t=1 (reference
+            # LinearBottleneck keeps it unconditionally)
+            body.add(conv_block(mid, 1, relu6=True))
             body.add(conv_block(mid, 3, stride, groups=mid, relu6=True))
             body.add(conv_block(out_ch, 1, act=None))
             self.body = body
@@ -61,6 +62,12 @@ class _InvertedResidual(HybridBlock):
 
 def _scaled(ch, multiplier):
     return max(1, int(ch * multiplier))
+
+
+def _version_suffix(multiplier):
+    """Model-store name fragment: 1.0 -> '1.0', 0.75 -> '0.75'."""
+    text = "%.2f" % multiplier
+    return text[:-1] if text.endswith("0") else text
 
 
 class MobileNet(Classifier):
@@ -110,7 +117,7 @@ def get_mobilenet(multiplier, pretrained=False, ctx=None, root=None,
     if pretrained:
         from ..model_store import get_model_file
 
-        ver = ("%.2f" % multiplier).rstrip("0").rstrip(".")
+        ver = _version_suffix(multiplier)
         net.load_parameters(get_model_file("mobilenet%s" % ver, root=root),
                             ctx=ctx)
     return net
@@ -123,7 +130,7 @@ def get_mobilenet_v2(multiplier, pretrained=False, ctx=None, root=None,
     if pretrained:
         from ..model_store import get_model_file
 
-        ver = ("%.2f" % multiplier).rstrip("0").rstrip(".")
+        ver = _version_suffix(multiplier)
         net.load_parameters(get_model_file("mobilenetv2_%s" % ver, root=root),
                             ctx=ctx)
     return net

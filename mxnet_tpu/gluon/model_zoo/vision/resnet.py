@@ -18,23 +18,25 @@ __all__ = ["ResNetV1", "ResNetV2", "BasicBlockV1", "BasicBlockV2",
            "get_resnet"]
 
 
-# depth -> (bottleneck?, units per stage)
-_UNITS = {
-    18: (False, [2, 2, 2, 2]),
-    34: (False, [3, 4, 6, 3]),
-    50: (True, [3, 4, 6, 3]),
-    101: (True, [3, 4, 23, 3]),
-    152: (True, [3, 8, 36, 3]),
+# depth -> (bottleneck?, units per stage, per-stage output channels)
+_SPECS = {
+    18: (False, [2, 2, 2, 2], [64, 64, 128, 256, 512]),
+    34: (False, [3, 4, 6, 3], [64, 64, 128, 256, 512]),
+    50: (True, [3, 4, 6, 3], [64, 256, 512, 1024, 2048]),
+    101: (True, [3, 4, 23, 3], [64, 256, 512, 1024, 2048]),
+    152: (True, [3, 8, 36, 3], [64, 256, 512, 1024, 2048]),
 }
-_STAGE_WIDTHS = [64, 128, 256, 512]
 
 
 class _Unit(HybridBlock):
     """One residual unit, covering all four (version, bottleneck) combos.
 
-    v1: relu(x + body(x)) with post-activation convs
+    v1: relu(x + body(x)) with post-activation convs; the bottleneck's
+        1x1 convs carry a bias (upstream quirk kept for param parity) and
+        the stride sits on the leading 1x1.
     v2: pre-activation (BN-relu first; the projection shortcut taps the
-        pre-activated tensor)
+        pre-activated tensor); the bottleneck's stride sits on the middle
+        3x3 per He et al. 1603.05027.
     """
 
     def __init__(self, channels, stride, version, bottleneck,
@@ -47,17 +49,19 @@ class _Unit(HybridBlock):
             if version == 2:
                 self.pre = nn.HybridSequential(prefix="")
                 self.pre.add(nn.BatchNorm(), nn.Activation("relu"))
-            # conv plan: bottleneck = 1x1/s -> 3x3 -> 1x1;
-            # basic = 3x3/s -> 3x3.  v1 puts BN(+relu) after each conv
-            # (final relu fused with the add); v2 before.
-            if bottleneck:
-                plan = [(mid, 1, stride), (mid, 3, 1), (channels, 1, 1)]
+            # conv plan rows: (channels, kernel, stride, biased?)
+            if bottleneck and version == 1:
+                plan = [(mid, 1, stride, True), (mid, 3, 1, False),
+                        (channels, 1, 1, True)]
+            elif bottleneck:
+                plan = [(mid, 1, 1, False), (mid, 3, stride, False),
+                        (channels, 1, 1, False)]
             else:
-                plan = [(mid, 3, stride), (channels, 3, 1)]
-            for i, (ch, k, s) in enumerate(plan):
+                plan = [(mid, 3, stride, False), (channels, 3, 1, False)]
+            for i, (ch, k, s, biased) in enumerate(plan):
                 last = i == len(plan) - 1
                 if version == 1:
-                    self.body.add(conv_block(ch, k, s,
+                    self.body.add(conv_block(ch, k, s, bias=biased,
                                              act=None if last else "relu"))
                 else:
                     if i > 0:  # first conv is fed by self.pre
@@ -107,31 +111,26 @@ class BottleneckV2(_Unit):
 
 
 class _ResNet(Classifier):
-    """Interpret the spec: stem, 4 unit stages, pooled classifier."""
+    """Interpret a spec (units per stage + channel schedule) into stem,
+    unit stages, and a pooled classifier head."""
 
-    def __init__(self, version, depth, classes=1000, thumbnail=False,
-                 **kwargs):
+    def __init__(self, version, bottleneck, units, channels, classes=1000,
+                 thumbnail=False, **kwargs):
         super().__init__(**kwargs)
-        bottleneck, units = _UNITS[depth]
-        expansion = 4 if bottleneck else 1
+        assert len(channels) == len(units) + 1
         with self.name_scope():
             f = nn.HybridSequential(prefix="")
-            if thumbnail:  # CIFAR-style 3x3 stem, no pooling
-                f.add(nn.Conv2D(64, kernel_size=3, strides=1, padding=1,
-                                use_bias=False))
-                if version == 1:
-                    f.add(nn.BatchNorm(), nn.Activation("relu"))
+            if version == 2:
+                # no-affine input normalisation, shared by both stems
+                f.add(nn.BatchNorm(scale=False, center=False))
+            if thumbnail:  # CIFAR-style bare 3x3 conv, no pooling
+                f.add(nn.Conv2D(channels[0], kernel_size=3, strides=1,
+                                padding=1, use_bias=False))
             else:
-                if version == 1:
-                    f.add(conv_block(64, 7, 2, 3))
-                else:
-                    f.add(nn.BatchNorm(scale=False, center=False))
-                    f.add(nn.Conv2D(64, kernel_size=7, strides=2, padding=3,
-                                    use_bias=False))
+                f.add(conv_block(channels[0], 7, 2, 3))
                 f.add(nn.MaxPool2D(pool_size=3, strides=2, padding=1))
-            in_ch = 64
-            for si, (width, n) in enumerate(zip(_STAGE_WIDTHS, units)):
-                out_ch = width * expansion
+            in_ch = channels[0]
+            for si, (out_ch, n) in enumerate(zip(channels[1:], units)):
                 for ui in range(n):
                     stride = 2 if (ui == 0 and si > 0) else 1
                     f.add(_Unit(out_ch, stride, version, bottleneck,
@@ -145,40 +144,35 @@ class _ResNet(Classifier):
             self.output = nn.Dense(classes, in_units=in_ch)
 
 
-def _depth_for(block, layers):
-    bottleneck = block in (BottleneckV1, BottleneckV2)
-    for depth, (b, units) in _UNITS.items():
-        if b == bottleneck and units == list(layers):
-            return depth
-    raise ValueError("unsupported resnet layout %s" % (layers,))
-
-
 class ResNetV1(_ResNet):
     """Reference-signature constructor (block class + explicit layout)."""
 
     def __init__(self, block, layers, channels, classes=1000,
                  thumbnail=False, **kwargs):
-        super().__init__(1, _depth_for(block, layers), classes=classes,
+        super().__init__(1, block in (BottleneckV1, BottleneckV2),
+                         list(layers), list(channels), classes=classes,
                          thumbnail=thumbnail, **kwargs)
 
 
 class ResNetV2(_ResNet):
     def __init__(self, block, layers, channels, classes=1000,
                  thumbnail=False, **kwargs):
-        super().__init__(2, _depth_for(block, layers), classes=classes,
+        super().__init__(2, block in (BottleneckV1, BottleneckV2),
+                         list(layers), list(channels), classes=classes,
                          thumbnail=thumbnail, **kwargs)
 
 
 def get_resnet(version, num_layers, pretrained=False, ctx=None, root=None,
                **kwargs):
     """Parity: model_zoo.vision.get_resnet."""
-    if num_layers not in _UNITS:
+    if num_layers not in _SPECS:
         raise ValueError("Invalid number of layers: %d. Options are %s" % (
-            num_layers, sorted(_UNITS)))
+            num_layers, sorted(_SPECS)))
     if version not in (1, 2):
         raise ValueError("Invalid resnet version: %d. Options are 1 and 2."
                          % version)
-    net = _ResNet(version, num_layers, **kwargs)
+    bottleneck, units, channels = _SPECS[num_layers]
+    net = _ResNet(version, bottleneck, units, channels, **kwargs)
     if pretrained:
         from ..model_store import get_model_file
 

@@ -7,6 +7,7 @@ loop rather than transcribed layer lists.
 from __future__ import annotations
 
 from ... import nn
+from ....initializer import Xavier
 from ._builder import Classifier
 
 __all__ = ["VGG", "vgg11", "vgg13", "vgg16", "vgg19", "vgg11_bn",
@@ -22,20 +23,27 @@ class VGG(Classifier):
     def __init__(self, layers, filters, classes=1000, batch_norm=False,
                  **kwargs):
         super().__init__(**kwargs)
+        conv_init = dict(
+            weight_initializer=Xavier(rnd_type="gaussian",
+                                      factor_type="out", magnitude=2),
+            bias_initializer="zeros")
+        fc_init = dict(weight_initializer="normal",
+                       bias_initializer="zeros")
         with self.name_scope():
             f = nn.HybridSequential(prefix="")
             for reps, width in zip(layers, filters):
                 for _ in range(reps):
-                    f.add(nn.Conv2D(width, kernel_size=3, padding=1))
+                    f.add(nn.Conv2D(width, kernel_size=3, padding=1,
+                                    **conv_init))
                     if batch_norm:
                         f.add(nn.BatchNorm())
                     f.add(nn.Activation("relu"))
                 f.add(nn.MaxPool2D(strides=2))
             for _ in range(2):  # fc6/fc7 with dropout
-                f.add(nn.Dense(4096, activation="relu"))
+                f.add(nn.Dense(4096, activation="relu", **fc_init))
                 f.add(nn.Dropout(rate=0.5))
             self.features = f
-            self.output = nn.Dense(classes)
+            self.output = nn.Dense(classes, **fc_init)
 
 
 def get_vgg(num_layers, pretrained=False, ctx=None, root=None, **kwargs):

@@ -1,5 +1,14 @@
-"""Learning-rate schedulers (reference parity: python/mxnet/lr_scheduler.py:
-Factor/MultiFactor/Poly/Cosine with warmup)."""
+"""Learning-rate schedules.
+
+API parity target: the reference ``python/mxnet/lr_scheduler.py`` (base
+class + Factor / MultiFactor / Poly / Cosine, all with warmup). Structured
+differently: warmup is resolved once in :meth:`LRScheduler.__call__`, and
+each schedule implements a single ``_lr_after_warmup(step)`` hook. The
+annealing schedules (poly, cosine) share one progress-fraction helper.
+
+Schedulers are host-side Python called between jitted steps — they feed a
+scalar into the update program, so nothing here needs to trace.
+"""
 from __future__ import annotations
 
 import math
@@ -9,126 +18,136 @@ __all__ = ["LRScheduler", "FactorScheduler", "MultiFactorScheduler",
 
 
 class LRScheduler:
+    """Maps ``num_update`` (optimizer update count) to a learning rate.
+
+    Subclasses override :meth:`_lr_after_warmup`; warmup interpolation for
+    steps below ``warmup_steps`` is handled here for every schedule.
+    """
+
     def __init__(self, base_lr=0.01, warmup_steps=0, warmup_begin_lr=0,
                  warmup_mode="linear"):
-        self.base_lr = base_lr
-        self.warmup_steps = warmup_steps
-        self.warmup_begin_lr = warmup_begin_lr
-        self.warmup_final_lr = base_lr
-        self.warmup_mode = warmup_mode
         if warmup_begin_lr > base_lr:
             raise ValueError("base lr must be larger than warmup_begin_lr")
         if warmup_steps < 0:
             raise ValueError("warmup_steps must be >= 0")
+        if warmup_mode not in ("linear", "constant"):
+            raise ValueError("Invalid warmup mode %s" % warmup_mode)
+        self.base_lr = self.warmup_final_lr = base_lr
+        self.warmup_steps, self.warmup_begin_lr = warmup_steps, warmup_begin_lr
+        self.warmup_mode = warmup_mode
 
     def get_warmup_lr(self, num_update):
-        assert num_update < self.warmup_steps
-        if self.warmup_mode == "linear":
-            increase = ((self.warmup_final_lr - self.warmup_begin_lr)
-                        * float(num_update) / float(self.warmup_steps))
-            return self.warmup_begin_lr + increase
+        assert num_update < self.warmup_steps, "past the warmup window"
         if self.warmup_mode == "constant":
             return self.warmup_begin_lr
-        raise ValueError("Invalid warmup mode %s" % self.warmup_mode)
+        ramp = num_update / float(self.warmup_steps)
+        return self.warmup_begin_lr + \
+            ramp * (self.warmup_final_lr - self.warmup_begin_lr)
+
+    def _lr_after_warmup(self, num_update):
+        raise NotImplementedError
 
     def __call__(self, num_update):
-        raise NotImplementedError
+        if num_update < self.warmup_steps:
+            return self.get_warmup_lr(num_update)
+        return self._lr_after_warmup(num_update)
 
 
 class FactorScheduler(LRScheduler):
+    """Multiply lr by ``factor`` every ``step`` updates, floored at
+    ``stop_factor_lr``."""
+
     def __init__(self, step, factor=1, stop_factor_lr=1e-8, base_lr=0.01,
                  warmup_steps=0, warmup_begin_lr=0, warmup_mode="linear"):
         super().__init__(base_lr, warmup_steps, warmup_begin_lr, warmup_mode)
         if step < 1:
-            raise ValueError("Schedule step must be greater or equal than 1")
+            raise ValueError("step must be at least 1")
         if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
-        self.step = step
-        self.factor = factor
-        self.stop_factor_lr = stop_factor_lr
-        self.count = 0
+            raise ValueError("factor must be <= 1 so the lr decays")
+        self.step, self.factor = step, factor
+        self.stop_factor_lr, self.count = stop_factor_lr, 0
 
-    def __call__(self, num_update):
-        if num_update < self.warmup_steps:
-            return self.get_warmup_lr(num_update)
-        while num_update > self.count + self.step:
+    def _lr_after_warmup(self, num_update):
+        # Stateful on purpose (matches reference): base_lr decays as the
+        # update counter crosses each step boundary.
+        while num_update - self.count > self.step:
             self.count += self.step
-            self.base_lr *= self.factor
-            if self.base_lr < self.stop_factor_lr:
-                self.base_lr = self.stop_factor_lr
+            self.base_lr = max(self.base_lr * self.factor,
+                               self.stop_factor_lr)
         return self.base_lr
 
 
 class MultiFactorScheduler(LRScheduler):
+    """Multiply lr by ``factor`` at each milestone in the ``step`` list."""
+
     def __init__(self, step, factor=1, base_lr=0.01, warmup_steps=0,
                  warmup_begin_lr=0, warmup_mode="linear"):
         super().__init__(base_lr, warmup_steps, warmup_begin_lr, warmup_mode)
-        assert isinstance(step, list) and len(step) >= 1
-        for i, _step in enumerate(step):
-            if i != 0 and step[i] <= step[i - 1]:
-                raise ValueError("Schedule step must be an increasing list")
-            if _step < 1:
-                raise ValueError("Schedule step must be greater or equal than 1")
+        if not isinstance(step, list) or not step:
+            raise ValueError("step must be a non-empty list of milestones")
+        if any(s < 1 for s in step):
+            raise ValueError("every milestone must be at least 1")
+        if sorted(set(step)) != step:
+            raise ValueError("milestones must be strictly increasing")
         if factor > 1.0:
-            raise ValueError("Factor must be no more than 1 to make lr reduce")
-        self.step = step
-        self.cur_step_ind = 0
-        self.factor = factor
-        self.count = 0
+            raise ValueError("factor must be <= 1 so the lr decays")
+        self.step, self.factor = step, factor
+        self.cur_step_ind, self.count = 0, 0
 
-    def __call__(self, num_update):
-        if num_update < self.warmup_steps:
-            return self.get_warmup_lr(num_update)
-        while self.cur_step_ind <= len(self.step) - 1:
-            if num_update > self.step[self.cur_step_ind]:
-                self.count = self.step[self.cur_step_ind]
-                self.cur_step_ind += 1
-                self.base_lr *= self.factor
-            else:
-                return self.base_lr
+    def _lr_after_warmup(self, num_update):
+        pending = self.step[self.cur_step_ind:]
+        for milestone in pending:
+            if num_update <= milestone:
+                break
+            self.count = milestone
+            self.cur_step_ind += 1
+            self.base_lr *= self.factor
         return self.base_lr
 
 
-class PolyScheduler(LRScheduler):
-    def __init__(self, max_update, base_lr=0.01, pwr=2, final_lr=0,
-                 warmup_steps=0, warmup_begin_lr=0, warmup_mode="linear"):
-        super().__init__(base_lr, warmup_steps, warmup_begin_lr, warmup_mode)
-        assert isinstance(max_update, int)
-        if max_update < 1:
-            raise ValueError("maximum number of updates must be strictly positive")
-        self.power = pwr
-        self.base_lr_orig = self.base_lr
-        self.max_update = max_update
-        self.final_lr = final_lr
-        self.max_steps = self.max_update - self.warmup_steps
+class _AnnealingScheduler(LRScheduler):
+    """Shared shell for schedules that anneal base→final over ``max_update``
+    post-warmup steps via a shape function of progress t ∈ [0, 1]."""
 
-    def __call__(self, num_update):
-        if num_update < self.warmup_steps:
-            return self.get_warmup_lr(num_update)
-        if num_update <= self.max_update:
-            self.base_lr = self.final_lr + (self.base_lr_orig - self.final_lr) * \
-                pow(1 - float(num_update - self.warmup_steps) / float(self.max_steps),
-                    self.power)
-        return self.base_lr
-
-
-class CosineScheduler(LRScheduler):
     def __init__(self, max_update, base_lr=0.01, final_lr=0, warmup_steps=0,
                  warmup_begin_lr=0, warmup_mode="linear"):
         super().__init__(base_lr, warmup_steps, warmup_begin_lr, warmup_mode)
-        assert isinstance(max_update, int)
+        if not isinstance(max_update, int):
+            raise TypeError("max_update must be an int")
         if max_update < 1:
-            raise ValueError("maximum number of updates must be strictly positive")
-        self.base_lr_orig = base_lr
+            raise ValueError(
+                "maximum number of updates must be strictly positive")
+        self.base_lr_orig, self.final_lr = base_lr, final_lr
         self.max_update = max_update
-        self.final_lr = final_lr
-        self.max_steps = self.max_update - self.warmup_steps
+        self.max_steps = max_update - warmup_steps
 
-    def __call__(self, num_update):
-        if num_update < self.warmup_steps:
-            return self.get_warmup_lr(num_update)
+    def _shape(self, t):
+        """Decay weight in [0,1]: 1 at t=0, 0 at t=1."""
+        raise NotImplementedError
+
+    def _lr_after_warmup(self, num_update):
         if num_update <= self.max_update:
-            self.base_lr = self.final_lr + (self.base_lr_orig - self.final_lr) * \
-                (1 + math.cos(math.pi * (num_update - self.warmup_steps)
-                              / self.max_steps)) / 2
+            t = (num_update - self.warmup_steps) / float(self.max_steps)
+            span = self.base_lr_orig - self.final_lr
+            self.base_lr = self.final_lr + span * self._shape(t)
         return self.base_lr
+
+
+class PolyScheduler(_AnnealingScheduler):
+    """Polynomial decay: lr = final + (base-final) * (1-t)^pwr."""
+
+    def __init__(self, max_update, base_lr=0.01, pwr=2, final_lr=0,
+                 warmup_steps=0, warmup_begin_lr=0, warmup_mode="linear"):
+        super().__init__(max_update, base_lr, final_lr, warmup_steps,
+                         warmup_begin_lr, warmup_mode)
+        self.power = pwr
+
+    def _shape(self, t):
+        return (1 - t) ** self.power
+
+
+class CosineScheduler(_AnnealingScheduler):
+    """Cosine decay: lr = final + (base-final) * (1+cos(pi t))/2."""
+
+    def _shape(self, t):
+        return (1 + math.cos(math.pi * t)) / 2

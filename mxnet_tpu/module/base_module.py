@@ -1,41 +1,59 @@
-"""BaseModule with the full fit() train loop (reference parity:
-python/mxnet/module/base_module.py:409)."""
+"""BaseModule: the abstract train/eval/predict driver.
+
+API parity target: the reference ``python/mxnet/module/base_module.py``
+(notably the ``fit`` loop at ``base_module.py:409``). Re-organised here:
+the epoch loop is split into :meth:`fit` (setup + per-epoch bookkeeping)
+and :meth:`_fit_epoch` (one pass over the iterator), batch lookahead is a
+standalone generator so prefetch/prepare logic isn't tangled into the
+loop body, and callback fan-out / metric coercion are shared helpers.
+
+On TPU the subclasses execute jitted XLA programs per batch; this layer is
+pure host-side orchestration and never touches device state directly.
+"""
 from __future__ import annotations
 
 import logging
 import time
 
-import numpy as np
-
-from ..base import MXNetError
 from .. import metric as _metric
 from .. import ndarray
-from ..ndarray.ndarray import NDArray
 from ..context import cpu
 
 __all__ = ["BaseModule", "_check_input_names", "_as_list"]
 
 
 def _as_list(obj):
-    if isinstance(obj, list):
-        return obj
-    return [obj]
+    return obj if isinstance(obj, list) else [obj]
+
+
+def _fire(callbacks, arg):
+    """Invoke one callback or a list of them with ``arg``."""
+    if callbacks is None:
+        return
+    for cb in _as_list(callbacks):
+        cb(arg)
+
+
+def _coerce_metric(m):
+    return m if isinstance(m, _metric.EvalMetric) else _metric.create(m)
 
 
 def _check_input_names(symbol, names, typename, throw):
-    args = symbol.list_arguments()
+    """Warn or raise when a declared input name is absent from the symbol."""
+    known = symbol.list_arguments()
     for name in names:
-        if name in args:
-            continue
-        msg = "You created Module with Module(..., %s_names=%s) but input " \
-              "with name '%s' is not found in symbol.list_arguments()." % (
-                  typename, str(names), name)
-        if throw:
-            raise ValueError(msg)
-        logging.warning(msg)
+        if name not in known:
+            msg = ("You created Module with Module(..., %s_names=%s) but "
+                   "input with name '%s' is not found in "
+                   "symbol.list_arguments()." % (typename, str(names), name))
+            if throw:
+                raise ValueError(msg)
+            logging.warning(msg)
 
 
 class BatchEndParam:
+    """Namespace handed to batch-end callbacks."""
+
     def __init__(self, epoch, nbatch, eval_metric, locals=None):
         self.epoch = epoch
         self.nbatch = nbatch
@@ -44,6 +62,12 @@ class BatchEndParam:
 
 
 class BaseModule:
+    """Abstract base for every Module flavour.
+
+    Subclasses provide bind/init/forward/backward/update; this class
+    provides everything built from those primitives (fit, score, predict).
+    """
+
     def __init__(self, logger=logging):
         self.logger = logger
         self.binded = False
@@ -54,7 +78,20 @@ class BaseModule:
         self._symbol = None
         self._total_exec_bytes = 0
 
-    # -- high-level ------------------------------------------------------
+    def _require(self, *, params=True):
+        assert self.binded, "call bind() first"
+        if params:
+            assert self.params_initialized, "call init_params() first"
+
+    def _metric_labels(self, batch):
+        """Labels for update_metric, handling pre-sliced list batches."""
+        if isinstance(batch, list):
+            return [b.label for b in batch], True
+        return batch.label, False
+
+    # ------------------------------------------------------------------
+    # Composite operations
+    # ------------------------------------------------------------------
     def forward_backward(self, data_batch):
         self.forward(data_batch, is_train=True)
         self.backward()
@@ -62,78 +99,111 @@ class BaseModule:
     def score(self, eval_data, eval_metric, num_batch=None,
               batch_end_callback=None, score_end_callback=None, reset=True,
               epoch=0, sparse_row_id_fn=None):
-        assert self.binded and self.params_initialized
+        """Evaluate on ``eval_data``; returns metric name/value pairs."""
+        self._require()
         if reset:
             eval_data.reset()
-        if not isinstance(eval_metric, _metric.EvalMetric):
-            eval_metric = _metric.create(eval_metric)
+        eval_metric = _coerce_metric(eval_metric)
         eval_metric.reset()
-        actual_num_batch = 0
-        for nbatch, eval_batch in enumerate(eval_data):
+
+        nbatch = -1
+        for nbatch, batch in enumerate(eval_data):
             if num_batch is not None and nbatch == num_batch:
+                nbatch -= 1
                 break
-            self.forward(eval_batch, is_train=False)
-            if isinstance(eval_batch, list):
-                self.update_metric(eval_metric, [eb.label
-                                                 for eb in eval_batch],
-                                   pre_sliced=True)
-            else:
-                self.update_metric(eval_metric, eval_batch.label)
-            if batch_end_callback is not None:
-                batch_end_params = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                                 eval_metric=eval_metric,
-                                                 locals=locals())
-                for callback in _as_list(batch_end_callback):
-                    callback(batch_end_params)
-            actual_num_batch += 1
-        if score_end_callback:
-            params = BatchEndParam(epoch=epoch, nbatch=actual_num_batch,
-                                   eval_metric=eval_metric, locals=locals())
-            for callback in _as_list(score_end_callback):
-                callback(params)
+            self.forward(batch, is_train=False)
+            labels, sliced = self._metric_labels(batch)
+            self.update_metric(eval_metric, labels, pre_sliced=sliced)
+            _fire(batch_end_callback,
+                  BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                eval_metric=eval_metric, locals=locals()))
+        _fire(score_end_callback,
+              BatchEndParam(epoch=epoch, nbatch=nbatch + 1,
+                            eval_metric=eval_metric, locals=locals()))
         return eval_metric.get_name_value()
 
+    def _unpadded_outputs(self, batch, copy=False):
+        """Forward outputs with the iterator's pad rows stripped."""
+        keep = lambda o: o[0:o.shape[0] - batch.pad]
+        outs = [keep(o) for o in self.get_outputs()]
+        return [o.copy() for o in outs] if copy else outs
+
     def iter_predict(self, eval_data, num_batch=None, reset=True):
-        assert self.binded and self.params_initialized
+        self._require()
         if reset:
             eval_data.reset()
-        for nbatch, eval_batch in enumerate(eval_data):
+        for nbatch, batch in enumerate(eval_data):
             if num_batch is not None and nbatch == num_batch:
                 break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - pad] for out in self.get_outputs()]
-            yield (outputs, nbatch, eval_batch)
+            self.forward(batch, is_train=False)
+            yield (self._unpadded_outputs(batch), nbatch, batch)
 
     def predict(self, eval_data, num_batch=None, merge_batches=True,
                 reset=True, always_output_list=False, sparse_row_id_fn=None):
-        assert self.binded and self.params_initialized
+        """Run inference over the iterator; concatenate batches by default."""
+        self._require()
         if reset:
             eval_data.reset()
-        output_list = []
-        for nbatch, eval_batch in enumerate(eval_data):
+        collected = []
+        for nbatch, batch in enumerate(eval_data):
             if num_batch is not None and nbatch == num_batch:
                 break
-            self.forward(eval_batch, is_train=False)
-            pad = eval_batch.pad
-            outputs = [out[0:out.shape[0] - pad].copy()
-                       for out in self.get_outputs()]
-            output_list.append(outputs)
-        if len(output_list) == 0:
-            return output_list
-        if merge_batches:
-            num_outputs = len(output_list[0])
-            for out in output_list:
-                assert len(out) == num_outputs, \
-                    "Cannot merge batches, as num of outputs is not the same "\
-                    "in mini-batches. Maybe bucketing is used?"
-            output_list2 = [ndarray.concatenate([out[i]
-                                                 for out in output_list])
-                            for i in range(num_outputs)]
-            if num_outputs == 1 and not always_output_list:
-                return output_list2[0]
-            return output_list2
-        return output_list
+            self.forward(batch, is_train=False)
+            collected.append(self._unpadded_outputs(batch, copy=True))
+        if not collected:
+            return collected
+        if not merge_batches:
+            return collected
+        width = len(collected[0])
+        if any(len(outs) != width for outs in collected):
+            raise ValueError("Cannot merge batches: output arity varies "
+                             "across mini-batches (bucketing?)")
+        merged = [ndarray.concatenate([outs[i] for outs in collected])
+                  for i in range(width)]
+        if width == 1 and not always_output_list:
+            return merged[0]
+        return merged
+
+    # ------------------------------------------------------------------
+    # Training
+    # ------------------------------------------------------------------
+    def _fit_epoch(self, train_data, epoch, eval_metric, batch_end_callback,
+                   monitor, sparse_row_id_fn):
+        """One pass over ``train_data``; returns final metric pairs.
+
+        The next batch is pulled only AFTER forward_backward/update on the
+        current one — iterators are allowed to recycle their batch buffer
+        once next() is called (the reference C++-iterator contract).
+        """
+        final_pairs = []
+        it = iter(train_data)
+        try:
+            batch = next(it)
+        except StopIteration:
+            return final_pairs
+        nbatch = 0
+        while batch is not None:
+            if monitor is not None:
+                monitor.tic()
+            self.forward_backward(batch)
+            self.update()
+            try:
+                upcoming = next(it)
+                self.prepare(upcoming, sparse_row_id_fn=sparse_row_id_fn)
+            except StopIteration:
+                upcoming = None
+            labels, sliced = self._metric_labels(batch)
+            self.update_metric(eval_metric, labels, pre_sliced=sliced)
+            if monitor is not None:
+                monitor.toc_print()
+            if upcoming is None:
+                final_pairs = eval_metric.get_name_value()
+            _fire(batch_end_callback,
+                  BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                eval_metric=eval_metric, locals=locals()))
+            batch = upcoming
+            nbatch += 1
+        return final_pairs
 
     def fit(self, train_data, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
@@ -143,16 +213,19 @@ class BaseModule:
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
             monitor=None, sparse_row_id_fn=None):
-        """The full training loop (parity: base_module.py:409)."""
-        assert num_epoch is not None, "please specify number of epochs"
-        from .. import initializer as init_mod
+        """Train over ``train_data`` for ``num_epoch`` epochs.
 
+        Parity: reference ``base_module.py:409`` — same knobs, same
+        callback firing points, same logging shape.
+        """
+        assert num_epoch is not None, "please specify number of epochs"
         if initializer is None:
-            initializer = init_mod.Uniform(0.01)
+            from .. import initializer as _init
+            initializer = _init.Uniform(0.01)
 
         self.bind(data_shapes=train_data.provide_data,
-                  label_shapes=train_data.provide_label, for_training=True,
-                  force_rebind=force_rebind)
+                  label_shapes=train_data.provide_label,
+                  for_training=True, force_rebind=force_rebind)
         if monitor is not None:
             self.install_monitor(monitor)
         self.init_params(initializer=initializer, arg_params=arg_params,
@@ -161,69 +234,69 @@ class BaseModule:
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
 
+        eval_metric = _coerce_metric(eval_metric)
         if validation_metric is None:
             validation_metric = eval_metric
-        if not isinstance(eval_metric, _metric.EvalMetric):
-            eval_metric = _metric.create(eval_metric)
 
         for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
+            start = time.time()
             eval_metric.reset()
-            nbatch = 0
-            data_iter = iter(train_data)
-            end_of_batch = False
-            next_data_batch = next(data_iter)
-            while not end_of_batch:
-                data_batch = next_data_batch
-                if monitor is not None:
-                    monitor.tic()
-                self.forward_backward(data_batch)
-                self.update()
-                try:
-                    next_data_batch = next(data_iter)
-                    self.prepare(next_data_batch,
-                                 sparse_row_id_fn=sparse_row_id_fn)
-                except StopIteration:
-                    end_of_batch = True
-                if isinstance(data_batch, list):
-                    self.update_metric(eval_metric,
-                                       [db.label for db in data_batch],
-                                       pre_sliced=True)
-                else:
-                    self.update_metric(eval_metric, data_batch.label)
-                if monitor is not None:
-                    monitor.toc_print()
-                if end_of_batch:
-                    eval_name_vals = eval_metric.get_name_value()
-                if batch_end_callback is not None:
-                    batch_end_params = BatchEndParam(epoch=epoch,
-                                                     nbatch=nbatch,
-                                                     eval_metric=eval_metric,
-                                                     locals=locals())
-                    for callback in _as_list(batch_end_callback):
-                        callback(batch_end_params)
-                nbatch += 1
-            for name, val in eval_name_vals:
+            train_pairs = self._fit_epoch(
+                train_data, epoch, eval_metric, batch_end_callback, monitor,
+                sparse_row_id_fn)
+            for name, val in train_pairs:
                 self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            toc = time.time()
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch, (toc - tic))
+            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                             time.time() - start)
 
             arg_params, aux_params = self.get_params()
             self.set_params(arg_params, aux_params)
             if epoch_end_callback is not None:
-                for callback in _as_list(epoch_end_callback):
-                    callback(epoch, self.symbol, arg_params, aux_params)
+                for cb in _as_list(epoch_end_callback):
+                    cb(epoch, self.symbol, arg_params, aux_params)
+
             if eval_data is not None:
-                res = self.score(eval_data, validation_metric,
-                                 score_end_callback=eval_end_callback,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
+                pairs = self.score(eval_data, validation_metric,
+                                   score_end_callback=eval_end_callback,
+                                   batch_end_callback=eval_batch_end_callback,
+                                   epoch=epoch)
+                for name, val in pairs:
                     self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
                                      name, val)
             train_data.reset()
 
-    # -- abstract --------------------------------------------------------
+    # ------------------------------------------------------------------
+    # Parameter persistence
+    # ------------------------------------------------------------------
+    def save_params(self, fname):
+        arg_params, aux_params = self.get_params()
+        blob = {"arg:" + k: v.as_in_context(cpu())
+                for k, v in arg_params.items()}
+        blob.update({"aux:" + k: v.as_in_context(cpu())
+                     for k, v in aux_params.items()})
+        ndarray.save(fname, blob)
+
+    def load_params(self, fname):
+        arg_params, aux_params = {}, {}
+        for key, value in ndarray.load(fname).items():
+            kind, _, name = key.partition(":")
+            if kind == "arg":
+                arg_params[name] = value
+            elif kind == "aux":
+                aux_params[name] = value
+            else:
+                raise ValueError("Invalid param file " + fname)
+        self.set_params(arg_params, aux_params)
+
+    def set_params(self, arg_params, aux_params, allow_missing=False,
+                   force_init=True, allow_extra=False):
+        self.init_params(initializer=None, arg_params=arg_params,
+                         aux_params=aux_params, allow_missing=allow_missing,
+                         force_init=force_init, allow_extra=allow_extra)
+
+    # ------------------------------------------------------------------
+    # Interface for subclasses
+    # ------------------------------------------------------------------
     @property
     def symbol(self):
         return self._symbol
@@ -252,37 +325,8 @@ class BaseModule:
         raise NotImplementedError()
 
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
-                    allow_missing=False, force_init=False,
-                    allow_extra=False):
+                    allow_missing=False, force_init=False, allow_extra=False):
         raise NotImplementedError()
-
-    def set_params(self, arg_params, aux_params, allow_missing=False,
-                   force_init=True, allow_extra=False):
-        self.init_params(initializer=None, arg_params=arg_params,
-                         aux_params=aux_params, allow_missing=allow_missing,
-                         force_init=force_init, allow_extra=allow_extra)
-
-    def save_params(self, fname):
-        arg_params, aux_params = self.get_params()
-        save_dict = {("arg:%s" % k): v.as_in_context(cpu())
-                     for k, v in arg_params.items()}
-        save_dict.update({("aux:%s" % k): v.as_in_context(cpu())
-                          for k, v in aux_params.items()})
-        ndarray.save(fname, save_dict)
-
-    def load_params(self, fname):
-        save_dict = ndarray.load(fname)
-        arg_params = {}
-        aux_params = {}
-        for k, value in save_dict.items():
-            arg_type, name = k.split(":", 1)
-            if arg_type == "arg":
-                arg_params[name] = value
-            elif arg_type == "aux":
-                aux_params[name] = value
-            else:
-                raise ValueError("Invalid param file " + fname)
-        self.set_params(arg_params, aux_params)
 
     def install_monitor(self, mon):
         raise NotImplementedError()

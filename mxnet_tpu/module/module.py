@@ -1,15 +1,20 @@
-"""Module (reference parity: python/mxnet/module/module.py:40 — bind:364 ->
-executor_group; init_optimizer:474; forward:575; backward:629; update:646)."""
+"""Module: symbol + executor-group driver.
+
+API parity target: the reference ``python/mxnet/module/module.py:40``
+(bind:364, init_optimizer:474, forward:575, backward:629, update:646).
+Re-organised: input-name classification happens in one `_classify_inputs`
+pass, optimizer/kvstore wiring lives in dedicated helpers, and the
+per-parameter gradient walk used by update() is a single generator.
+
+On TPU each executor in the group runs one jitted XLA program; Module is
+host-side orchestration over those programs.
+"""
 from __future__ import annotations
 
 import logging
 
-import numpy as np
-
-from ..base import MXNetError
 from ..context import cpu, Context
-from .. import ndarray
-from ..ndarray.ndarray import NDArray, zeros
+from ..ndarray.ndarray import zeros
 from .. import optimizer as opt
 from .. import kvstore as kvs
 from ..io.io import DataDesc
@@ -19,59 +24,68 @@ from .executor_group import DataParallelExecutorGroup
 __all__ = ["Module"]
 
 
+def _descs(shapes):
+    """Normalize (name, shape) tuples / DataDesc into DataDesc list."""
+    if not shapes:
+        return None
+    return [s if isinstance(s, DataDesc) else DataDesc(*s) for s in shapes]
+
+
 class Module(BaseModule):
-    def __init__(self, symbol, data_names=("data",), label_names=("softmax_label",),
-                 logger=logging, context=None, work_load_list=None,
-                 fixed_param_names=None, state_names=None, group2ctxs=None,
-                 compression_params=None):
+    """Executes one Symbol over one or more contexts with data parallelism."""
+
+    def __init__(self, symbol, data_names=("data",),
+                 label_names=("softmax_label",), logger=logging, context=None,
+                 work_load_list=None, fixed_param_names=None,
+                 state_names=None, group2ctxs=None, compression_params=None):
         super().__init__(logger=logger)
-        if context is None:
-            context = cpu()
-        if isinstance(context, Context):
-            context = [context]
-        self._context = context
-        self._work_load_list = work_load_list or [1] * len(context)
+        ctxs = context if context is not None else cpu()
+        if isinstance(ctxs, Context):
+            ctxs = [ctxs]
+        self._context = ctxs
+        self._work_load_list = work_load_list or [1] * len(ctxs)
         self._group2ctxs = group2ctxs
         self._symbol = symbol
-        data_names = list(data_names) if data_names is not None else []
-        label_names = list(label_names) if label_names is not None else []
-        state_names = list(state_names) if state_names is not None else []
-        fixed_param_names = list(fixed_param_names) \
-            if fixed_param_names is not None else []
-        _check_input_names(symbol, data_names, "data", True)
-        _check_input_names(symbol, label_names, "label", False)
-        _check_input_names(symbol, state_names, "state", True)
-        _check_input_names(symbol, fixed_param_names, "fixed_param", True)
-        arg_names = symbol.list_arguments()
-        input_names = data_names + label_names + state_names
-        self._param_names = [x for x in arg_names if x not in input_names]
-        self._fixed_param_names = fixed_param_names
-        self._aux_names = symbol.list_auxiliary_states()
-        self._data_names = data_names
-        self._label_names = [n for n in label_names if n in arg_names]
-        self._state_names = state_names
-        self._output_names = symbol.list_outputs()
-        self._arg_params = None
-        self._aux_params = None
+        self._classify_inputs(symbol, data_names, label_names, state_names,
+                              fixed_param_names)
+        self._arg_params = self._aux_params = None
         self._params_dirty = False
         self._compression_params = compression_params
-        self._optimizer = None
-        self._kvstore = None
-        self._update_on_kvstore = None
-        self._updater = None
-        self._preload_opt_states = None
-        self._exec_group = None
-        self._data_shapes = None
-        self._label_shapes = None
+        self._optimizer = self._kvstore = self._update_on_kvstore = None
+        self._updater = self._preload_opt_states = None
+        self._exec_group = self._data_shapes = self._label_shapes = None
 
+    def _classify_inputs(self, symbol, data_names, label_names, state_names,
+                         fixed_param_names):
+        """Split symbol arguments into data/label/state/param name lists."""
+        data_names = list(data_names or [])
+        label_names = list(label_names or [])
+        state_names = list(state_names or [])
+        fixed_param_names = list(fixed_param_names or [])
+        for names, kind, strict in ((data_names, "data", True),
+                                    (label_names, "label", False),
+                                    (state_names, "state", True),
+                                    (fixed_param_names, "fixed_param", True)):
+            _check_input_names(symbol, names, kind, strict)
+        args = symbol.list_arguments()
+        non_params = set(data_names) | set(label_names) | set(state_names)
+        self._data_names, self._state_names = data_names, state_names
+        self._label_names = [n for n in label_names if n in args]
+        self._fixed_param_names = fixed_param_names
+        self._param_names = [a for a in args if a not in non_params]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._output_names = symbol.list_outputs()
+
+    # ------------------------------------------------------------------
+    # Checkpointing
+    # ------------------------------------------------------------------
     @staticmethod
     def load(prefix, epoch, load_optimizer_states=False, **kwargs):
         from ..model import load_checkpoint
 
         sym, args, auxs = load_checkpoint(prefix, epoch)
         mod = Module(symbol=sym, **kwargs)
-        mod._arg_params = args
-        mod._aux_params = auxs
+        mod._arg_params, mod._aux_params = args, auxs
         mod.params_initialized = True
         if load_optimizer_states:
             mod._preload_opt_states = "%s-%04d.states" % (prefix, epoch)
@@ -83,81 +97,63 @@ class Module(BaseModule):
         self._sync_params_from_devices()
         save_checkpoint(prefix, epoch, self.symbol, *self.get_params())
         if save_optimizer_states:
-            state_name = "%s-%04d.states" % (prefix, epoch)
-            self.save_optimizer_states(state_name)
+            self.save_optimizer_states("%s-%04d.states" % (prefix, epoch))
 
-    # -- properties ------------------------------------------------------
-    @property
-    def data_names(self):
-        return self._data_names
+    # ------------------------------------------------------------------
+    # Properties
+    # ------------------------------------------------------------------
+    data_names = property(lambda self: self._data_names)
+    label_names = property(lambda self: self._label_names)
+    output_names = property(lambda self: self._output_names)
 
-    @property
-    def label_names(self):
-        return self._label_names
+    def _bound(self, attr):
+        assert self.binded, "module is not bound"
+        return getattr(self, attr)
 
-    @property
-    def output_names(self):
-        return self._output_names
-
-    @property
-    def data_shapes(self):
-        assert self.binded
-        return self._data_shapes
-
-    @property
-    def label_shapes(self):
-        assert self.binded
-        return self._label_shapes
+    data_shapes = property(lambda self: self._bound("_data_shapes"))
+    label_shapes = property(lambda self: self._bound("_label_shapes"))
 
     @property
     def output_shapes(self):
-        assert self.binded
-        outs = self._exec_group.get_outputs()
+        outs = self._bound("_exec_group").get_outputs()
         return list(zip(self._output_names, [o.shape for o in outs]))
 
-    # -- params ----------------------------------------------------------
+    # ------------------------------------------------------------------
+    # Parameters
+    # ------------------------------------------------------------------
     def get_params(self):
-        assert self.binded and self.params_initialized
+        self._require()
         if self._params_dirty:
             self._sync_params_from_devices()
         return (self._arg_params, self._aux_params)
 
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
                     allow_missing=False, force_init=False, allow_extra=False):
-        from .. import initializer as init_mod
-
         if self.params_initialized and not force_init:
             return
         assert self.binded, "call bind before initializing the parameters"
+        from ..initializer import InitDesc, Uniform
+
         if initializer is None:
-            initializer = init_mod.Uniform(0.01)
-
-        def _impl(name, arr, cache):
-            if cache is not None:
-                if name in cache:
-                    cache_arr = cache[name]
-                    if cache_arr is not arr:
-                        cache_arr.copyto(arr)
-                else:
-                    if not allow_missing:
-                        raise RuntimeError(
-                            "%s is not presented" % name)
-                    if initializer is not None:
-                        initializer(name, arr)
-            else:
-                initializer(name, arr)
-
+            initializer = Uniform(0.01)
         attrs = self._symbol.attr_dict()
-        for name, arr in sorted(self._arg_params.items()):
-            from ..initializer import InitDesc
 
-            desc = InitDesc(name, attrs.get(name, None))
-            _impl(desc, arr, arg_params)
-        for name, arr in sorted(self._aux_params.items()):
-            from ..initializer import InitDesc
+        def _fill(store, source):
+            for name in sorted(store):
+                arr = store[name]
+                desc = InitDesc(name, attrs.get(name, None))
+                if source is None:
+                    initializer(desc, arr)
+                elif name in source:
+                    if source[name] is not arr:
+                        source[name].copyto(arr)
+                elif not allow_missing:
+                    raise RuntimeError("%s is not presented" % name)
+                elif initializer is not None:
+                    initializer(desc, arr)
 
-            desc = InitDesc(name, attrs.get(name, None))
-            _impl(desc, arr, aux_params)
+        _fill(self._arg_params, arg_params)
+        _fill(self._aux_params, aux_params)
         self.params_initialized = True
         self._params_dirty = False
         self._exec_group.set_params(self._arg_params, self._aux_params,
@@ -167,8 +163,7 @@ class Module(BaseModule):
                    force_init=True, allow_extra=False):
         if not allow_missing:
             self.init_params(initializer=None, arg_params=arg_params,
-                             aux_params=aux_params,
-                             allow_missing=allow_missing,
+                             aux_params=aux_params, allow_missing=False,
                              force_init=force_init, allow_extra=allow_extra)
             return
         if self.params_initialized and not force_init:
@@ -178,7 +173,9 @@ class Module(BaseModule):
         self._params_dirty = True
         self.params_initialized = True
 
-    # -- bind ------------------------------------------------------------
+    # ------------------------------------------------------------------
+    # Binding
+    # ------------------------------------------------------------------
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
              grad_req="write"):
@@ -187,17 +184,14 @@ class Module(BaseModule):
         if self.binded:
             self.logger.warning("Already bound, ignoring bind()")
             return
+        if not for_training:
+            assert not inputs_need_grad
         self.for_training = for_training
         self.inputs_need_grad = inputs_need_grad
         self._grad_req = grad_req
-        if not for_training:
-            assert not inputs_need_grad
+        self._data_shapes = _descs(data_shapes)
+        self._label_shapes = _descs(label_shapes)
 
-        self._data_shapes = [d if isinstance(d, DataDesc) else DataDesc(*d)
-                             for d in data_shapes]
-        self._label_shapes = ([l if isinstance(l, DataDesc) else DataDesc(*l)
-                               for l in label_shapes]
-                              if label_shapes else None)
         shared_group = None
         if shared_module is not None:
             assert isinstance(shared_module, Module) and \
@@ -211,167 +205,164 @@ class Module(BaseModule):
             self._fixed_param_names, grad_req, self._state_names,
             self._group2ctxs)
         self.binded = True
+
         if shared_module is not None and shared_module.params_initialized:
             self._arg_params = shared_module._arg_params
             self._aux_params = shared_module._aux_params
             self.params_initialized = True
         elif self._arg_params is None:
+            exec0 = self._exec_group.execs[0]
             self._arg_params = {
-                name: zeros(self._exec_group.execs[0].arg_dict[name].shape,
-                            dtype=self._exec_group.execs[0].arg_dict[name].dtype)
-                for name in self._param_names
-                if name in self._exec_group.execs[0].arg_dict}
-            self._aux_params = {
-                name: zeros(arr.shape, dtype=arr.dtype)
-                for name, arr in self._exec_group.execs[0].aux_dict.items()}
+                n: zeros(exec0.arg_dict[n].shape,
+                         dtype=exec0.arg_dict[n].dtype)
+                for n in self._param_names if n in exec0.arg_dict}
+            self._aux_params = {n: zeros(a.shape, dtype=a.dtype)
+                                for n, a in exec0.aux_dict.items()}
         elif self.params_initialized:
             self._exec_group.set_params(self._arg_params, self._aux_params)
 
     def _reset_bind(self):
         self.binded = False
-        self._exec_group = None
-        self._data_shapes = None
-        self._label_shapes = None
+        self._exec_group = self._data_shapes = self._label_shapes = None
 
     def reshape(self, data_shapes, label_shapes=None):
         assert self.binded
-        self._data_shapes = [d if isinstance(d, DataDesc) else DataDesc(*d)
-                             for d in data_shapes]
-        self._label_shapes = ([l if isinstance(l, DataDesc) else DataDesc(*l)
-                               for l in label_shapes]
-                              if label_shapes else None)
+        self._data_shapes = _descs(data_shapes)
+        self._label_shapes = _descs(label_shapes)
         self._exec_group.bind_exec(self._data_shapes, self._label_shapes,
                                    reshape=True)
         self._exec_group.set_params(self._arg_params, self._aux_params)
 
-    # -- optimizer -------------------------------------------------------
+    # ------------------------------------------------------------------
+    # Optimizer
+    # ------------------------------------------------------------------
+    def _effective_batch_size(self, kvstore_obj):
+        bs = self._exec_group.batch_size
+        if kvstore_obj and "dist" in kvstore_obj.type and \
+                "_sync" in kvstore_obj.type:
+            bs *= kvstore_obj.num_workers
+        return bs
+
+    def _build_optimizer(self, optimizer, optimizer_params, rescale_grad):
+        idx2name = dict(enumerate(self._param_names))
+        if isinstance(optimizer, str):
+            params = dict(optimizer_params)
+            params.setdefault("rescale_grad", rescale_grad)
+            return opt.create(optimizer, sym=self.symbol,
+                              param_idx2name=idx2name, **params)
+        assert isinstance(optimizer, opt.Optimizer)
+        if optimizer.rescale_grad != rescale_grad:
+            self.logger.warning(
+                "Optimizer created manually outside Module but rescale_grad "
+                "is not normalized to 1.0/batch_size/num_workers (%s vs. "
+                "%s).", optimizer.rescale_grad, rescale_grad)
+        if not optimizer.idx2name:
+            optimizer.idx2name = idx2name
+        return optimizer
+
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False):
-        assert self.binded and self.params_initialized
+        self._require()
         if self.optimizer_initialized and not force_init:
             self.logger.warning("optimizer already initialized, ignoring...")
             return
         if self._params_dirty:
             self._sync_params_from_devices()
 
-        kvstore_obj = kvs.create(kvstore) if isinstance(kvstore, str) \
-            else kvstore
-        update_on_kvstore = bool(kvstore_obj) and \
-            kvstore_obj.type.startswith("dist")
-        batch_size = self._exec_group.batch_size
-        if kvstore_obj and "dist" in kvstore_obj.type and \
-                "_sync" in kvstore_obj.type:
-            batch_size *= kvstore_obj.num_workers
-        rescale_grad = 1.0 / batch_size
-
-        idx2name = {i: n for i, n in enumerate(self._param_names)}
-        if isinstance(optimizer, str):
-            optimizer_params = dict(optimizer_params)
-            if "rescale_grad" not in optimizer_params:
-                optimizer_params["rescale_grad"] = rescale_grad
-            optimizer = opt.create(optimizer, sym=self.symbol,
-                                   param_idx2name=idx2name,
-                                   **optimizer_params)
-        else:
-            assert isinstance(optimizer, opt.Optimizer)
-            if optimizer.rescale_grad != rescale_grad:
-                self.logger.warning(
-                    "Optimizer created manually outside Module but "
-                    "rescale_grad is not normalized to 1.0/batch_size/"
-                    "num_workers (%s vs. %s).", optimizer.rescale_grad,
-                    rescale_grad)
-            if not optimizer.idx2name:
-                optimizer.idx2name = idx2name.copy()
-
-        self._optimizer = optimizer
-        self._kvstore = kvstore_obj
+        store = kvs.create(kvstore) if isinstance(kvstore, str) else kvstore
+        update_on_kvstore = bool(store) and store.type.startswith("dist")
+        rescale = 1.0 / self._effective_batch_size(store)
+        self._optimizer = self._build_optimizer(optimizer, optimizer_params,
+                                                rescale)
+        self._kvstore = store
         self._update_on_kvstore = update_on_kvstore
         self._updater = None
-        if kvstore_obj:
+
+        if store:
             if self._compression_params:
-                kvstore_obj.set_gradient_compression(self._compression_params)
-            for i, name in enumerate(self._param_names):
+                store.set_gradient_compression(self._compression_params)
+            for idx, name in enumerate(self._param_names):
                 if name in self._arg_params:
-                    kvstore_obj.init(i, self._arg_params[name])
+                    store.init(idx, self._arg_params[name])
             if update_on_kvstore:
-                kvstore_obj.set_optimizer(self._optimizer)
+                store.set_optimizer(self._optimizer)
         if not update_on_kvstore:
-            self._updater = opt.get_updater(optimizer)
+            self._updater = opt.get_updater(self._optimizer)
         self.optimizer_initialized = True
+
         if self._preload_opt_states is not None:
             self.load_optimizer_states(self._preload_opt_states)
             self._preload_opt_states = None
 
-    # -- compute ---------------------------------------------------------
+    # ------------------------------------------------------------------
+    # Compute
+    # ------------------------------------------------------------------
     def forward(self, data_batch, is_train=None):
-        assert self.binded and self.params_initialized
-        curr_data_shapes = tuple(i.shape for i in self._data_shapes)
-        if isinstance(data_batch, list):
-            new_data_shapes = tuple(d.shape for d in data_batch[0].data)
-        else:
-            new_data_shapes = tuple(d.shape for d in data_batch.data)
-        if curr_data_shapes != new_data_shapes:
-            if hasattr(data_batch, "provide_data") and data_batch.provide_data:
-                new_dshape = data_batch.provide_data
-            else:
-                new_dshape = [
-                    DataDesc(i.name, shape, i.dtype, i.layout)
-                    for i, shape in zip(self._data_shapes, new_data_shapes)]
-            if hasattr(data_batch, "provide_label") and \
-                    data_batch.provide_label:
-                new_lshape = data_batch.provide_label
-            elif hasattr(data_batch, "label") and data_batch.label:
-                new_lshape = [
-                    DataDesc(i.name, j.shape, i.dtype, i.layout)
-                    for i, j in zip(self._label_shapes, data_batch.label)]
-            else:
-                new_lshape = None
-            self.reshape(new_dshape, new_lshape)
+        self._require()
+        first = data_batch[0] if isinstance(data_batch, list) else data_batch
+        incoming = tuple(d.shape for d in first.data)
+        bound = tuple(d.shape for d in self._data_shapes)
+        if incoming != bound:
+            self.reshape(*self._shapes_from_batch(data_batch, incoming))
         self._exec_group.forward(data_batch, is_train)
 
+    def _shapes_from_batch(self, batch, incoming):
+        """Derive (data_descs, label_descs) for a shape-changing batch."""
+        if getattr(batch, "provide_data", None):
+            dshapes = batch.provide_data
+        else:
+            dshapes = [DataDesc(d.name, s, d.dtype, d.layout)
+                       for d, s in zip(self._data_shapes, incoming)]
+        if getattr(batch, "provide_label", None):
+            lshapes = batch.provide_label
+        elif getattr(batch, "label", None):
+            lshapes = [DataDesc(d.name, arr.shape, d.dtype, d.layout)
+                       for d, arr in zip(self._label_shapes, batch.label)]
+        else:
+            lshapes = None
+        return dshapes, lshapes
+
     def backward(self, out_grads=None):
-        assert self.binded and self.params_initialized
+        self._require()
         self._exec_group.backward(out_grads=out_grads)
 
+    def _grad_walk(self):
+        """Yield (idx, name, grad_list, arg_list) per learnable param."""
+        for idx, name in enumerate(self._param_names):
+            grads = [e.grad_dict[name] for e in self._exec_group.execs
+                     if name in e.grad_dict]
+            if grads:
+                args = [e.arg_dict[name] for e in self._exec_group.execs
+                        if name in e.grad_dict]
+                yield idx, name, grads, args
+
     def update(self):
-        assert self.binded and self.params_initialized and \
-            self.optimizer_initialized
+        self._require()
+        assert self.optimizer_initialized
         self._params_dirty = True
         if self._update_on_kvstore:
-            for i, name in enumerate(self._param_names):
-                grads = [exe.grad_dict[name]
-                         for exe in self._exec_group.execs
-                         if name in exe.grad_dict]
-                if not grads:
-                    continue
-                self._kvstore.push(i, grads)
-                self._kvstore.pull(i, [exe.arg_dict[name]
-                                       for exe in self._exec_group.execs])
-        else:
-            if self._kvstore:
-                for i, name in enumerate(self._param_names):
-                    grads = [exe.grad_dict[name]
-                             for exe in self._exec_group.execs
-                             if name in exe.grad_dict]
-                    if not grads:
-                        continue
-                    self._kvstore.push(i, grads)
-                    self._kvstore.pull(i, grads)
-            for i, name in enumerate(self._param_names):
-                for exe in self._exec_group.execs:
-                    if name in exe.grad_dict:
-                        self._updater(i, exe.grad_dict[name],
-                                      exe.arg_dict[name])
+            for idx, _, grads, args in self._grad_walk():
+                self._kvstore.push(idx, grads)
+                self._kvstore.pull(idx, args)
+            return
+        if self._kvstore:
+            # Reduce across devices through the store, then update locally.
+            for idx, _, grads, _ in self._grad_walk():
+                self._kvstore.push(idx, grads)
+                self._kvstore.pull(idx, grads)
+        for idx, _, grads, args in self._grad_walk():
+            for g, a in zip(grads, args):
+                self._updater(idx, g, a)
 
     def get_outputs(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized
+        self._require()
         return self._exec_group.get_outputs(
             merge_multi_context=merge_multi_context)
 
     def get_input_grads(self, merge_multi_context=True):
-        assert self.binded and self.params_initialized and \
-            self.inputs_need_grad
+        self._require()
+        assert self.inputs_need_grad
         return self._exec_group.get_input_grads(
             merge_multi_context=merge_multi_context)
 
@@ -381,18 +372,21 @@ class Module(BaseModule):
     def _sync_params_from_devices(self):
         self._exec_group.get_params(self._arg_params, self._aux_params)
         if self._kvstore and self._update_on_kvstore:
-            for i, name in enumerate(self._param_names):
+            for idx, name in enumerate(self._param_names):
                 if name in self._arg_params:
-                    self._kvstore.pull(i, [self._arg_params[name]])
+                    self._kvstore.pull(idx, [self._arg_params[name]])
         self._params_dirty = False
 
+    # ------------------------------------------------------------------
+    # Optimizer state persistence
+    # ------------------------------------------------------------------
     def save_optimizer_states(self, fname):
         assert self.optimizer_initialized
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
-            with open(fname, "wb") as fout:
-                fout.write(self._updater.get_states())
+            with open(fname, "wb") as f:
+                f.write(self._updater.get_states())
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized
