@@ -19,3 +19,7 @@ for _op in _list_ops():
         globals()[_op[len("_contrib_"):]] = _make(_op)
         globals()[_op] = _make(_op)
 del _op
+
+
+# control-flow surface (parity: ndarray/contrib.py foreach/while_loop/cond)
+from ..ops.control_flow import foreach, while_loop, cond  # noqa: F401,E402

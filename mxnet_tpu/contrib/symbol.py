@@ -18,3 +18,9 @@ for _op in _list_ops():
         globals()[_op[len("_contrib_"):]] = _make(_op)
         globals()[_op] = _make(_op)
 del _op
+
+
+# control-flow surface (parity: symbol/contrib.py foreach/while_loop/cond)
+from ..ops.control_flow import (sym_foreach as foreach,  # noqa: F401,E402
+                                sym_while_loop as while_loop,
+                                sym_cond as cond)

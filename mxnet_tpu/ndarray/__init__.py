@@ -7,6 +7,7 @@ from ..ops import nn as _ops_nn  # noqa: F401
 from ..ops import random_ops as _ops_random  # noqa: F401
 from ..ops import optimizer_ops as _ops_opt  # noqa: F401
 from ..ops import contrib_ops as _ops_contrib  # noqa: F401
+from ..ops import control_flow as _ops_cf  # noqa: F401
 
 from .ndarray import (  # noqa: F401
     NDArray, array, zeros, ones, empty, full, arange, concatenate, concat,
@@ -23,3 +24,11 @@ from ..ops.registry import list_ops as _list_ops  # noqa: E402
 __all__ = ["NDArray", "array", "zeros", "ones", "empty", "full", "arange",
            "concatenate", "concat", "stack", "moveaxis", "waitall", "save",
            "load", "sparse", "csr_matrix", "row_sparse_array"] + _list_ops()
+
+
+def __getattr__(name):
+    # lazy alias: mx.nd.contrib -> mx.contrib.ndarray (avoids import cycle)
+    if name == "contrib":
+        from ..contrib import ndarray as _contrib_nd
+        return _contrib_nd
+    raise AttributeError(name)

@@ -1,0 +1,537 @@
+"""Control-flow operators: foreach / while_loop / cond.
+
+Reference parity: the imperative surface
+``python/mxnet/ndarray/contrib.py:136,232,400`` and the symbolic surface
+``python/mxnet/symbol/contrib.py:212,375,598``, backed in the reference
+by the fused C++ ops ``src/operator/control_flow.cc:1255,1316,1378``.
+
+TPU-native design: the compiled path lowers directly onto the XLA
+structured-control-flow primitives — ``lax.scan`` for foreach,
+``lax.scan`` + ``lax.cond`` with an alive mask for while_loop (fixed
+trip count = ``max_iterations``, so shapes stay static for the TPU),
+and ``lax.cond`` for cond.  Under an eager ``autograd.record()`` scope
+the imperative implementations instead run the loop in Python with
+ordinary taped ops — exactly what the reference's imperative versions
+do — so gradients flow through loop-carried state *and* captured
+arrays.  Inside a jit trace (hybridize / CachedOp / Symbol executor)
+the lax path is always used and jax differentiates through it.
+"""
+from __future__ import annotations
+
+import itertools
+
+from ..base import MXNetError
+from .registry import register
+
+__all__ = ["foreach", "while_loop", "cond",
+           "sym_foreach", "sym_while_loop", "sym_cond"]
+
+_uid = itertools.count()
+
+
+# ---------------------------------------------------------------------------
+# nested-structure helpers (parity: _flatten/_regroup in python/mxnet/base.py)
+# ---------------------------------------------------------------------------
+
+
+def _flatten(obj):
+    """Flatten nested lists/tuples into (leaves, format-template)."""
+    if isinstance(obj, (list, tuple)):
+        flat, fmt = [], []
+        for item in obj:
+            f, sub = _flatten(item)
+            flat.extend(f)
+            fmt.append(sub)
+        return flat, fmt
+    return [obj], 0
+
+
+def _regroup(flat, fmt):
+    """Inverse of _flatten; returns (structure, leftovers)."""
+    if fmt == 0:
+        return flat[0], flat[1:]
+    out = []
+    for sub in fmt:
+        item, flat = _regroup(flat, sub)
+        out.append(item)
+    return out, flat
+
+
+def _shape(flat, fmt):
+    return _regroup(flat, fmt)[0]
+
+
+def _tracer(x):
+    import jax
+
+    return isinstance(x, jax.core.Tracer)
+
+
+def _squeeze_bool(c):
+    import jax.numpy as jnp
+
+    return jnp.squeeze(c).astype(bool)
+
+
+# ---------------------------------------------------------------------------
+# imperative surface (mx.nd.contrib.*)
+# ---------------------------------------------------------------------------
+
+
+def _check_nd(flat, what):
+    from ..ndarray.ndarray import NDArray
+
+    for x in flat:
+        if not isinstance(x, NDArray):
+            raise MXNetError("%s should be an NDArray or a nested list of "
+                             "NDArrays, got %r" % (what, type(x)))
+
+
+def _concrete(flat_nd):
+    """True when every input holds a real array (not a jit tracer).
+
+    Concrete inputs take the imperative Python path — the reference's
+    imperative control flow always executes the body eagerly, so bodies
+    may branch in Python, call .asnumpy(), etc., and taped ops record
+    gradients.  Tracer inputs (hybridize / CachedOp / Symbol executor)
+    take the lax structured-control-flow path.
+    """
+    return not any(_tracer(x._data) for x in flat_nd)
+
+
+def foreach(body, data, init_states):
+    """Scan ``body`` over axis 0 of ``data``, threading loop state.
+
+    ``out, states = body(slice, states)``; returns (stacked outs, final
+    states).  Parity: ``ndarray/contrib.py:136``; compiled path is one
+    ``lax.scan``.
+    """
+    from jax import lax
+
+    from ..ndarray.ndarray import NDArray, _invoke_nd
+
+    flat_data, data_fmt = _flatten(data)
+    flat_states, state_fmt = _flatten(init_states)
+    _check_nd(flat_data, "data")
+    _check_nd(flat_states, "init_states")
+    if not flat_data:
+        raise MXNetError("foreach needs at least one data array")
+
+    if _concrete(flat_data + flat_states) and flat_data[0].shape[0] > 0:
+        # reference-imperative path: plain Python loop over taped ops
+        states = init_states
+        rows = []
+        out_fmt = 0
+        for i in range(flat_data[0].shape[0]):
+            eles = _shape([d[i] for d in flat_data], data_fmt)
+            out, states = body(eles, states)
+            flat_out, out_fmt = _flatten(out)
+            rows.append(flat_out)
+        stacked = [_invoke_nd("stack", list(col), {"axis": 0})
+                   for col in zip(*rows)]
+        return _shape(stacked, out_fmt), states
+    # zero-length data falls through to the traced path, which recovers
+    # the output shapes by abstract evaluation of the body
+
+    fmt_box = {}
+
+    def step(carry, xs):
+        states = _shape([NDArray(c) for c in carry], state_fmt)
+        eles = _shape([NDArray(x) for x in xs], data_fmt)
+        out, new_states = body(eles, states)
+        flat_out, fmt_box["out"] = _flatten(out)
+        flat_new, _ = _flatten(new_states)
+        return (tuple(x._data for x in flat_new),
+                tuple(x._data for x in flat_out))
+
+    final, stacked = lax.scan(step, tuple(x._data for x in flat_states),
+                              tuple(x._data for x in flat_data))
+    outs = _shape([NDArray(s) for s in stacked], fmt_box["out"])
+    states = _shape([NDArray(c) for c in final], state_fmt)
+    return outs, states
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """Iterate ``func`` while ``cond`` holds, at most ``max_iterations``.
+
+    Returns (stacked step outputs padded to ``max_iterations`` rows,
+    final loop_vars).  Parity: ``ndarray/contrib.py:232`` — like the
+    reference, rows past the termination step are undefined (here:
+    zeros, for fixed XLA shapes).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..ndarray.ndarray import NDArray, _invoke_nd, zeros as nd_zeros
+
+    if max_iterations is None:
+        raise ValueError("max_iterations should be specified")
+    max_iterations = int(max_iterations.asscalar()
+                         if isinstance(max_iterations, NDArray)
+                         else max_iterations)
+    flat_vars, var_fmt = _flatten(loop_vars)
+    if not flat_vars:
+        raise ValueError("loop_vars should contain at least one element")
+    _check_nd(flat_vars, "loop_vars")
+
+    def as_args(flat_nd):
+        """Rebuild the caller's loop_vars structure as call arguments."""
+        top = _shape(flat_nd, var_fmt)
+        return [top] if var_fmt == 0 else list(top)
+
+    def call_func(flat_nd):
+        step_out, new_vars = func(*as_args(flat_nd))
+        step_out = [] if step_out is None else step_out
+        flat_new, _ = _flatten([] if new_vars is None else new_vars)
+        if len(flat_new) != len(flat_vars):
+            raise ValueError("The length of loop_vars should be consistent "
+                             "during the loop")
+        return step_out, flat_new
+
+    if _concrete(flat_vars):
+        cur = list(flat_vars)
+        rows, out_fmt, steps = [], None, 0
+        while steps < max_iterations and \
+                bool(cond(*as_args(cur)).asscalar()):
+            step_out, cur = call_func(cur)
+            flat_out, out_fmt = _flatten(step_out)
+            rows.append(flat_out)
+            steps += 1
+        if not rows:
+            return [], _shape(cur, var_fmt)
+        cols = []
+        for col in zip(*rows):
+            col = list(col)
+            if steps < max_iterations:  # zero padding to the static length
+                pad = nd_zeros((max_iterations - steps,) + col[0].shape,
+                               dtype=col[0].dtype)
+                stacked = _invoke_nd("stack", col, {"axis": 0})
+                cols.append(_invoke_nd("concat", [stacked, pad],
+                                       {"dim": 0}))
+            else:
+                cols.append(_invoke_nd("stack", col, {"axis": 0}))
+        return _shape(cols, out_fmt), _shape(cur, var_fmt)
+
+    fmt_box = {}
+
+    def func_flat(vars_raw):
+        step_out, flat_new = call_func([NDArray(v) for v in vars_raw])
+        flat_out, fmt_box["out"] = _flatten(step_out)
+        return (tuple(x._data for x in flat_out),
+                tuple(x._data for x in flat_new))
+
+    def cond_flat(vars_raw):
+        return _squeeze_bool(
+            cond(*as_args([NDArray(v) for v in vars_raw]))._data)
+
+    vars0 = tuple(v._data for v in flat_vars)
+    out_avals = jax.eval_shape(lambda v: func_flat(v)[0], vars0)
+
+    def step(carry, _):
+        alive, cur = carry
+
+        def live(cur):
+            outs, new = func_flat(cur)
+            return new, outs, cond_flat(new)
+
+        def dead(cur):
+            return (cur,
+                    tuple(jnp.zeros(a.shape, a.dtype) for a in out_avals),
+                    jnp.asarray(False))
+
+        new, outs, more = lax.cond(alive, live, dead, cur)
+        return (alive & more, new), outs
+
+    alive0 = cond_flat(vars0)
+    (_, final), stacked = lax.scan(step, (alive0, vars0), None,
+                                   length=max_iterations)
+    outs = _shape([NDArray(s) for s in stacked], fmt_box["out"])
+    return outs, _shape([NDArray(v) for v in final], var_fmt)
+
+
+def cond(pred, then_func, else_func):
+    """If-then-else on a scalar predicate.  Parity:
+    ``ndarray/contrib.py:400``; compiled path is ``lax.cond``."""
+    from jax import lax
+
+    from ..ndarray.ndarray import NDArray
+
+    if not isinstance(pred, NDArray):
+        raise MXNetError("pred should be an NDArray")
+
+    if not _tracer(pred._data):
+        # concrete predicate: run only the chosen branch (taped if
+        # recording, exactly like the reference's imperative cond)
+        return then_func() if bool(pred.asscalar()) else else_func()
+
+    fmt_box = {}
+
+    def branch(fn):
+        def run(_):
+            flat, fmt = _flatten(fn())
+            if "fmt" in fmt_box and fmt_box["fmt"] != fmt:
+                raise ValueError("then_func and else_func must produce "
+                                 "outputs of the same structure")
+            fmt_box["fmt"] = fmt
+            return tuple(x._data for x in flat)
+
+        return run
+
+    outs = lax.cond(_squeeze_bool(pred._data), branch(then_func),
+                    branch(else_func), None)
+    return _shape([NDArray(o) for o in outs], fmt_box["fmt"])
+
+
+# ---------------------------------------------------------------------------
+# registered graph ops (Symbol executor path)
+# ---------------------------------------------------------------------------
+
+
+def _n_cf_outputs(attrs):
+    return attrs["_n_out"] + attrs.get("_n_state", 0)
+
+
+@register("_foreach", num_inputs=-1, num_outputs=_n_cf_outputs)
+def _foreach_op(*arrays, _sub=None, _n_data=0, _n_state=0, _n_out=0,
+                _data_names=(), _state_names=(), _cap_names=()):
+    """Graph form of foreach: inputs are [data..., states..., captured...];
+    outputs are [stacked step outputs..., final states...]."""
+    from jax import lax
+
+    nd_, ns = _n_data, _n_state
+    data = arrays[:nd_]
+    states = arrays[nd_:nd_ + ns]
+    caps = dict(zip(_cap_names, arrays[nd_ + ns:]))
+
+    def step(carry, xs):
+        vm = dict(zip(_state_names, carry))
+        vm.update(zip(_data_names, xs))
+        vm.update(caps)
+        outs, _ = _sub(vm)
+        return tuple(outs[_n_out:]), tuple(outs[:_n_out])
+
+    final, stacked = lax.scan(step, tuple(states), tuple(data))
+    res = tuple(stacked) + tuple(final)
+    return res if len(res) > 1 else res[0]
+
+
+@register("_while_loop", num_inputs=-1, num_outputs=_n_cf_outputs)
+def _while_loop_op(*arrays, _cond_sub=None, _func_sub=None, _n_state=0,
+                   _n_out=0, _max_iter=0, _state_names=(), _cap_names=()):
+    """Graph form of while_loop over a masked fixed-length scan."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    states = arrays[:_n_state]
+    caps = dict(zip(_cap_names, arrays[_n_state:]))
+
+    def vm_of(cur):
+        vm = dict(zip(_state_names, cur))
+        vm.update(caps)
+        return vm
+
+    def cond_val(cur):
+        (c,), _ = _cond_sub(vm_of(cur))
+        return _squeeze_bool(c)
+
+    def func_val(cur):
+        outs, _ = _func_sub(vm_of(cur))
+        return tuple(outs[:_n_out]), tuple(outs[_n_out:])
+
+    out_avals = jax.eval_shape(lambda v: func_val(v)[0], tuple(states))
+
+    def step(carry, _):
+        alive, cur = carry
+
+        def live(cur):
+            outs, new = func_val(cur)
+            return new, outs, cond_val(new)
+
+        def dead(cur):
+            return (cur,
+                    tuple(jnp.zeros(a.shape, a.dtype) for a in out_avals),
+                    jnp.asarray(False))
+
+        new, outs, more = lax.cond(alive, live, dead, cur)
+        return (alive & more, new), outs
+
+    (_, final), stacked = lax.scan(step, (cond_val(tuple(states)),
+                                          tuple(states)),
+                                   None, length=_max_iter)
+    res = tuple(stacked) + tuple(final)
+    return res if len(res) > 1 else res[0]
+
+
+@register("_cond", num_inputs=-1,
+          num_outputs=lambda attrs: attrs["_n_out"])
+def _cond_op(*arrays, _then_sub=None, _else_sub=None, _then_caps=(),
+             _else_caps=(), _n_out=0):
+    """Graph form of cond: inputs are [pred, then-captures...,
+    else-captures...]."""
+    from jax import lax
+
+    pred = arrays[0]
+    nt = len(_then_caps)
+    tvm = dict(zip(_then_caps, arrays[1:1 + nt]))
+    evm = dict(zip(_else_caps, arrays[1 + nt:]))
+
+    def t(_):
+        outs, _2 = _then_sub(tvm)
+        return tuple(outs)
+
+    def e(_):
+        outs, _2 = _else_sub(evm)
+        return tuple(outs)
+
+    outs = lax.cond(_squeeze_bool(pred), t, e, None)
+    return outs if len(outs) > 1 else outs[0]
+
+
+# ---------------------------------------------------------------------------
+# symbolic surface (mx.sym.contrib.*)
+# ---------------------------------------------------------------------------
+
+
+def _trace_subgraph(build, n_placeholders_prefix):
+    """Trace a Symbol-level closure; returns (compiled value-map fn,
+    [placeholder names], [captured names], [captured Symbols])."""
+    from ..symbol import symbol as S
+
+    outs_syms = build()
+    sub = S.Group(outs_syms)
+    fn, arg_names, aux_names = sub._build_fn()
+    ph = set(n_placeholders_prefix)
+    cap_names, cap_syms = [], []
+    arg_nodes, aux_nodes = sub._arg_nodes(with_aux=True)
+    for node in list(arg_nodes) + list(aux_nodes):
+        if node.name not in ph:
+            cap_names.append(node.name)
+            cap_syms.append(S.Symbol([(node, 0)]))
+    return fn, cap_names, cap_syms
+
+
+def sym_foreach(body, data, init_states, name="foreach"):
+    """Symbol foreach (parity: symbol/contrib.py:212)."""
+    from ..symbol import symbol as S
+
+    flat_data, data_fmt = _flatten(data)
+    flat_states, state_fmt = _flatten(init_states)
+    uid = next(_uid)
+    data_names = ["_cf%d_data%d" % (uid, i) for i in range(len(flat_data))]
+    state_names = ["_cf%d_state%d" % (uid, i)
+                   for i in range(len(flat_states))]
+    fmt_box = {}
+
+    def build():
+        eles = _shape([S.var(n) for n in data_names], data_fmt)
+        states = _shape([S.var(n) for n in state_names], state_fmt)
+        out, new_states = body(eles, states)
+        flat_out, fmt_box["out"] = _flatten(out)
+        flat_new, _ = _flatten(new_states)
+        return flat_out + flat_new
+
+    fn, cap_names, cap_syms = _trace_subgraph(
+        build, data_names + state_names)
+    n_out = _leaf_count(fmt_box["out"])
+    res = S._invoke_sym(
+        "_foreach", flat_data + flat_states + cap_syms,
+        {"_sub": fn, "_n_data": len(flat_data),
+         "_n_state": len(flat_states), "_n_out": n_out,
+         "_data_names": tuple(data_names),
+         "_state_names": tuple(state_names),
+         "_cap_names": tuple(cap_names)}, name=name)
+    outs = _shape([res[i] for i in range(n_out)], fmt_box["out"])
+    states = _shape([res[n_out + i] for i in range(len(flat_states))],
+                    state_fmt)
+    return outs, states
+
+
+def _leaf_count(fmt):
+    if fmt == 0:
+        return 1
+    return sum(_leaf_count(f) for f in fmt)
+
+
+def sym_while_loop(cond, func, loop_vars, max_iterations=None,
+                   name="while_loop"):
+    """Symbol while_loop (parity: symbol/contrib.py:375)."""
+    from ..symbol import symbol as S
+
+    if max_iterations is None:
+        raise ValueError("max_iterations should be specified")
+    single = isinstance(loop_vars, S.Symbol)
+    flat_vars = [loop_vars] if single else list(loop_vars)
+    uid = next(_uid)
+    state_names = ["_cf%d_var%d" % (uid, i) for i in range(len(flat_vars))]
+    fmt_box = {}
+
+    def build_cond():
+        return [cond(*[S.var(n) for n in state_names])]
+
+    def build_func():
+        step_out, new_vars = func(*[S.var(n) for n in state_names])
+        step_out = [] if step_out is None else step_out
+        flat_out, fmt_box["out"] = _flatten(step_out)
+        new_vars = [] if new_vars is None else new_vars
+        new_vars = [new_vars] if isinstance(new_vars, S.Symbol) \
+            else list(new_vars)
+        if len(new_vars) != len(flat_vars):
+            raise ValueError("The length of loop_vars should be consistent "
+                             "during the loop")
+        return flat_out + new_vars
+
+    cond_fn, cond_caps, cond_cap_syms = _trace_subgraph(build_cond,
+                                                        state_names)
+    func_fn, func_caps, func_cap_syms = _trace_subgraph(build_func,
+                                                        state_names)
+    # merge capture sets (shared value-map feeds both subgraphs)
+    cap_names, cap_syms = list(cond_caps), list(cond_cap_syms)
+    for n, s in zip(func_caps, func_cap_syms):
+        if n not in cap_names:
+            cap_names.append(n)
+            cap_syms.append(s)
+    n_out = _leaf_count(fmt_box["out"])
+    res = S._invoke_sym(
+        "_while_loop", flat_vars + cap_syms,
+        {"_cond_sub": cond_fn, "_func_sub": func_fn,
+         "_n_state": len(flat_vars), "_n_out": n_out,
+         "_max_iter": int(max_iterations),
+         "_state_names": tuple(state_names),
+         "_cap_names": tuple(cap_names)}, name=name)
+    outs = _shape([res[i] for i in range(n_out)], fmt_box["out"])
+    final = [res[n_out + i] for i in range(len(flat_vars))]
+    return outs, (final[0] if single else final)
+
+
+def sym_cond(pred, then_func, else_func, name="cond"):
+    """Symbol cond (parity: symbol/contrib.py:598)."""
+    from ..symbol import symbol as S
+
+    fmt_box = {}
+
+    def build(fn, key):
+        def run():
+            flat, fmt = _flatten(fn())
+            fmt_box[key] = fmt
+            return flat
+
+        return run
+
+    then_fn, then_caps, then_cap_syms = _trace_subgraph(
+        build(then_func, "then"), [])
+    else_fn, else_caps, else_cap_syms = _trace_subgraph(
+        build(else_func, "else"), [])
+    if fmt_box["then"] != fmt_box["else"]:
+        raise ValueError("then_func and else_func must produce outputs of "
+                         "the same structure")
+    n_out = _leaf_count(fmt_box["then"])
+    res = S._invoke_sym(
+        "_cond", [pred] + then_cap_syms + else_cap_syms,
+        {"_then_sub": then_fn, "_else_sub": else_fn,
+         "_then_caps": tuple(then_caps), "_else_caps": tuple(else_caps),
+         "_n_out": n_out}, name=name)
+    if n_out == 1:
+        return _shape([res], fmt_box["then"])
+    return _shape([res[i] for i in range(n_out)], fmt_box["then"])

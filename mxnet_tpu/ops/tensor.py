@@ -8,6 +8,8 @@ epilogues, so there is no hand-written kernel zoo (mshadow_op.h) here.
 """
 from __future__ import annotations
 
+import math
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -73,9 +75,23 @@ _SCALAR_OPS = {
     "_minimum_scalar": lambda x, s: jnp.minimum(x, s),
     "_hypot_scalar": lambda x, s: jnp.hypot(x, jnp.asarray(s, x.dtype)),
 }
+def _op_scalar(x, s, min_int=None):
+    """Scalar operand coercion: keep integer arrays integer when the
+    scalar is integral (reference scalar ops don't promote int -> float).
+    Non-finite scalars stay float; ``min_int`` floors the int coercion
+    (power rejects negative integer exponents on int arrays)."""
+    f = pfloat(s, 0.0)
+    if jnp.issubdtype(x.dtype, jnp.integer) and math.isfinite(f) \
+            and f == int(f) and (min_int is None or f >= min_int):
+        return int(f)
+    return f
+
+
 for _name, _fn in _SCALAR_OPS.items():
     register(_name)(
-        (lambda f: lambda data, scalar=0.0, **kw: f(data, pfloat(scalar, 0.0)))(_fn))
+        (lambda f, lo: lambda data, scalar=0.0, **kw:
+            f(data, _op_scalar(data, scalar, min_int=lo)))(
+                _fn, 0 if _name == "_power_scalar" else None))
 
 _SCALAR_CMP = {
     "_equal_scalar": jnp.equal, "_not_equal_scalar": jnp.not_equal,
@@ -95,7 +111,7 @@ for _name, _fn in _SCALAR_CMP.items():
 
 _UNARY = {
     "abs": jnp.abs, "sign": jnp.sign, "rint": jnp.rint, "ceil": jnp.ceil,
-    "floor": jnp.floor, "trunc": jnp.trunc, "fix": jnp.fix,
+    "floor": jnp.floor, "trunc": jnp.trunc, "fix": jnp.trunc,
     "square": jnp.square, "sqrt": jnp.sqrt,
     "rsqrt": lambda x: lax.rsqrt(x),
     "cbrt": jnp.cbrt, "rcbrt": lambda x: 1.0 / jnp.cbrt(x),

@@ -4,3 +4,11 @@ from .symbol import (Symbol, var, Variable, Group, load, load_json,  # noqa: F40
 from . import register as _register
 
 _register.populate(globals())
+
+
+def __getattr__(name):
+    # lazy alias: mx.sym.contrib -> mx.contrib.symbol (avoids import cycle)
+    if name == "contrib":
+        from ..contrib import symbol as _contrib_sym
+        return _contrib_sym
+    raise AttributeError(name)

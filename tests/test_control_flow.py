@@ -1,0 +1,158 @@
+"""Control-flow op tests (modeled on the reference
+tests/python/unittest/test_contrib_control_flow.py basic cases)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_foreach_simple():
+    step = lambda data, states: (data + states[0], [states[0] * 2])
+    data = nd.array(np.arange(8).reshape(4, 2).astype(np.float32))
+    states = [nd.array(np.ones(2, np.float32))]
+    outs, final = nd.contrib.foreach(step, data, states)
+    expect = data.asnumpy() + np.array([[1], [2], [4], [8]], np.float32)
+    np.testing.assert_allclose(outs.asnumpy(), expect)
+    np.testing.assert_allclose(final[0].asnumpy(), np.full(2, 16.0))
+
+
+def test_foreach_list_data_and_grad():
+    d1 = nd.array(np.random.rand(3, 4).astype(np.float32))
+    d2 = nd.array(np.random.rand(3, 4).astype(np.float32))
+    s0 = nd.array(np.zeros(4, np.float32))
+    d1.attach_grad()
+
+    def step(eles, states):
+        a, b = eles
+        new_s = states[0] + a * b
+        return a + new_s, [new_s]
+
+    with autograd.record():
+        outs, final = nd.contrib.foreach(step, [d1, d2], [s0])
+        loss = outs.sum()
+    loss.backward()
+    # d(loss)/d(d1[i]) = 1 + b[i] * (number of steps >= i)
+    b = d2.asnumpy()
+    coeff = np.array([3, 2, 1], np.float32)[:, None]
+    np.testing.assert_allclose(d1.grad.asnumpy(), 1 + b * coeff, rtol=1e-5)
+
+
+def test_foreach_in_hybrid_block():
+    class Net(mx.gluon.HybridBlock):
+        def hybrid_forward(self, F, x):
+            out, states = F.contrib.foreach(
+                lambda d, s: (d * 2 + s[0], [s[0] + 1]),
+                x, [F.zeros((3,))])
+            return out
+
+    net = Net()
+    x = nd.array(np.arange(12).reshape(4, 3).astype(np.float32))
+    y0 = net(x).asnumpy()
+    net.hybridize()
+    y1 = net(x).asnumpy()
+    expect = x.asnumpy() * 2 + np.arange(4, dtype=np.float32)[:, None]
+    np.testing.assert_allclose(y0, expect)
+    np.testing.assert_allclose(y1, expect)
+
+
+def test_while_loop_simple():
+    cond = lambda i, s: i <= 5
+    func = lambda i, s: ([i + s], [i + 1, s + i])
+    loop_vars = (nd.array([0], dtype="int64"), nd.array([1], dtype="int64"))
+    outputs, states = nd.contrib.while_loop(cond, func, loop_vars,
+                                            max_iterations=10)
+    out = outputs[0].asnumpy()
+    np.testing.assert_array_equal(out[:6, 0], [1, 2, 4, 7, 11, 16])
+    assert out.shape == (10, 1)
+    np.testing.assert_array_equal(states[0].asnumpy(), [6])
+    np.testing.assert_array_equal(states[1].asnumpy(), [16])
+
+
+def test_while_loop_grad():
+    x = nd.array([2.0])
+    x.attach_grad()
+
+    def cond_fn(i, acc):
+        return i < 3
+
+    def func(i, acc):
+        return None, [i + 1, acc * x]
+
+    with autograd.record():
+        _, states = nd.contrib.while_loop(
+            cond_fn, func, [nd.array([0.0]), nd.array([1.0])],
+            max_iterations=5)
+        loss = states[1].sum()
+    loss.backward()
+    # acc = x^3 -> d/dx = 3 x^2 = 12
+    np.testing.assert_allclose(x.grad.asnumpy(), [12.0], rtol=1e-5)
+
+
+def test_cond_eager_and_traced():
+    x = nd.array([1.0, 2.0])
+    y = nd.array([3.0, 4.0])
+    out = nd.contrib.cond(nd.array([1.0]), lambda: x + y, lambda: x - y)
+    np.testing.assert_allclose(out.asnumpy(), [4.0, 6.0])
+    out = nd.contrib.cond(nd.array([0.0]), lambda: x + y, lambda: x - y)
+    np.testing.assert_allclose(out.asnumpy(), [-2.0, -2.0])
+
+    class Net(mx.gluon.HybridBlock):
+        def hybrid_forward(self, F, p, a, b):
+            return F.contrib.cond(p, lambda: a * 2, lambda: b * 3)
+
+    net = Net()
+    net.hybridize()
+    r = net(nd.array([1.0]), x, y)
+    np.testing.assert_allclose(r.asnumpy(), [2.0, 4.0])
+    r = net(nd.array([0.0]), x, y)
+    np.testing.assert_allclose(r.asnumpy(), [9.0, 12.0])
+
+
+def test_sym_foreach_executor():
+    data = mx.sym.var("data")
+    init = mx.sym.var("init")
+    outs, states = mx.sym.contrib.foreach(
+        lambda d, s: (d + s[0], [s[0] + 1]), data, [init])
+    out = outs * 2
+    ex = out.bind(args={"data": nd.array(np.ones((3, 2), np.float32)),
+                        "init": nd.array(np.zeros(2, np.float32))})
+    res = ex.forward()[0].asnumpy()
+    expect = 2 * (np.ones((3, 2)) + np.arange(3)[:, None])
+    np.testing.assert_allclose(res, expect)
+
+
+def test_sym_while_loop_executor():
+    v = mx.sym.var("v")
+    outs, final = mx.sym.contrib.while_loop(
+        cond=lambda i, acc: i < 4,
+        func=lambda i, acc: (None, [i + 1, acc + i]),
+        loop_vars=[v, mx.sym.zeros((1,))],
+        max_iterations=8)
+    ex = final[1].bind(args={"v": nd.array([0.0])})
+    res = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(res, [6.0])  # 0+1+2+3
+
+
+def test_sym_cond_executor():
+    p = mx.sym.var("p")
+    a = mx.sym.var("a")
+    out = mx.sym.contrib.cond(p > 0, lambda: a + 1, lambda: a - 1)
+    ex = out.bind(args={"p": nd.array([2.0]), "a": nd.array([5.0])})
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), [6.0])
+    ex = out.bind(args={"p": nd.array([-2.0]), "a": nd.array([5.0])})
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), [4.0])
+
+
+def test_foreach_capture_grad():
+    """Gradients flow into arrays captured by the body closure (taped path)."""
+    w = nd.array([3.0])
+    w.attach_grad()
+    data = nd.array(np.ones((4, 1), np.float32))
+
+    with autograd.record():
+        outs, _ = nd.contrib.foreach(
+            lambda d, s: (d * w, [s[0]]), data, [nd.zeros((1,))])
+        loss = outs.sum()
+    loss.backward()
+    np.testing.assert_allclose(w.grad.asnumpy(), [4.0])
