@@ -48,6 +48,7 @@ from . import name  # noqa: F401
 from .name import NameManager  # noqa: F401
 from . import rtc  # noqa: F401
 from . import contrib  # noqa: F401
+from . import operator  # noqa: F401
 from . import util  # noqa: F401
 
 __version__ = "2.0.0.tpu1"

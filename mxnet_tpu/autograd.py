@@ -278,15 +278,117 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
                 h._tape_ref = None
 
 
+def _replay_fn(heads, var_refs):
+    """Rebuild the taped computation heads = f(variables) as a pure,
+    jax-traceable function (constants captured from the tape).  The
+    foundation of higher-order grad: jax.vjp of the replay is itself
+    traceable, so the gradient computation can be taped again."""
+    from . import random as _random
+
+    nodes = _topo_nodes([h._tape_ref for h in heads])
+    for node in nodes:
+        if node.info.fn is None:
+            raise MXNetError(
+                "create_graph=True cannot differentiate through a custom "
+                "autograd.Function (op %s)" % node.info.name)
+
+    def f(*var_arrays):
+        env = dict(zip((id(r) for r in var_refs), var_arrays))
+        for node in nodes:
+            ins = [env[id(r)] if r is not None and id(r) in env else cap
+                   for r, cap in zip(node.input_refs, node.input_arrays)]
+            if node.rng_key is not None:
+                _random.push_trace_key(node.rng_key)
+            try:
+                outs = node.info.fn(*ins, **node.attrs)
+            finally:
+                if node.rng_key is not None:
+                    _random.pop_trace_key()
+            outs = outs if isinstance(outs, tuple) else (outs,)
+            for oref, o in zip(node.output_refs, outs):
+                env[id(oref)] = o
+        return tuple(env.get(id(h._tape_ref), h._data) for h in heads)
+
+    return f
+
+
 def grad(heads, variables, head_grads=None, retain_graph=None,
          create_graph=False, train_mode=True):
-    """Parity: autograd.grad (autograd.py:270). First-order only; the
-    TPU-native higher-order path is jax.grad-of-jax.grad on a hybridized
-    block."""
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True: use jax.grad composition via CachedOp")
+    """Parity: autograd.grad (autograd.py:270).
+
+    With ``create_graph=True`` the gradient computation itself is taped:
+    the recorded forward is replayed as one pure jax function, its vjp
+    produces the gradients, and that vjp closure is recorded as a new
+    differentiable tape node — so ``backward()`` through the returned
+    grads yields true higher-order derivatives via jax's vjp-of-vjp.
+    """
     from .ndarray.ndarray import NDArray, zeros
+
+    if create_graph:
+        import jax
+        import jax.numpy as jnp
+
+        from .ops.registry import OpInfo
+
+        if isinstance(heads, NDArray):
+            heads = [heads]
+        if isinstance(variables, NDArray):
+            variables = [variables]
+        if head_grads is None:
+            head_grads = [None] * len(heads)
+        elif isinstance(head_grads, NDArray):
+            head_grads = [head_grads]
+        for v in variables:
+            if v._tape_ref is None or v._tape_ref.variable is None:
+                raise MXNetError(
+                    "variables passed to grad() must have attached grad "
+                    "(attach_grad) and participate in the graph")
+        # dedup requested variables (each unique ref appears once in the
+        # replay; duplicates map onto the same accumulated gradient)
+        uniq_refs, uniq_vars, req_idx = [], [], []
+        pos = {}
+        for v in variables:
+            r = v._tape_ref
+            if id(r) not in pos:
+                pos[id(r)] = len(uniq_refs)
+                uniq_refs.append(r)
+                uniq_vars.append(v)
+            req_idx.append(pos[id(r)])
+        var_refs = uniq_refs
+        # the recorded grad node must also take every OTHER marked
+        # variable on the tape as input, so mixed partials (d2y/dadb)
+        # flow on the second backward pass
+        extra_vars, extra_refs = [], []
+        seen = {id(r) for r in var_refs}
+        for node in _topo_nodes([h._tape_ref for h in heads]):
+            for r in node.input_refs:
+                if r is not None and r.variable is not None \
+                        and id(r) not in seen:
+                    seen.add(id(r))
+                    extra_vars.append(r.variable)
+                    extra_refs.append(r)
+        all_refs = var_refs + extra_refs
+        cots = tuple(hg._data if hg is not None else jnp.ones_like(h._data)
+                     for h, hg in zip(heads, head_grads))
+        f = _replay_fn(heads, all_refs)
+        n_req = len(req_idx)
+
+        def grad_fn(*all_arrays):
+            with _RecordingStateScope(False, train_mode):
+                _, vjp = jax.vjp(f, *all_arrays)
+                res = vjp(cots)
+                res = tuple(res[i] for i in req_idx)
+                # op convention: single output -> bare array, not 1-tuple
+                return res if len(res) > 1 else res[0]
+
+        raw = grad_fn(*[r.variable._data for r in all_refs])
+        raw = raw if isinstance(raw, tuple) else (raw,)
+        outs = [NDArray(g) for g in raw]
+        if is_recording():
+            info = OpInfo("_grad_of_graph", grad_fn,
+                          num_inputs=len(all_refs), num_outputs=n_req)
+            record_op(info, {}, uniq_vars + extra_vars, outs)
+        return outs
 
     if isinstance(variables, NDArray):
         variables = [variables]

@@ -19,6 +19,11 @@ from .sparse import csr_matrix, row_sparse_array  # noqa: F401
 
 _register.populate(globals())
 
+# Custom-op surface: orders kwarg inputs by the prop's declared argument
+# names (replaces the plain generated wrapper)
+from ..operator import make_nd_custom as _make_nd_custom  # noqa: E402
+Custom = _make_nd_custom()
+
 from ..ops.registry import list_ops as _list_ops  # noqa: E402
 
 __all__ = ["NDArray", "array", "zeros", "ones", "empty", "full", "arange",

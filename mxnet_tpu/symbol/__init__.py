@@ -5,6 +5,9 @@ from . import register as _register
 
 _register.populate(globals())
 
+from ..operator import make_sym_custom as _make_sym_custom  # noqa: E402
+Custom = _make_sym_custom()
+
 
 def __getattr__(name):
     # lazy alias: mx.sym.contrib -> mx.contrib.symbol (avoids import cycle)

@@ -182,3 +182,29 @@ def test_setitem_in_record_raises():
         except mx.MXNetError:
             raised = True
     assert raised
+
+
+def test_grad_create_graph_second_order():
+    """Higher-order autograd: d2/dx2 x^3 = 6x."""
+    import numpy as np
+    x = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x * x * x
+        (dydx,) = autograd.grad(y, x, create_graph=True, retain_graph=True)
+        z = dydx.sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 6 * x.asnumpy(), rtol=1e-5)
+
+
+def test_grad_create_graph_mixed_partials():
+    import numpy as np
+    a = nd.array(np.array([2.0], np.float32))
+    b = nd.array(np.array([3.0], np.float32))
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        y = a * a * b          # dy/da = 2ab; d2y/dadb = 2a
+        (dyda,) = autograd.grad(y, a, create_graph=True, retain_graph=True)
+        dyda.backward()
+    np.testing.assert_allclose(b.grad.asnumpy(), [4.0], rtol=1e-5)
