@@ -27,6 +27,7 @@ from . import callback  # noqa: F401
 from . import monitor  # noqa: F401
 from .monitor import Monitor  # noqa: F401
 from . import kvstore  # noqa: F401
+from . import kvstore as kv  # noqa: F401  (reference alias mx.kv)
 from . import gluon  # noqa: F401
 from . import module  # noqa: F401
 from . import module as mod  # noqa: F401

@@ -85,9 +85,27 @@ class KVStore:
         return out
 
     def push(self, key, value, priority=0):
+        from .ndarray.sparse import RowSparseNDArray, add_rsp_rsp
+
         keys, vals = _ctype_key_value(key, value)
         for k, vlist in zip(keys, vals):
             k = str(k)
+            if all(isinstance(v, RowSparseNDArray) for v in vlist):
+                # nnz-bounded componentwise aggregation
+                agg = vlist[0]
+                for v in vlist[1:]:
+                    agg = add_rsp_rsp(agg, v)
+                if self._updater is not None:
+                    # hand the row-sparse aggregate through; sparse-aware
+                    # optimizers (SGD lazy_update) stay nnz-bounded and
+                    # others fall back dense with a RuntimeWarning
+                    self._updater(int(k) if k.isdigit() else k,
+                                  agg, self._store[k])
+                else:
+                    st = self._store[k]
+                    st._rebind(st._data.at[agg.indices._data].add(
+                        agg.data._data.astype(st._data.dtype)))
+                continue
             agg = self._reduce([v.tostype("default")
                                 if v.stype != "default" else v for v in vlist])
             if self._updater is not None:
@@ -111,7 +129,11 @@ class KVStore:
             self.pull(key, out=out, priority=priority)
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        """Pull only the rows in row_ids (reference: kvstore.py:314)."""
+        """Pull only the rows in row_ids (reference: kvstore.py:314).
+        A RowSparseNDArray `out` receives components (gather, memory ∝
+        requested rows); a dense `out` gets the row-masked dense view."""
+        from .ndarray.sparse import RowSparseNDArray
+
         keys, outs = _ctype_key_value(key, out)
         if isinstance(row_ids, NDArray):
             row_ids = [row_ids] * len(outs[0])
@@ -119,7 +141,11 @@ class KVStore:
             k = str(k)
             src = self._store[k]
             for o, rid in zip(olist, row_ids):
-                rows = rid.asnumpy().astype(np.int64)
+                rows = np.unique(rid.asnumpy().astype(np.int64))
+                if isinstance(o, RowSparseNDArray):
+                    vals = src._data[array(rows)._data]
+                    o._assign_rows(NDArray(vals), array(rows), src.shape)
+                    continue
                 dense = src.asnumpy()
                 mask = np.zeros(dense.shape[0], bool)
                 mask[rows] = True
@@ -197,13 +223,18 @@ class KVStoreDist(KVStore):
                 o._rebind(nd._data.astype(o._data.dtype))
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
+        from .ndarray.sparse import RowSparseNDArray
+
         keys, outs = _ctype_key_value(key, out)
         if isinstance(row_ids, NDArray):
             row_ids = [row_ids] * len(outs[0])
         for k, olist in zip(keys, outs):
             val = self._client.pull(str(k))
             for o, rid in zip(olist, row_ids):
-                rows = rid.asnumpy().astype(np.int64)
+                rows = np.unique(rid.asnumpy().astype(np.int64))
+                if isinstance(o, RowSparseNDArray):
+                    o._assign_rows(array(val[rows]), array(rows), val.shape)
+                    continue
                 mask = np.zeros(val.shape[0], bool)
                 mask[rows] = True
                 o._rebind(array(val * mask.reshape(

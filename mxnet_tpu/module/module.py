@@ -115,8 +115,13 @@ class Module(BaseModule):
 
     @property
     def output_shapes(self):
-        outs = self._bound("_exec_group").get_outputs()
-        return list(zip(self._output_names, [o.shape for o in outs]))
+        self._bound("_exec_group")
+        # shape inference, not execution — valid right after bind()
+        feed = {d.name: d.shape for d in self._data_shapes}
+        if self._label_shapes:
+            feed.update({l.name: l.shape for l in self._label_shapes})
+        _, out_shapes, _ = self._symbol.infer_shape(**feed)
+        return list(zip(self._output_names, out_shapes))
 
     # ------------------------------------------------------------------
     # Parameters

@@ -1,24 +1,29 @@
-"""Sparse NDArray subset: row_sparse + csr.
+"""Sparse NDArray subset: row_sparse + csr, component-first.
 
 Reference parity: src/ndarray (kRowSparseStorage/kCSRStorage,
 include/mxnet/ndarray.h:61-65) and python/mxnet/ndarray/sparse.py.
 
 TPU-native scope (per SURVEY §7 hard-part 7): TPUs have no native sparse
-compute; we keep faithful *storage* semantics (indices/indptr/data
-components, tostype round-trips, row_sparse_pull-able) and lower compute
-to dense XLA ops (gather/scatter for embedding-style access).  CSR matmul
-uses a gather-based segment-sum, adequate for the kvstore/embedding test
-surface; everything else densifies with a warning-free fallback.
+compute, but *storage* is honest — a sparse array holds only its
+components (memory ∝ nnz; nothing dense is materialized at
+construction).  Sparse-aware kernels (retain, csr·dense dot, row-sparse
+aggregation, row_sparse_pull) compute directly on the components with
+nnz-bounded gather/scatter.  Any other operator falls back to a dense
+view, materialized lazily on first access and flagged with a
+RuntimeWarning so silent densification is visible.
 """
 from __future__ import annotations
+
+import warnings
 
 import numpy as np
 
 from ..base import MXNetError
 from .ndarray import NDArray, array, _as_nd, zeros
 
-__all__ = ["RowSparseNDArray", "CSRNDArray", "row_sparse_array", "csr_matrix",
-           "cast_storage", "zeros_sparse"]
+__all__ = ["BaseSparseNDArray", "RowSparseNDArray", "CSRNDArray",
+           "row_sparse_array", "csr_matrix", "cast_storage", "zeros_sparse",
+           "retain", "dot"]
 
 
 def _jnp():
@@ -28,10 +33,61 @@ def _jnp():
 
 
 class BaseSparseNDArray(NDArray):
-    __slots__ = ("_aux",)
+    """Sparse base: dense view is lazy; subclasses store components in
+    ``_aux`` and implement ``_densify()``."""
+
+    __slots__ = ("_aux", "_dense_cache", "_sshape", "_sdtype")
+
+    # `_data` shadows the NDArray slot: the dense array exists only after
+    # something actually asks for it.
+    @property
+    def _data(self):
+        if self._dense_cache is None:
+            warnings.warn(
+                "%s densified for an operator without a sparse kernel "
+                "(dense fallback)" % type(self).__name__, RuntimeWarning,
+                stacklevel=3)
+            self._dense_cache = self._densify()
+        return self._dense_cache
+
+    @_data.setter
+    def _data(self, value):
+        # rebinds (in-place ops) overwrite the dense view; components are
+        # re-derived lazily from it
+        self._dense_cache = value
+        if value is not None:
+            self._aux = None
+
+    def _components(self):
+        if self._aux is None:
+            self._aux = self._extract(self._dense_cache)
+        return self._aux
+
+    @property
+    def shape(self):
+        return self._sshape
+
+    @property
+    def ndim(self):
+        return len(self._sshape)
+
+    @property
+    def dtype(self):
+        return self._sdtype.type
 
     def asnumpy(self):
-        return self.tostype("default").asnumpy()
+        return np.asarray(self.tostype("default").asnumpy())
+
+    def tostype(self, stype):
+        if stype == self._stype:
+            return self
+        if stype == "default":
+            dense = self._dense_cache if self._dense_cache is not None \
+                else self._densify()
+            self._dense_cache = dense
+            return NDArray(dense, self._ctx)
+        raise MXNetError("cast_storage %s -> %s unsupported"
+                         % (self._stype, stype))
 
     def __repr__(self):
         return "<%s %s @%s>" % (type(self).__name__,
@@ -39,93 +95,124 @@ class BaseSparseNDArray(NDArray):
 
 
 class RowSparseNDArray(BaseSparseNDArray):
-    """values (nnz_rows, *row_shape) + indices (nnz_rows,)."""
+    """values (nnz_rows, *row_shape) + indices (nnz_rows,).  Memory is
+    proportional to the number of non-zero rows."""
 
     def __init__(self, data, indices, shape, ctx=None):
+        self._sshape = tuple(int(s) for s in shape)
+        self._sdtype = np.dtype(data._data.dtype)
+        super().__init__(None, ctx, stype="row_sparse")
+        self._aux = {"data": data,
+                     "indices": NDArray(indices._data.astype("int64"),
+                                        indices._ctx)}
+
+    def _densify(self):
         jnp = _jnp()
-        dense = jnp.zeros(shape, dtype=data._data.dtype)
-        dense = dense.at[indices._data.astype("int32")].set(data._data)
-        super().__init__(dense, ctx, stype="row_sparse")
-        self._aux = {"data": data, "indices": indices}
+        aux = self._aux
+        dense = jnp.zeros(self._sshape, dtype=self._sdtype)
+        return dense.at[aux["indices"]._data.astype("int32")].set(
+            aux["data"]._data)
+
+    @staticmethod
+    def _extract(dense):
+        d = np.asarray(dense)
+        nz = np.where(np.any(d.reshape(d.shape[0], -1) != 0, axis=1))[0]
+        return {"data": array(d[nz]),
+                "indices": array(nz.astype(np.int64))}
 
     @property
     def indices(self):
-        return self._aux["indices"]
+        return self._components()["indices"]
 
     @property
-    def data(self):  # note: shadows NDArray.data (jax array) intentionally
-        return self._aux["data"]
-
-    @property
-    def _dense(self):
-        return self._data
-
-    def tostype(self, stype):
-        if stype == "row_sparse":
-            return self
-        if stype == "default":
-            return NDArray(self._data, self._ctx)
-        raise MXNetError("cast_storage row_sparse -> %s unsupported" % stype)
+    def data(self):  # shadows NDArray.data (the jax array) intentionally
+        return self._components()["data"]
 
     def copyto(self, other):
-        if isinstance(other, NDArray) and not isinstance(other, BaseSparseNDArray):
-            other._rebind(self._data)
+        if isinstance(other, NDArray) and \
+                not isinstance(other, BaseSparseNDArray):
+            other._rebind(self.tostype("default")._data)
             return other
         return super().copyto(other)
+
+    def _assign_rows(self, vals, rows, shape):
+        """Replace this array's contents with (vals, rows) components —
+        the kvstore row_sparse_pull write-back path."""
+        self._sshape = tuple(int(s) for s in shape)
+        self._sdtype = np.dtype(vals._data.dtype)
+        self._dense_cache = None
+        self._aux = {"data": vals,
+                     "indices": NDArray(rows._data.astype("int64"),
+                                        rows._ctx)}
 
 
 class CSRNDArray(BaseSparseNDArray):
     """CSR: data (nnz,), indices (nnz,), indptr (rows+1,)."""
 
     def __init__(self, data, indices, indptr, shape, ctx=None):
+        self._sshape = tuple(int(s) for s in shape)
+        self._sdtype = np.dtype(data._data.dtype)
+        super().__init__(None, ctx, stype="csr")
+        self._aux = {"data": data,
+                     "indices": NDArray(indices._data.astype("int64"),
+                                        indices._ctx),
+                     "indptr": NDArray(indptr._data.astype("int64"),
+                                       indptr._ctx)}
+
+    def _row_ids(self):
+        """Per-nnz row index (host-side from indptr)."""
+        indptr = np.asarray(self._aux["indptr"]._data)
+        return np.repeat(np.arange(len(indptr) - 1), np.diff(indptr))
+
+    def _densify(self):
         jnp = _jnp()
-        np_data = np.asarray(data._data)
-        np_indices = np.asarray(indices._data).astype(np.int64)
-        np_indptr = np.asarray(indptr._data).astype(np.int64)
-        dense = np.zeros(shape, dtype=np_data.dtype)
-        for r in range(shape[0]):
-            lo, hi = np_indptr[r], np_indptr[r + 1]
-            dense[r, np_indices[lo:hi]] = np_data[lo:hi]
-        super().__init__(jnp.asarray(dense), ctx, stype="csr")
-        self._aux = {"data": data, "indices": indices, "indptr": indptr}
+        aux = self._aux
+        rows = jnp.asarray(self._row_ids())
+        dense = jnp.zeros(self._sshape, dtype=self._sdtype)
+        return dense.at[rows, aux["indices"]._data].set(aux["data"]._data)
+
+    @staticmethod
+    def _extract(dense):
+        d = np.asarray(dense)
+        rows, cols = np.nonzero(d)
+        counts = np.bincount(rows, minlength=d.shape[0])
+        indptr = np.concatenate([[0], np.cumsum(counts)])
+        return {"data": array(d[rows, cols]),
+                "indices": array(cols.astype(np.int64)),
+                "indptr": array(indptr.astype(np.int64))}
 
     @property
     def indices(self):
-        return self._aux["indices"]
+        return self._components()["indices"]
 
     @property
     def indptr(self):
-        return self._aux["indptr"]
+        return self._components()["indptr"]
 
     @property
     def data(self):
-        return self._aux["data"]
-
-    def tostype(self, stype):
-        if stype == "csr":
-            return self
-        if stype == "default":
-            return NDArray(self._data, self._ctx)
-        raise MXNetError("cast_storage csr -> %s unsupported" % stype)
+        return self._components()["data"]
 
 
 def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
     if isinstance(arg1, (list, tuple)) and len(arg1) == 2:
         data, indices = arg1
-        return RowSparseNDArray(_as_nd(np.asarray(data, dtype=dtype or np.float32)),
-                                _as_nd(np.asarray(indices)), shape, ctx)
-    dense = _as_nd(np.asarray(arg1, dtype=dtype or np.float32) if not isinstance(arg1, NDArray) else arg1)
+        return RowSparseNDArray(
+            _as_nd(np.asarray(data, dtype=dtype or np.float32)),
+            _as_nd(np.asarray(indices)), shape, ctx)
+    dense = _as_nd(np.asarray(arg1, dtype=dtype or np.float32)
+                   if not isinstance(arg1, NDArray) else arg1)
     return cast_storage(dense, "row_sparse")
 
 
 def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
     if isinstance(arg1, (list, tuple)) and len(arg1) == 3:
         data, indices, indptr = arg1
-        return CSRNDArray(_as_nd(np.asarray(data, dtype=dtype or np.float32)),
-                          _as_nd(np.asarray(indices)), _as_nd(np.asarray(indptr)),
-                          shape, ctx)
-    dense = _as_nd(arg1)
-    return cast_storage(dense, "csr")
+        return CSRNDArray(
+            _as_nd(np.asarray(data, dtype=dtype or np.float32)),
+            _as_nd(np.asarray(indices)), _as_nd(np.asarray(indptr)),
+            shape, ctx)
+    return cast_storage(_as_nd(arg1), "csr")
 
 
 def cast_storage(arr, stype):
@@ -134,49 +221,92 @@ def cast_storage(arr, stype):
         if isinstance(arr, BaseSparseNDArray):
             return arr.tostype("default")
         return arr
+    if isinstance(arr, BaseSparseNDArray):
+        arr = arr.tostype("default")
     dense = np.asarray(arr.asnumpy())
+    ctx = arr.context
     if stype == "row_sparse":
-        nz_rows = np.where(np.any(dense.reshape(dense.shape[0], -1) != 0, axis=1))[0]
-        vals = dense[nz_rows]
-        return RowSparseNDArray(array(vals), array(nz_rows.astype(np.int64)),
-                                dense.shape, arr.context)
+        aux = RowSparseNDArray._extract(dense)
+        return RowSparseNDArray(aux["data"], aux["indices"], dense.shape,
+                                ctx)
     if stype == "csr":
         if dense.ndim != 2:
             raise MXNetError("csr requires 2-D")
-        indptr = [0]
-        indices = []
-        data = []
-        for r in range(dense.shape[0]):
-            cols = np.where(dense[r] != 0)[0]
-            indices.extend(cols.tolist())
-            data.extend(dense[r, cols].tolist())
-            indptr.append(len(indices))
-        return CSRNDArray(array(np.asarray(data, dtype=dense.dtype)),
-                          array(np.asarray(indices, dtype=np.int64)),
-                          array(np.asarray(indptr, dtype=np.int64)),
-                          dense.shape, arr.context)
+        aux = CSRNDArray._extract(dense)
+        return CSRNDArray(aux["data"], aux["indices"], aux["indptr"],
+                          dense.shape, ctx)
     raise MXNetError("unknown stype %r" % stype)
 
 
 def zeros_sparse(stype, shape, ctx=None, dtype=None):
-    d = zeros(shape, ctx=ctx, dtype=dtype)
-    return cast_storage(d, stype) if stype != "default" else d
+    if stype == "default":
+        return zeros(shape, ctx=ctx, dtype=dtype)
+    dtype = np.dtype(dtype or np.float32)
+    if stype == "row_sparse":
+        empty_vals = array(np.zeros((0,) + tuple(shape[1:]), dtype))
+        return RowSparseNDArray(empty_vals, array(np.zeros(0, np.int64)),
+                                shape, ctx)
+    if stype == "csr":
+        return CSRNDArray(array(np.zeros(0, dtype)),
+                          array(np.zeros(0, np.int64)),
+                          array(np.zeros(int(shape[0]) + 1, np.int64)),
+                          shape, ctx)
+    raise MXNetError("unknown stype %r" % stype)
 
 
 def retain(data, indices):
-    """Parity: mx.nd.sparse.retain."""
+    """Keep only the listed rows (parity: mx.nd.sparse.retain).
+    Component-level: no densification."""
+    if not isinstance(data, RowSparseNDArray):
+        raise MXNetError("retain expects a RowSparseNDArray")
     keep = np.asarray(indices.asnumpy()).astype(np.int64)
-    dense = np.asarray(data.asnumpy())
-    mask = np.zeros(dense.shape[0], bool)
-    mask[keep] = True
-    dense = dense * mask.reshape((-1,) + (1,) * (dense.ndim - 1))
-    return cast_storage(array(dense), "row_sparse")
+    idx = np.asarray(data.indices._data)
+    mask = np.isin(idx, keep)
+    vals = data.data._data[np.where(mask)[0]]
+    return RowSparseNDArray(NDArray(vals), array(idx[mask]), data.shape,
+                            data.context)
+
+
+def add_rsp_rsp(a, b):
+    """Row-sparse + row-sparse with nnz-bounded merge (device-side
+    position mapping via searchsorted, no per-element Python)."""
+    jnp = _jnp()
+    ia = np.asarray(a.indices._data)
+    ib = np.asarray(b.indices._data)
+    union = np.union1d(ia, ib)
+    uj = jnp.asarray(union)
+    out = jnp.zeros((len(union),) + tuple(a.shape[1:]), dtype=a.dtype)
+    out = out.at[jnp.searchsorted(uj, jnp.asarray(ia))].add(a.data._data)
+    out = out.at[jnp.searchsorted(uj, jnp.asarray(ib))].add(b.data._data)
+    return RowSparseNDArray(NDArray(out), array(union.astype(np.int64)),
+                            a.shape, a.context)
 
 
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):
-    """csr dot dense (and csr.T dot dense) via dense fallback."""
+    """dot with sparse-aware kernels: csr·dense and csrᵀ·dense run as
+    nnz-bounded gather + scatter-add (no densification)."""
     from . import ndarray as _nd
 
-    return _nd._invoke_nd("dot", [lhs.tostype("default") if isinstance(lhs, BaseSparseNDArray) else lhs,
-                                  rhs.tostype("default") if isinstance(rhs, BaseSparseNDArray) else rhs],
-                          {"transpose_a": transpose_a, "transpose_b": transpose_b})
+    if isinstance(lhs, CSRNDArray) and \
+            not isinstance(rhs, BaseSparseNDArray) and not transpose_b:
+        jnp = _jnp()
+        vals = lhs.data._data
+        cols = lhs.indices._data
+        rows = jnp.asarray(lhs._row_ids())
+        r = rhs._data
+        # per-nnz contribution: scalar for a 1-D rhs, row for 2-D+
+        expand = (lambda v: v) if r.ndim == 1 else \
+            (lambda v: v.reshape((-1,) + (1,) * (r.ndim - 1)))
+        if transpose_a:
+            out = jnp.zeros((lhs.shape[1],) + tuple(r.shape[1:]),
+                            dtype=vals.dtype)
+            out = out.at[cols].add(expand(vals) * r[rows])
+        else:
+            out = jnp.zeros((lhs.shape[0],) + tuple(r.shape[1:]),
+                            dtype=vals.dtype)
+            out = out.at[rows].add(expand(vals) * r[cols])
+        return NDArray(out, lhs.context)
+    dl = lhs.tostype("default") if isinstance(lhs, BaseSparseNDArray) else lhs
+    dr = rhs.tostype("default") if isinstance(rhs, BaseSparseNDArray) else rhs
+    return _nd._invoke_nd("dot", [dl, dr], {"transpose_a": transpose_a,
+                                            "transpose_b": transpose_b})

@@ -171,6 +171,14 @@ def _custom_fn(*arrays, op_type=None, **ctor_kwargs):
             "got %d inputs" % (op_type, n_args, len(aux_names),
                                len(arrays)))
     args, auxs = arrays[:n_args], arrays[n_args:]
+    if auxs:
+        import warnings
+
+        warnings.warn(
+            "Custom op %r: auxiliary-state mutations inside a traced "
+            "(hybridized/jitted) region are not propagated back to the "
+            "aux NDArrays; run the op eagerly if forward must update aux "
+            "state" % op_type, RuntimeWarning, stacklevel=3)
 
     in_shapes = [tuple(a.shape) for a in args]
     _, out_shapes, _ = _shapes3(prop.infer_shape([list(s) for s in
@@ -253,11 +261,17 @@ def _order_inputs(prop, pos_args, array_kwargs):
     names = prop.list_arguments() + prop.list_auxiliary_states()
     inputs = []
     pos = list(pos_args)
+    missing = []
     for n in names:
         if n in array_kwargs:
             inputs.append(array_kwargs.pop(n))
         elif pos:
             inputs.append(pos.pop(0))
+        else:
+            missing.append(n)
+    if missing:
+        raise MXNetError("Custom op %s: missing inputs %s"
+                         % (type(prop).__name__, missing))
     if pos or array_kwargs:
         raise MXNetError(
             "Custom op %s: unmatched inputs (extra positional: %d, "

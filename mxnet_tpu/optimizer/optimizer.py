@@ -168,9 +168,13 @@ class SGD(Optimizer):
         return zeros(weight.shape, dtype=weight.dtype)
 
     def update(self, index, weight, grad, state):
+        from ..ndarray.sparse import RowSparseNDArray
+
         self._update_count(index)
         lr = self._get_lr(index)
         wd = self._get_wd(index)
+        if isinstance(grad, RowSparseNDArray) and self.lazy_update:
+            return self._lazy_sparse_update(weight, grad, state, lr, wd)
         kw = dict(lr=lr, wd=wd, rescale_grad=self.rescale_grad,
                   clip_gradient=self.clip_gradient or -1.0)
         if state is not None:
@@ -178,6 +182,26 @@ class SGD(Optimizer):
                        dict(kw, momentum=self.momentum))
         else:
             _invoke_nd("sgd_update", [weight, grad], kw)
+
+    def _lazy_sparse_update(self, weight, grad, state, lr, wd):
+        """Row-sparse lazy update (reference sgd[_mom]_update lazy path):
+        only touched rows are read or written — nnz-bounded compute and
+        no dense gradient materialization."""
+        import jax.numpy as jnp
+
+        rows = grad.indices._data
+        w = weight._data
+        g = grad.data._data.astype(w.dtype) * self.rescale_grad
+        if self.clip_gradient:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        g = g + wd * w[rows]
+        if state is not None:
+            m = state._data
+            new_m = self.momentum * m[rows] - lr * g
+            state._rebind(m.at[rows].set(new_m))
+            weight._rebind(w.at[rows].add(new_m))
+        else:
+            weight._rebind(w.at[rows].add(-lr * g))
 
     def update_multi_precision(self, index, weight, grad, state):
         if self.multi_precision and weight.dtype == np.float16:

@@ -123,3 +123,61 @@ def test_module_reshape():
     batch = mx.io.DataBatch([mx.nd.ones((5, 32))], [mx.nd.zeros((5,))])
     mod.forward(batch, is_train=True)
     assert mod.get_outputs()[0].shape == (5, 5)
+
+
+def test_sequential_module():
+    """Chain two symbol Modules; train end to end."""
+    from mxnet_tpu.module import SequentialModule, Module
+
+    net1 = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=8,
+                                 name="fc1")
+    net1 = mx.sym.Activation(net1, act_type="relu", name="relu1")
+    net2 = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=4,
+                                 name="fc2")
+    net2 = mx.sym.SoftmaxOutput(net2, name="softmax")
+
+    seq = SequentialModule()
+    seq.add(Module(net1, label_names=None)) \
+       .add(Module(net2), take_labels=True, auto_wiring=True)
+
+    assert seq.data_names == ["data"]
+    assert seq.output_names[-1].startswith("softmax")
+
+    x = np.random.rand(10, 6).astype(np.float32)
+    y = np.random.randint(0, 4, 10).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=5, label_name="softmax_label")
+    seq.fit(it, num_epoch=2, optimizer_params=(("learning_rate", 0.1),))
+    out = seq.predict(it)
+    assert out.shape == (10, 4)
+    score = seq.score(it, "acc")
+    assert 0.0 <= score[0][1] <= 1.0
+
+
+def test_python_loss_module():
+    from mxnet_tpu.module import SequentialModule, Module, PythonLossModule
+
+    net = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=3,
+                                name="fc_pl")
+
+    def l2_grad(scores, labels):
+        lab = mx.nd.one_hot(labels, 3) if labels.ndim == 1 else labels
+        return 2 * (scores - lab)
+
+    seq = SequentialModule()
+    seq.add(Module(net, label_names=None)) \
+       .add(PythonLossModule(grad_func=l2_grad), take_labels=True)
+    x = np.random.rand(8, 5).astype(np.float32)
+    y = np.random.randint(0, 3, 8).astype(np.float32)
+    it = mx.io.NDArrayIter(x, y, batch_size=4, label_name="softmax_label")
+    seq.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    seq.init_params()
+    seq.init_optimizer(optimizer_params=(("learning_rate", 0.05),))
+    batch = next(iter(it))
+    seq.forward(batch, is_train=True)
+    before = seq.get_outputs()[0].asnumpy().copy()
+    seq.backward()
+    seq.update()
+    it.reset()
+    seq.forward(next(iter(it)), is_train=False)
+    after = seq.get_outputs()[0].asnumpy()
+    assert not np.allclose(before, after)  # the fc actually updated

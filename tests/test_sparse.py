@@ -77,3 +77,126 @@ def test_rand_ndarray_sparse():
     assert arr.stype == "csr"
     nnz_frac = (arr.asnumpy() != 0).mean()
     assert nnz_frac < 0.8
+
+
+def test_rsp_no_densify_on_construction():
+    """Memory ∝ nnz: a huge-shape rsp stores only components."""
+    import warnings as _w
+    shape = (10_000_000, 128)     # dense would be ~5 GB fp32
+    data = np.random.rand(3, 128).astype(np.float32)
+    with _w.catch_warnings():
+        _w.simplefilter("error", RuntimeWarning)  # any densify -> fail
+        rsp = mx.nd.sparse.row_sparse_array(
+            (data, np.array([7, 42, 9_999_999])), shape=shape)
+        assert rsp._dense_cache is None
+        assert rsp.shape == shape
+        assert rsp.data.shape == (3, 128)
+        np.testing.assert_array_equal(rsp.indices.asnumpy(),
+                                      [7, 42, 9_999_999])
+
+
+def test_rsp_retain_component_level():
+    import warnings as _w
+    shape = (1_000_000, 4)
+    rsp = mx.nd.sparse.row_sparse_array(
+        (np.ones((3, 4), np.float32), np.array([1, 5, 10])), shape=shape)
+    with _w.catch_warnings():
+        _w.simplefilter("error", RuntimeWarning)
+        kept = mx.nd.sparse.retain(rsp, mx.nd.array(np.array([5, 10])))
+        np.testing.assert_array_equal(kept.indices.asnumpy(), [5, 10])
+        assert kept.data.shape == (2, 4)
+        assert kept._dense_cache is None
+
+
+def test_csr_dot_no_densify():
+    import warnings as _w
+    from mxnet_tpu.ndarray import sparse as sp
+    shape = (500_000, 6)
+    csr = sp.CSRNDArray(
+        mx.nd.array(np.array([1.0, 2.0, 3.0], np.float32)),
+        mx.nd.array(np.array([0, 3, 5])),
+        mx.nd.array(np.concatenate([[0, 1, 3],
+                                    np.full(shape[0] - 1, 3)])),
+        shape)
+    rhs = mx.nd.array(np.random.rand(6, 2).astype(np.float32))
+    with _w.catch_warnings():
+        _w.simplefilter("error", RuntimeWarning)
+        out = sp.dot(csr, rhs)
+    expect = np.zeros((shape[0], 2), np.float32)
+    expect[0] = 1.0 * rhs.asnumpy()[0]
+    expect[1] = 2.0 * rhs.asnumpy()[3] + 3.0 * rhs.asnumpy()[5]
+    np.testing.assert_allclose(out.asnumpy()[:2], expect[:2], rtol=1e-6)
+    assert float(np.abs(out.asnumpy()[2:].sum())) == 0.0
+
+
+def test_csr_dot_transpose():
+    from mxnet_tpu.ndarray import sparse as sp
+    dense = np.random.rand(5, 4).astype(np.float32)
+    dense[dense < 0.5] = 0
+    csr = sp.cast_storage(mx.nd.array(dense), "csr")
+    rhs = mx.nd.array(np.random.rand(5, 3).astype(np.float32))
+    out = sp.dot(csr, rhs, transpose_a=True)
+    np.testing.assert_allclose(out.asnumpy(), dense.T @ rhs.asnumpy(),
+                               rtol=1e-5)
+
+
+def test_kvstore_rsp_push_pull_mesh():
+    """Row-sparse push from per-device grads + component pull."""
+    import jax
+    kv = mx.kv.create("local")
+    shape = (100_000, 8)
+    kv.init("emb", mx.nd.zeros(shape))
+    devs = jax.local_devices()
+    grads = []
+    for i in range(min(8, len(devs))):
+        data = np.full((2, 8), float(i + 1), np.float32)
+        g = mx.nd.sparse.row_sparse_array(
+            (data, np.array([i, 50_000 + i])), shape=shape)
+        grads.append(g)
+    kv.push("emb", grads)
+    out = mx.nd.sparse.zeros_sparse("row_sparse", shape)
+    kv.row_sparse_pull("emb", out=out,
+                       row_ids=mx.nd.array(np.array([0, 1, 50_000])))
+    got = dict(zip(out.indices.asnumpy().tolist(),
+                   out.data.asnumpy()[:, 0].tolist()))
+    assert got[0] == 1.0 and got[1] == 2.0 and got[50_000] == 1.0
+    assert out.data.shape[0] == 3
+
+
+def test_rsp_rebind_rederives_components():
+    rsp = mx.nd.sparse.row_sparse_array(
+        (np.ones((1, 2), np.float32), np.array([1])), shape=(4, 2))
+    rsp._rebind(mx.nd.array(np.array([[0, 0], [0, 0], [3, 3], [0, 0]],
+                                     np.float32))._data)
+    np.testing.assert_array_equal(rsp.indices.asnumpy(), [2])
+    np.testing.assert_allclose(rsp.data.asnumpy(), [[3.0, 3.0]])
+
+
+def test_kvstore_rsp_push_lazy_optimizer():
+    """Row-sparse push through a kvstore optimizer stays nnz-bounded."""
+    import warnings as _w
+    kv = mx.kv.create("local")
+    shape = (2_000_000, 4)
+    kv.init("w", mx.nd.zeros(shape))
+    opt = mx.optimizer.SGD(learning_rate=1.0, rescale_grad=1.0)
+    kv.set_optimizer(opt)
+    g = mx.nd.sparse.row_sparse_array(
+        (np.ones((2, 4), np.float32), np.array([3, 1_000_000])),
+        shape=shape)
+    with _w.catch_warnings():
+        _w.simplefilter("error", RuntimeWarning)  # densify would raise
+        kv.push("w", [g])
+    out = mx.nd.sparse.zeros_sparse("row_sparse", shape)
+    kv.row_sparse_pull("w", out=out,
+                       row_ids=mx.nd.array(np.array([3, 1_000_000])))
+    np.testing.assert_allclose(out.data.asnumpy(),
+                               -np.ones((2, 4), np.float32))
+
+
+def test_csr_dot_vector_rhs():
+    from mxnet_tpu.ndarray import sparse as sp
+    dense = np.array([[1.0, 0.0, 2.0], [0.0, 3.0, 0.0]], np.float32)
+    csr = sp.cast_storage(mx.nd.array(dense), "csr")
+    v = mx.nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    out = sp.dot(csr, v)
+    np.testing.assert_allclose(out.asnumpy(), dense @ v.asnumpy())
