@@ -426,44 +426,9 @@ class ImageIter:
         return self
 
 
-class ImageRecordIterPy(ImageIter):
-    """`mx.io.ImageRecordIter` signature compatibility: thread-pool decode
-    + double-buffered prefetch (the iter_image_recordio_2.cc pipeline)."""
+def ImageRecordIterPy(**kwargs):
+    """Back-compat alias: the threaded RecordIO pipeline now lives in
+    mxnet_tpu.io.image_record.ImageRecordIter (single implementation)."""
+    from ..io.image_record import ImageRecordIter
 
-    def __init__(self, path_imgrec=None, data_shape=None, batch_size=1,
-                 label_width=1, shuffle=False, mean_r=0, mean_g=0, mean_b=0,
-                 std_r=1, std_g=1, std_b=1, rand_crop=False,
-                 rand_mirror=False, resize=0, num_parts=1, part_index=0,
-                 preprocess_threads=4, data_name="data",
-                 label_name="softmax_label", **kwargs):
-        mean = None
-        if mean_r or mean_g or mean_b:
-            mean = np.array([mean_r, mean_g, mean_b])
-        std = None
-        if (std_r, std_g, std_b) != (1, 1, 1):
-            std = np.array([std_r, std_g, std_b])
-        aug_kwargs = dict(rand_crop=rand_crop, rand_mirror=rand_mirror,
-                          resize=resize, mean=mean, std=std)
-        self._pool = ThreadPoolExecutor(max_workers=max(1,
-                                                        preprocess_threads))
-        self._pending = None
-        super().__init__(batch_size, data_shape, label_width,
-                         path_imgrec=path_imgrec, shuffle=shuffle,
-                         num_parts=num_parts, part_index=part_index,
-                         data_name=data_name, label_name=label_name,
-                         **aug_kwargs)
-
-    def next(self):
-        if self._pending is None:
-            self._pending = self._pool.submit(super().next)
-        try:
-            batch = self._pending.result()
-        except StopIteration:
-            self._pending = None
-            raise
-        self._pending = self._pool.submit(super().next)
-        return batch
-
-    def reset(self):
-        self._pending = None
-        super().reset()
+    return ImageRecordIter(**kwargs)

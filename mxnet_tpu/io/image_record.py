@@ -1,0 +1,280 @@
+"""ImageRecordIter: the high-throughput RecordIO image pipeline.
+
+Reference parity: ``src/io/iter_image_recordio_2.cc:50-817``
+(ImageRecordIOParser2) — sharded .rec reading (``part_index`` /
+``num_parts``), threaded JPEG decode + augmentation
+(``preprocess_threads``), double-buffered batch prefetch
+(``prefetch_buffer``), ``round_batch`` wrap-around padding, and the
+standard augmenter knobs (resize / rand_crop / rand_mirror / mean / std
+/ scale).
+
+TPU-native design: the decode+augment work happens in a thread pool —
+PIL's JPEG codec and numpy release the GIL, so ``preprocess_threads``
+batches are decoded concurrently while the chip trains on the previous
+batch.  Each worker owns its own file handle (RecordIO seeks are
+per-thread), a whole batch is assembled into one preallocated numpy
+buffer, and the single host->device transfer per batch rides the async
+dispatch queue.  This replaces the reference's OMP parser threads +
+threaded-iter pipeline with the same architecture in Python threads.
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ndarray.ndarray import array
+from ..recordio import MXIndexedRecordIO, MXRecordIO, unpack
+from .io import DataBatch, DataDesc, DataIter
+
+__all__ = ["ImageRecordIter"]
+
+
+def _parse_shape(v):
+    if isinstance(v, str):
+        v = v.strip("()[] ").split(",")
+    return tuple(int(x) for x in v)
+
+
+class ImageRecordIter(DataIter):
+    """Threaded RecordIO -> JPEG decode -> augment -> device batches."""
+
+    def __init__(self, path_imgrec, data_shape, batch_size,
+                 path_imgidx=None, label_width=1, shuffle=False,
+                 part_index=0, num_parts=1, preprocess_threads=4,
+                 prefetch_buffer=4, resize=-1, rand_crop=False,
+                 rand_mirror=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
+                 std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0, seed=0,
+                 round_batch=True, data_name="data",
+                 label_name="softmax_label", dtype="float32", **kwargs):
+        super().__init__(batch_size)
+        self._path_rec = path_imgrec
+        self._path_idx = path_imgidx
+        self._data_shape = _parse_shape(data_shape)
+        if len(self._data_shape) != 3:
+            raise MXNetError("data_shape must be (channels, height, width)")
+        self._label_width = int(label_width)
+        self._shuffle = bool(shuffle)
+        self._resize = int(resize)
+        self._rand_crop = bool(rand_crop)
+        self._rand_mirror = bool(rand_mirror)
+        self._mean = np.array([mean_r, mean_g, mean_b], np.float32)
+        self._std = np.array([std_r, std_g, std_b], np.float32)
+        self._scale = float(scale)
+        self._seed = int(seed)
+        self._round_batch = bool(round_batch)
+        self._dtype = np.dtype(dtype)
+        self._data_name = data_name
+        self._label_name = label_name
+
+        self._positions = self._index_positions(part_index, num_parts)
+        if not self._positions:
+            raise MXNetError("shard %d/%d of %s holds no records"
+                             % (part_index, num_parts, path_imgrec))
+        self._tl = threading.local()
+        self._norm_fn = None
+        self._pool = ThreadPoolExecutor(max_workers=int(preprocess_threads),
+                                        thread_name_prefix="imgrec")
+        self._depth = max(2, int(prefetch_buffer))
+        self._epoch = 0
+        self._order = None
+        self._cursor = 0
+        self._pending = deque()
+        self.reset()
+
+    # ------------------------------------------------------------------
+    # index & sharding
+    # ------------------------------------------------------------------
+    def _index_positions(self, part_index, num_parts):
+        """Byte offsets of every record in this worker's shard."""
+        import os
+
+        idx_path = self._path_idx
+        if idx_path is None and os.path.exists(self._path_rec[:-4]
+                                               + ".idx"):
+            idx_path = self._path_rec[:-4] + ".idx"
+        positions = []
+        if idx_path and os.path.exists(idx_path):
+            with open(idx_path) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    if len(parts) >= 2:
+                        positions.append(int(parts[1]))
+        else:
+            # one sequential scan to build the offset table
+            rec = MXRecordIO(self._path_rec, "r")
+            while True:
+                pos = rec.tell()
+                if rec.read() is None:
+                    break
+                positions.append(pos)
+            rec.close()
+        # contiguous shard per worker, reference-style
+        n = len(positions)
+        lo = (n * part_index) // num_parts
+        hi = (n * (part_index + 1)) // num_parts
+        return positions[lo:hi]
+
+    def _reader(self):
+        r = getattr(self._tl, "reader", None)
+        if r is None:
+            r = MXRecordIO(self._path_rec, "r")
+            self._tl.reader = r
+        return r
+
+    # ------------------------------------------------------------------
+    # iterator contract
+    # ------------------------------------------------------------------
+    @property
+    def provide_data(self):
+        return [DataDesc(self._data_name,
+                         (self.batch_size,) + self._data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self._label_width == 1 else \
+            (self.batch_size, self._label_width)
+        return [DataDesc(self._label_name, shape)]
+
+    def reset(self):
+        self._epoch += 1
+        order = np.arange(len(self._positions))
+        if self._shuffle:
+            np.random.RandomState(self._seed + self._epoch).shuffle(order)
+        self._order = order
+        self._cursor = 0
+        self._pending.clear()
+        for _ in range(self._depth):
+            self._submit()
+
+    def _submit(self):
+        if self._cursor >= len(self._order):
+            return
+        take = self._order[self._cursor:self._cursor + self.batch_size]
+        self._cursor += self.batch_size
+        pad = 0
+        if len(take) < self.batch_size:
+            short = self.batch_size - len(take)
+            pad = short
+            if self._round_batch:
+                # np.resize cycles — correct even when the whole shard is
+                # smaller than the shortfall
+                take = np.concatenate([take, np.resize(self._order, short)])
+            elif len(take) == 0:
+                return
+            else:
+                take = np.concatenate([take, np.resize(take, short)])
+        batch_id = self._cursor // self.batch_size
+        self._pending.append(
+            self._pool.submit(self._load_batch, take, pad, batch_id))
+
+    def next(self):
+        if not self._pending:
+            raise StopIteration
+        fut = self._pending.popleft()
+        self._submit()
+        data_u8, label_np, pad = fut.result()
+        return DataBatch(data=[self._to_device(data_u8)],
+                         label=[array(label_np)], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)
+
+    def _to_device(self, data_u8):
+        """Upload the raw uint8 batch (4x less tunnel/PCIe traffic than
+        fp32) and normalize on device as ONE fused jitted XLA call —
+        a single dispatch, not a chain of eager ops."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..ndarray.ndarray import NDArray
+
+        if self._norm_fn is None:
+            c = self._data_shape[0]
+            mean = jnp.asarray(self._mean[:c]).reshape(1, c, 1, 1)
+            std = jnp.asarray(self._std[:c]).reshape(1, c, 1, 1)
+            scale, dtype = self._scale, jnp.dtype(self._dtype)
+
+            @jax.jit
+            def norm(u8):
+                x = (u8.astype(jnp.float32) - mean) / std
+                if scale != 1.0:
+                    x = x * scale
+                return x.astype(dtype)
+
+            self._norm_fn = norm
+        return NDArray(self._norm_fn(data_u8))
+
+    # ------------------------------------------------------------------
+    # decode + augment (worker threads)
+    # ------------------------------------------------------------------
+    def _load_batch(self, order_idx, pad, batch_id):
+        c, h, w = self._data_shape
+        data = np.empty((self.batch_size, c, h, w), np.uint8)
+        if self._label_width == 1:
+            label = np.empty((self.batch_size,), np.float32)
+        else:
+            label = np.empty((self.batch_size, self._label_width),
+                             np.float32)
+        rng = np.random.RandomState(
+            (self._seed + 77_777 * self._epoch + batch_id) & 0x7FFFFFFF)
+        reader = self._reader()
+        for slot, oi in enumerate(order_idx):
+            raw = self._read_at(reader, self._positions[int(oi)])
+            header, img_bytes = unpack(raw)
+            img = self._decode_augment(img_bytes, rng)
+            data[slot] = img
+            lab = np.atleast_1d(np.asarray(header.label, np.float32))
+            label[slot] = lab[0] if self._label_width == 1 else \
+                lab[:self._label_width]
+        return data, label, pad
+
+    @staticmethod
+    def _read_at(reader, pos):
+        reader.seek(pos)
+        return reader.read()
+
+    def _decode_augment(self, img_bytes, rng):
+        import io as _io
+
+        from PIL import Image
+
+        c, h, w = self._data_shape
+        img = Image.open(_io.BytesIO(img_bytes))
+        img = img.convert("RGB" if c == 3 else "L")
+        if self._resize > 0:
+            ow, oh = img.size
+            if ow < oh:
+                img = img.resize((self._resize,
+                                  max(1, oh * self._resize // ow)))
+            else:
+                img = img.resize((max(1, ow * self._resize // oh),
+                                  self._resize))
+        ow, oh = img.size
+        if ow < w or oh < h:
+            img = img.resize((max(ow, w), max(oh, h)))
+            ow, oh = img.size
+        if self._rand_crop:
+            x0 = int(rng.randint(0, ow - w + 1))
+            y0 = int(rng.randint(0, oh - h + 1))
+        else:
+            x0, y0 = (ow - w) // 2, (oh - h) // 2
+        img = img.crop((x0, y0, x0 + w, y0 + h))
+        arr = np.asarray(img, np.uint8)
+        if arr.ndim == 2:
+            arr = arr[:, :, None]
+        if self._rand_mirror and rng.randint(2):
+            arr = arr[:, ::-1, :]
+        # normalization happens on device (see _to_device): workers only
+        # shuffle uint8 bytes, keeping host CPU for the JPEG codec
+        return np.ascontiguousarray(arr.transpose(2, 0, 1))
+
+    def __del__(self):
+        pool = getattr(self, "_pool", None)
+        if pool is not None:
+            try:
+                pool.shutdown(wait=False, cancel_futures=True)
+            except Exception:
+                pass  # interpreter teardown: queue module may be gone

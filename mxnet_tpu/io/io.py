@@ -512,8 +512,8 @@ class MNISTIter(NDArrayIter):
 
 def ImageRecordIter(**kwargs):
     """Factory matching mx.io.ImageRecordIter (reference:
-    src/io/iter_image_recordio_2.cc:766) — returns the python/thread-pool
-    pipeline from mxnet_tpu.image."""
-    from ..image.image import ImageRecordIterPy
+    src/io/iter_image_recordio_2.cc:766) — the threaded RecordIO ->
+    decode -> augment -> prefetch pipeline."""
+    from .image_record import ImageRecordIter as _Iter
 
-    return ImageRecordIterPy(**kwargs)
+    return _Iter(**kwargs)

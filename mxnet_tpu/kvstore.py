@@ -33,6 +33,22 @@ from . import optimizer as opt
 __all__ = ["KVStore", "create"]
 
 
+def _rsp_pull_into(out, row_ids, dense_source):
+    """Shared row_sparse_pull write-back: gather requested rows into a
+    RowSparseNDArray out, or row-mask a dense out."""
+    from .ndarray.sparse import RowSparseNDArray
+
+    rows = np.unique(row_ids.asnumpy().astype(np.int64))
+    src = np.asarray(dense_source)
+    if isinstance(out, RowSparseNDArray):
+        out._assign_rows(array(src[rows]), array(rows), src.shape)
+        return
+    mask = np.zeros(src.shape[0], bool)
+    mask[rows] = True
+    masked = src * mask.reshape((-1,) + (1,) * (src.ndim - 1))
+    out._rebind(array(masked)._data.astype(out._data.dtype))
+
+
 def _ctype_key_value(keys, vals):
     if isinstance(keys, (str, int)):
         keys = [keys]
@@ -132,25 +148,13 @@ class KVStore:
         """Pull only the rows in row_ids (reference: kvstore.py:314).
         A RowSparseNDArray `out` receives components (gather, memory ∝
         requested rows); a dense `out` gets the row-masked dense view."""
-        from .ndarray.sparse import RowSparseNDArray
-
         keys, outs = _ctype_key_value(key, out)
         if isinstance(row_ids, NDArray):
             row_ids = [row_ids] * len(outs[0])
         for k, olist in zip(keys, outs):
-            k = str(k)
-            src = self._store[k]
+            src = self._store[str(k)].asnumpy()
             for o, rid in zip(olist, row_ids):
-                rows = np.unique(rid.asnumpy().astype(np.int64))
-                if isinstance(o, RowSparseNDArray):
-                    vals = src._data[array(rows)._data]
-                    o._assign_rows(NDArray(vals), array(rows), src.shape)
-                    continue
-                dense = src.asnumpy()
-                mask = np.zeros(dense.shape[0], bool)
-                mask[rows] = True
-                val = dense * mask.reshape((-1,) + (1,) * (dense.ndim - 1))
-                o._rebind(array(val)._data.astype(o._data.dtype))
+                _rsp_pull_into(o, rid, src)
 
     # -- optimizer / updater --------------------------------------------
     def set_updater(self, updater):
@@ -223,22 +227,13 @@ class KVStoreDist(KVStore):
                 o._rebind(nd._data.astype(o._data.dtype))
 
     def row_sparse_pull(self, key, out=None, priority=0, row_ids=None):
-        from .ndarray.sparse import RowSparseNDArray
-
         keys, outs = _ctype_key_value(key, out)
         if isinstance(row_ids, NDArray):
             row_ids = [row_ids] * len(outs[0])
         for k, olist in zip(keys, outs):
             val = self._client.pull(str(k))
             for o, rid in zip(olist, row_ids):
-                rows = np.unique(rid.asnumpy().astype(np.int64))
-                if isinstance(o, RowSparseNDArray):
-                    o._assign_rows(array(val[rows]), array(rows), val.shape)
-                    continue
-                mask = np.zeros(val.shape[0], bool)
-                mask[rows] = True
-                o._rebind(array(val * mask.reshape(
-                    (-1,) + (1,) * (val.ndim - 1)))._data)
+                _rsp_pull_into(o, rid, val)
 
     def set_optimizer(self, optimizer):
         try:

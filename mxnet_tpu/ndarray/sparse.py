@@ -160,14 +160,19 @@ class CSRNDArray(BaseSparseNDArray):
                                        indptr._ctx)}
 
     def _row_ids(self):
-        """Per-nnz row index (host-side from indptr)."""
-        indptr = np.asarray(self._aux["indptr"]._data)
-        return np.repeat(np.arange(len(indptr) - 1), np.diff(indptr))
+        """Per-nnz row index NDArray (derived from indptr once, cached —
+        components are immutable between rebinds)."""
+        aux = self._components()
+        if "_rows" not in aux:
+            indptr = np.asarray(aux["indptr"]._data)
+            rows = np.repeat(np.arange(len(indptr) - 1), np.diff(indptr))
+            aux["_rows"] = array(rows.astype(np.int64))
+        return aux["_rows"]
 
     def _densify(self):
         jnp = _jnp()
         aux = self._aux
-        rows = jnp.asarray(self._row_ids())
+        rows = self._row_ids()._data
         dense = jnp.zeros(self._sshape, dtype=self._sdtype)
         return dense.at[rows, aux["indices"]._data].set(aux["data"]._data)
 
@@ -282,30 +287,41 @@ def add_rsp_rsp(a, b):
                             a.shape, a.context)
 
 
+def _register_csr_matmul():
+    from ..ops.registry import register
+
+    @register("_csr_matmul", num_inputs=4)
+    def _csr_matmul(vals, cols, rows, rhs, out_rows=0, transpose_a=False,
+                    **kw):
+        """csr(vals,cols,rows)·rhs as gather + scatter-add.  Pure jax and
+        differentiable — jax.vjp gives the gradients for vals and rhs, so
+        the autograd tape works through the sparse fast path."""
+        import jax.numpy as jnp
+
+        expand = (lambda v: v) if rhs.ndim == 1 else \
+            (lambda v: v.reshape((-1,) + (1,) * (rhs.ndim - 1)))
+        out = jnp.zeros((int(out_rows),) + tuple(rhs.shape[1:]),
+                        dtype=vals.dtype)
+        if transpose_a:
+            return out.at[cols].add(expand(vals) * rhs[rows])
+        return out.at[rows].add(expand(vals) * rhs[cols])
+
+
+_register_csr_matmul()
+
+
 def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     """dot with sparse-aware kernels: csr·dense and csrᵀ·dense run as
-    nnz-bounded gather + scatter-add (no densification)."""
+    nnz-bounded gather + scatter-add (no densification).  The fast path
+    dispatches through the op registry, so it is autograd-taped."""
     from . import ndarray as _nd
 
     if isinstance(lhs, CSRNDArray) and \
             not isinstance(rhs, BaseSparseNDArray) and not transpose_b:
-        jnp = _jnp()
-        vals = lhs.data._data
-        cols = lhs.indices._data
-        rows = jnp.asarray(lhs._row_ids())
-        r = rhs._data
-        # per-nnz contribution: scalar for a 1-D rhs, row for 2-D+
-        expand = (lambda v: v) if r.ndim == 1 else \
-            (lambda v: v.reshape((-1,) + (1,) * (r.ndim - 1)))
-        if transpose_a:
-            out = jnp.zeros((lhs.shape[1],) + tuple(r.shape[1:]),
-                            dtype=vals.dtype)
-            out = out.at[cols].add(expand(vals) * r[rows])
-        else:
-            out = jnp.zeros((lhs.shape[0],) + tuple(r.shape[1:]),
-                            dtype=vals.dtype)
-            out = out.at[rows].add(expand(vals) * r[cols])
-        return NDArray(out, lhs.context)
+        out_rows = lhs.shape[1] if transpose_a else lhs.shape[0]
+        return _nd._invoke_nd(
+            "_csr_matmul", [lhs.data, lhs.indices, lhs._row_ids(), rhs],
+            {"out_rows": out_rows, "transpose_a": bool(transpose_a)})
     dl = lhs.tostype("default") if isinstance(lhs, BaseSparseNDArray) else lhs
     dr = rhs.tostype("default") if isinstance(rhs, BaseSparseNDArray) else rhs
     return _nd._invoke_nd("dot", [dl, dr], {"transpose_a": transpose_a,
