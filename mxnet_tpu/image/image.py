@@ -9,7 +9,6 @@ from __future__ import annotations
 
 import io as _io
 import os
-from concurrent.futures import ThreadPoolExecutor
 
 import numpy as np
 
@@ -426,9 +425,11 @@ class ImageIter:
         return self
 
 
-def ImageRecordIterPy(**kwargs):
-    """Back-compat alias: the threaded RecordIO pipeline now lives in
-    mxnet_tpu.io.image_record.ImageRecordIter (single implementation)."""
+def ImageRecordIterPy(path_imgrec=None, data_shape=None, batch_size=1,
+                      **kwargs):
+    """Back-compat alias (old signature preserved): the threaded RecordIO
+    pipeline now lives in mxnet_tpu.io.image_record.ImageRecordIter."""
     from ..io.image_record import ImageRecordIter
 
-    return ImageRecordIter(**kwargs)
+    return ImageRecordIter(path_imgrec=path_imgrec, data_shape=data_shape,
+                           batch_size=batch_size, **kwargs)

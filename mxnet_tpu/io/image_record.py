@@ -27,7 +27,7 @@ import numpy as np
 
 from ..base import MXNetError
 from ..ndarray.ndarray import array
-from ..recordio import MXIndexedRecordIO, MXRecordIO, unpack
+from ..recordio import MXRecordIO, unpack
 from .io import DataBatch, DataDesc, DataIter
 
 __all__ = ["ImageRecordIter"]

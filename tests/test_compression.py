@@ -1,0 +1,104 @@
+"""2-bit gradient compression tests (arithmetic identities modeled on
+the reference's tests/nightly/dist_sync_kvstore.py compressed checks)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu.contrib.compression import (GradientCompression,
+                                           dequantize_2bit, quantize_2bit)
+
+
+def _reference_2bit(grad, residual, threshold):
+    """Straight numpy transcription of the documented semantics."""
+    out = np.zeros_like(grad)
+    res = residual + grad
+    pos = res >= threshold
+    neg = res <= -threshold
+    out[pos] = threshold
+    out[neg] = -threshold
+    res[pos] -= threshold
+    res[neg] += threshold
+    return out, res
+
+
+def test_quantize_matches_reference_semantics():
+    rng = np.random.RandomState(0)
+    grad = rng.randn(1000).astype(np.float32)
+    res = rng.randn(1000).astype(np.float32) * 0.1
+    threshold = 0.5
+    codes, new_res = quantize_2bit(grad, res, threshold)
+    deq = np.asarray(dequantize_2bit(codes, 1000, threshold))
+    expect_out, expect_res = _reference_2bit(grad, res.copy(), threshold)
+    np.testing.assert_allclose(deq, expect_out, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(new_res), expect_res, rtol=1e-5,
+                               atol=1e-6)
+
+
+def test_codes_are_16x_smaller():
+    n = 16384  # one packing tile
+    grad = np.random.randn(n).astype(np.float32)
+    codes, _ = quantize_2bit(grad, np.zeros(n, np.float32))
+    assert codes.dtype == np.int32
+    assert codes.size * 4 * 8 == grad.size * 2  # 2 bits per element
+
+
+def test_error_feedback_accumulates():
+    """Small gradients below threshold eventually emit via the residual."""
+    gc = GradientCompression(type="2bit", threshold=0.5)
+    grad = mx.nd.array(np.full(10, 0.2, np.float32))
+    emitted = np.zeros(10, np.float32)
+    for _ in range(5):
+        emitted += gc.compress_dequantize("k", grad).asnumpy()
+    # 5 x 0.2 = 1.0 of signal -> exactly two +0.5 emissions
+    np.testing.assert_allclose(emitted, np.full(10, 1.0), rtol=1e-6)
+
+
+def test_values_quantized_to_threshold_multiples():
+    gc = GradientCompression(threshold=0.3)
+    grad = mx.nd.array(np.random.randn(257).astype(np.float32))
+    out = gc.compress_dequantize("k", grad).asnumpy()
+    assert set(np.round(np.unique(out) / 0.3).astype(int)) <= {-1, 0, 1}
+
+
+def test_kvstore_push_with_compression():
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.zeros((64,)))
+    kv.set_gradient_compression({"type": "2bit", "threshold": 0.5})
+    g1 = mx.nd.array(np.full(64, 0.7, np.float32))
+    g2 = mx.nd.array(np.full(64, -0.6, np.float32))
+    kv.push("w", [g1, g2])
+    out = mx.nd.zeros((64,))
+    kv.pull("w", out=out)
+    # each worker quantizes independently: +0.5 + (-0.5) = 0
+    np.testing.assert_allclose(out.asnumpy(), np.zeros(64), atol=1e-6)
+    # residuals carry 0.2 / -0.1; second identical push emits +0.5 / -0.5
+    kv.push("w", [g1, g2])
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.zeros(64), atol=1e-6)
+    # third push: worker1 residual 0.4+0.7>=0.5 -> +0.5;
+    # worker2 residual -0.2-0.6<=-0.5 -> -0.5; still cancel
+    kv.push("w", [g1, g2])
+    kv.pull("w", out=out)
+    np.testing.assert_allclose(out.asnumpy(), np.zeros(64), atol=1e-6)
+
+
+def test_kvstore_compression_asymmetric_workers():
+    kv = mx.kv.create("local")
+    kv.init("w", mx.nd.zeros((32,)))
+    kv.set_gradient_compression({"type": "2bit", "threshold": 1.0})
+    kv.push("w", [mx.nd.array(np.full(32, 2.5, np.float32)),
+                  mx.nd.array(np.full(32, 0.4, np.float32))])
+    out = mx.nd.zeros((32,))
+    kv.pull("w", out=out)
+    # worker1 emits +1.0 (residual 1.5), worker2 emits 0 (residual .4)
+    np.testing.assert_allclose(out.asnumpy(), np.full(32, 1.0), atol=1e-6)
+
+
+def test_large_tensor_roundtrip():
+    rng = np.random.RandomState(7)
+    grad = rng.randn(100_000).astype(np.float32)
+    res = np.zeros(100_000, np.float32)
+    codes, new_res = quantize_2bit(grad, res, 0.5)
+    deq = np.asarray(dequantize_2bit(codes, 100_000, 0.5))
+    expect_out, expect_res = _reference_2bit(grad, res.copy(), 0.5)
+    np.testing.assert_allclose(deq, expect_out)
+    np.testing.assert_allclose(np.asarray(new_res), expect_res, atol=1e-6)
