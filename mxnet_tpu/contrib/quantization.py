@@ -2,10 +2,13 @@
 — calibration via layer-output collection :127, KL-divergence thresholds
 :346, quantize_model:422; C++ side src/operator/quantization/).
 
-TPU-native: int8 is emulated with fake-quantization (quantize->int8
-values held in int8 arrays, dequantize on use); XLA fuses the scale
-ops into the surrounding matmuls.  The calibration machinery (min/max
-and KL / entropy thresholds) matches the reference's algorithms.
+TPU-native: ``quantize_model`` graph-rewrites eligible layers onto real
+int8 kernels — int8 x int8 matmul/conv with int32 accumulation via
+``preferred_element_type`` (the MXU's int8 path) — with dynamic
+per-batch activation ranges quantized inside the graph and weights
+stored as int8 params + range scalars.  The calibration machinery
+(min/max and KL / entropy thresholds) matches the reference's
+algorithms.
 """
 from __future__ import annotations
 
@@ -140,28 +143,147 @@ class LayerOutputCollector:
         self.samples.setdefault(name, []).append(np.abs(npv).ravel()[:4096])
 
 
+_QUANTIZABLE = ("FullyConnected", "Convolution")
+
+
+def _eligible_nodes(sym, excluded):
+    """Quantizable nodes: op type matches, not excluded, weight input is
+    a plain variable that no other node consumes (shared or computed
+    weights stay fp32 — their producing subgraph must survive)."""
+    nodes = sym._topo_nodes()
+    var_consumers = {}
+    for node in nodes:
+        if node.op is None:
+            continue
+        for (n, _i) in node.inputs:
+            if n.op is None:
+                var_consumers.setdefault(id(n), set()).add(id(node))
+    eligible = set()
+    for node in nodes:
+        if node.op not in _QUANTIZABLE or node.name in excluded:
+            continue
+        w_node, _ = node.inputs[1]
+        if w_node.op is None and \
+                var_consumers.get(id(w_node)) == {id(node)}:
+            eligible.add(id(node))
+    return eligible
+
+
+def _quantize_symbol(sym, excluded):
+    """Graph rewrite (reference: quantize_graph_pass.cc): every eligible
+    FullyConnected/Convolution becomes
+
+        quantize_v2(x) -> int8 kernel (int32 accum) -> dequantize_int32
+        [-> broadcast bias add in fp32]
+
+    so the matmul/conv really executes in int8 on the MXU."""
+    from ..symbol import symbol as S
+
+    eligible = _eligible_nodes(sym, excluded)
+    memo = {}
+
+    def rebuild(node):
+        if id(node) in memo:
+            return memo[id(node)]
+        if node.op is None:
+            out = S.Symbol([(node, 0)])
+            memo[id(node)] = out
+            return out
+        ins = []
+        for (n, i) in node.inputs:
+            s = rebuild(n)
+            ins.append(s[i] if len(s) > 1 else s)
+        if id(node) in eligible:
+            out = _emit_quantized(S, node, ins)
+        else:
+            out = S._invoke_sym(node.op, ins, dict(node.attrs),
+                                name=node.name)
+        memo[id(node)] = out
+        return out
+
+    outs = []
+    for (node, i) in sym._entries:
+        s = rebuild(node)
+        outs.append(s[i] if len(s) > 1 else s)
+    return S.Group(outs)
+
+
+def _emit_quantized(S, node, ins):
+    data_s = ins[0]
+    bias_s = ins[2] if len(ins) > 2 else None
+    qd = S._invoke_sym("_contrib_quantize_v2", [data_s], {},
+                       name=node.name + "_data_quantize")
+    wq = S.var(node.name + "_weight_quantized")
+    wmin = S.var(node.name + "_weight_min")
+    wmax = S.var(node.name + "_weight_max")
+    qop = ("_contrib_quantized_fully_connected"
+           if node.op == "FullyConnected" else "_contrib_quantized_conv")
+    attrs = {k: v for k, v in node.attrs.items()
+             if k not in ("no_bias",)}
+    q = S._invoke_sym(qop, [qd[0], wq, qd[1], qd[2], wmin, wmax], attrs,
+                      name=node.name + "_quantized")
+    out = S._invoke_sym("_contrib_dequantize_int32", [q[0], q[1], q[2]],
+                        {}, name=node.name + "_dequantize")
+    if bias_s is not None:
+        if node.op == "Convolution":
+            from ..ops.utils import ptuple
+
+            kernel_nd = len(ptuple(node.attrs.get("kernel"), default=(1, 1)))
+            bias_s = S._invoke_sym(
+                "Reshape", [bias_s],
+                {"shape": (1, -1) + (1,) * kernel_nd},
+                name=node.name + "_bias_reshape")
+        out = S._invoke_sym("broadcast_add", [out, bias_s], {},
+                            name=node.name + "_bias_add")
+    return out
+
+
+def _quantized_layer_weights(sym, excluded):
+    """Map weight-param name -> quantized layer name for eligible nodes."""
+    eligible = _eligible_nodes(sym, excluded)
+    out = {}
+    for node in sym._topo_nodes():
+        if id(node) in eligible:
+            w_node, _ = node.inputs[1]
+            out[w_node.name] = node.name
+    return out
+
+
 def quantize_model(sym, arg_params, aux_params, data_names=("data",),
                    ctx=None, excluded_sym_names=None, calib_mode="naive",
                    calib_data=None, num_calib_examples=None,
                    quantized_dtype="int8", logger=None):
     """Quantize a symbolic model (reference: quantize_model:422).
 
-    Rewrites FullyConnected/Convolution weights to int8 + scale pairs
-    stored alongside fp32 originals; executor dequantizes on use (XLA
-    fuses the scale).  Returns (quantized symbol, arg_params, aux_params).
+    Returns (quantized symbol, quantized arg_params, aux_params): the
+    symbol is graph-rewritten so eligible layers compute in real int8
+    (int32 accumulation), and each quantized layer's weight param is
+    replaced by ``<layer>_weight_quantized`` (int8) plus
+    ``<layer>_weight_min`` / ``_max`` range scalars.  Activations use
+    dynamic per-batch ranges via quantize_v2 inside the graph.
     """
+    if quantized_dtype not in ("int8", "auto"):
+        raise MXNetError("quantized_dtype %r unsupported (int8 only)"
+                         % quantized_dtype)
     excluded = set(excluded_sym_names or [])
-    qarg_params = dict(arg_params)
+    qsym = _quantize_symbol(sym, excluded)
+    wmap = _quantized_layer_weights(sym, excluded)
+    qarg_params = {}
     for name, arr in arg_params.items():
-        if name in excluded or not name.endswith("weight"):
+        layer = wmap.get(name)
+        if layer is None:
+            qarg_params[name] = arr
             continue
         npv = arr.asnumpy()
         r = float(np.abs(npv).max()) or 1e-8
-        scale = 127.0 / r
-        q = np.clip(np.rint(npv * scale), -127, 127).astype(np.int8)
-        # store dequantized-through-int8 weights (fake-quant inference)
-        qarg_params[name] = array((q.astype(np.float32) / scale))
-    return sym, qarg_params, dict(aux_params)
+        q = np.clip(np.rint(npv * (127.0 / r)), -127, 127) \
+            .astype(np.int8)
+        qarg_params[layer + "_weight_quantized"] = array(q)
+        qarg_params[layer + "_weight_min"] = array(
+            np.array(-r, np.float32))
+        qarg_params[layer + "_weight_max"] = array(
+            np.array(r, np.float32))
+    return qsym, qarg_params, dict(aux_params)
 
 
 def quantize_net(net, calib_data=None, quantized_dtype="int8", **kwargs):
@@ -175,3 +297,65 @@ def quantize_net(net, calib_data=None, quantized_dtype="int8", **kwargs):
         q = np.clip(np.rint(npv * scale), -127, 127).astype(np.int8)
         p.set_data(array(q.astype(np.float32) / scale))
     return net
+
+
+# ---------------------------------------------------------------------------
+# real int8 compute (reference: src/operator/quantization/quantized_fully_
+# connected.cc / quantized_conv.cc — int8 x int8 -> int32 kernels)
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_quantized_fully_connected", num_inputs=6, num_outputs=3,
+          differentiable=False)
+def _quantized_fc(data, weight, min_data, max_data, min_w, max_w,
+                  num_hidden=None, flatten=True, **kw):
+    """int8 data x int8 weight -> int32 accumulation on the MXU
+    (preferred_element_type drives the int8 matmul path)."""
+    from jax import lax
+    from ..ops.utils import pbool
+
+    if pbool(flatten, True) and data.ndim > 2:
+        data = data.reshape(data.shape[0], -1)
+    out = lax.dot_general(data, weight,
+                          (((data.ndim - 1,), (1,)), ((), ())),
+                          preferred_element_type=jnp.int32)
+    rd = jnp.maximum(jnp.abs(min_data), jnp.abs(max_data))
+    rw = jnp.maximum(jnp.abs(min_w), jnp.abs(max_w))
+    r_out = rd * rw  # |q| <= 127*127 scale maps back by (rd*rw)/(127*127)
+    return out, -r_out, r_out
+
+
+@register("_contrib_quantized_conv", num_inputs=6, num_outputs=3,
+          differentiable=False)
+def _quantized_conv(data, weight, min_data, max_data, min_w, max_w,
+                    kernel=None, stride=None, dilate=None, pad=None,
+                    num_filter=None, num_group=1, layout=None, **kw):
+    """int8 x int8 -> int32 convolution (prologue shared with the fp32
+    Convolution op in ops/nn.py)."""
+    from jax import lax
+    from ..ops.nn import _conv_dims, _dim_numbers
+    from ..ops.utils import ptuple, pint
+
+    kernel = ptuple(kernel)
+    nd = _conv_dims(kernel)
+    stride = ptuple(stride, ndim=nd, default=(1,) * nd)
+    dilate = ptuple(dilate, ndim=nd, default=(1,) * nd)
+    pad = ptuple(pad, ndim=nd, default=(0,) * nd)
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                    _dim_numbers(nd))
+    out = lax.conv_general_dilated(
+        data, weight, window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=pint(num_group, 1),
+        preferred_element_type=jnp.int32)
+    rd = jnp.maximum(jnp.abs(min_data), jnp.abs(max_data))
+    rw = jnp.maximum(jnp.abs(min_w), jnp.abs(max_w))
+    r_out = rd * rw
+    return out, -r_out, r_out
+
+
+@register("_contrib_dequantize_int32", num_inputs=3, differentiable=False)
+def _dequantize_i32(data, min_range, max_range, **kw):
+    """int32 accumulator -> fp32 using the propagated product range."""
+    r = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
+    return data.astype(jnp.float32) * (r / (127.0 * 127.0))

@@ -1,0 +1,82 @@
+"""INT8 quantization tests: real int8 compute path."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.contrib import quantization as q
+
+
+def test_quantized_fc_int8_compute():
+    """The rewritten graph computes in int8 and tracks fp32 closely."""
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 8).astype(np.float32)
+    w = rng.randn(16, 8).astype(np.float32)
+    b = rng.randn(16).astype(np.float32)
+
+    data = mx.sym.var("data")
+    out = mx.sym.FullyConnected(data, num_hidden=16, name="fc")
+    args = {"fc_weight": nd.array(w), "fc_bias": nd.array(b)}
+    qsym, qargs, _ = q.quantize_model(out, args, {}, calib_mode="none")
+
+    # the quantized graph has int8 weight params, not the fp32 original
+    assert "fc_weight_quantized" in qargs and "fc_weight" not in qargs
+    assert qargs["fc_weight_quantized"].asnumpy().dtype == np.int8
+
+    ex = qsym.bind(args=dict(qargs, data=nd.array(x)))
+    got = ex.forward()[0].asnumpy()
+    expect = x @ w.T + b
+    # int8 dynamic quantization: ~2% relative error budget
+    err = np.abs(got - expect).max() / (np.abs(expect).max() + 1e-6)
+    assert err < 0.02, err
+
+
+def test_quantized_conv_int8_compute():
+    rng = np.random.RandomState(1)
+    x = rng.randn(2, 3, 8, 8).astype(np.float32)
+    w = rng.randn(4, 3, 3, 3).astype(np.float32)
+
+    data = mx.sym.var("data")
+    out = mx.sym.Convolution(data, num_filter=4, kernel=(3, 3),
+                             no_bias=True, name="conv")
+    args = {"conv_weight": nd.array(w)}
+    qsym, qargs, _ = q.quantize_model(out, args, {}, calib_mode="none")
+    ex = qsym.bind(args=dict(qargs, data=nd.array(x)))
+    got = ex.forward()[0].asnumpy()
+
+    fex = out.bind(args=dict(args, data=nd.array(x)))
+    expect = fex.forward()[0].asnumpy()
+    err = np.abs(got - expect).max() / (np.abs(expect).max() + 1e-6)
+    assert err < 0.03, err
+
+
+def test_quantized_int32_accumulator():
+    """The int8 kernel really accumulates in int32 (no float round-trip)."""
+    from mxnet_tpu.ndarray.ndarray import _invoke_nd
+    d = nd.array(np.full((2, 4), 100, np.int8))
+    w = nd.array(np.full((3, 4), 100, np.int8))
+    mn = nd.array(np.array(-1.0, np.float32))
+    mxr = nd.array(np.array(1.0, np.float32))
+    out, omin, omax = _invoke_nd(
+        "_contrib_quantized_fully_connected", [d, w, mn, mxr, mn, mxr],
+        {"num_hidden": 3})
+    # 4 * 100*100 = 40000 > int16 range: proves int32 accumulation
+    assert out.asnumpy().dtype == np.int32
+    np.testing.assert_array_equal(out.asnumpy(), 40000)
+
+
+def test_excluded_layer_stays_fp32():
+    data = mx.sym.var("data")
+    h = mx.sym.FullyConnected(data, num_hidden=4, name="fc1")
+    out = mx.sym.FullyConnected(h, num_hidden=2, name="fc2")
+    rng = np.random.RandomState(2)
+    args = {"fc1_weight": nd.array(rng.randn(4, 3).astype(np.float32)),
+            "fc1_bias": nd.array(np.zeros(4, np.float32)),
+            "fc2_weight": nd.array(rng.randn(2, 4).astype(np.float32)),
+            "fc2_bias": nd.array(np.zeros(2, np.float32))}
+    qsym, qargs, _ = q.quantize_model(out, args, {},
+                                      excluded_sym_names=["fc2"])
+    assert "fc1_weight_quantized" in qargs
+    assert "fc2_weight" in qargs and "fc2_weight_quantized" not in qargs
+    ex = qsym.bind(args=dict(qargs, data=nd.array(
+        rng.randn(5, 3).astype(np.float32))))
+    assert ex.forward()[0].shape == (5, 2)

@@ -1,0 +1,130 @@
+"""SSD / RPN contrib op tests (modeled on the reference
+tests/python/unittest/test_operator.py multibox + proposal cases)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_multibox_target_basic():
+    # one anchor exactly on the gt, one far away
+    anchors = nd.array(np.array([[[0.1, 0.1, 0.5, 0.5],
+                                  [0.6, 0.6, 0.9, 0.9]]], np.float32))
+    # label row: [class, x1, y1, x2, y2]
+    label = nd.array(np.array([[[0.0, 0.1, 0.1, 0.5, 0.5]]], np.float32))
+    cls_pred = nd.array(np.zeros((1, 2, 2), np.float32))
+    loc_t, loc_mask, cls_t = nd.contrib.MultiBoxTarget(
+        anchors, label, cls_pred)
+    assert cls_t.shape == (1, 2)
+    ct = cls_t.asnumpy()[0]
+    assert ct[0] == 1.0          # class 0 + 1
+    assert ct[1] == 0.0          # background
+    lm = loc_mask.asnumpy().reshape(2, 4)
+    np.testing.assert_array_equal(lm[0], 1)
+    np.testing.assert_array_equal(lm[1], 0)
+    # perfect match -> zero regression target
+    np.testing.assert_allclose(loc_t.asnumpy().reshape(2, 4)[0],
+                               np.zeros(4), atol=1e-5)
+
+
+def test_multibox_target_encoding():
+    anchors = nd.array(np.array([[[0.0, 0.0, 0.4, 0.4]]], np.float32))
+    label = nd.array(np.array([[[2.0, 0.1, 0.1, 0.5, 0.5]]], np.float32))
+    cls_pred = nd.array(np.zeros((1, 3, 1), np.float32))
+    loc_t, _, cls_t = nd.contrib.MultiBoxTarget(anchors, label, cls_pred)
+    assert cls_t.asnumpy()[0, 0] == 3.0
+    # encoding: centers shifted by 0.1 -> (0.1/0.4)/0.1 = 2.5; sizes equal
+    np.testing.assert_allclose(loc_t.asnumpy().reshape(4),
+                               [2.5, 2.5, 0.0, 0.0], atol=1e-5)
+
+
+def test_multibox_target_negative_mining():
+    anchors = np.random.rand(1, 20, 4).astype(np.float32) * 0.01
+    anchors[0, 0] = [0.5, 0.5, 0.9, 0.9]        # overlaps the gt
+    label = nd.array(np.array([[[0.0, 0.5, 0.5, 0.9, 0.9]]], np.float32))
+    cls_pred = nd.array(np.random.randn(1, 3, 20).astype(np.float32))
+    _, _, cls_t = nd.contrib.MultiBoxTarget(
+        nd.array(anchors), label, cls_pred, negative_mining_ratio=2.0,
+        negative_mining_thresh=0.5)
+    ct = cls_t.asnumpy()[0]
+    # bipartite matching gives one positive; ratio 2 -> two negatives
+    assert (ct > 0).sum() == 1
+    assert (ct == 0).sum() == 2
+    assert (ct == -1).sum() == 17
+
+
+def test_multibox_detection_roundtrip():
+    anchors = nd.array(np.array([[[0.1, 0.1, 0.5, 0.5],
+                                  [0.5, 0.5, 0.9, 0.9]]], np.float32))
+    cls_prob = nd.array(np.array(
+        [[[0.1, 0.8], [0.9, 0.1], [0.0, 0.1]]], np.float32))  # (1, 3, 2)
+    loc_pred = nd.array(np.zeros((1, 8), np.float32))
+    out = nd.contrib.MultiBoxDetection(cls_prob, loc_pred, anchors,
+                                       threshold=0.2)
+    res = out.asnumpy()[0]
+    # anchor0 -> class 0 (id 0 after -1 shift); anchor1 under threshold
+    kept = res[res[:, 0] >= 0]
+    assert len(kept) == 1
+    np.testing.assert_allclose(kept[0, :2], [0.0, 0.9], atol=1e-6)
+    np.testing.assert_allclose(kept[0, 2:], [0.1, 0.1, 0.5, 0.5],
+                               atol=1e-5)
+
+
+def test_multibox_detection_nms():
+    # two overlapping same-class detections: NMS keeps the stronger
+    anchors = nd.array(np.array([[[0.1, 0.1, 0.5, 0.5],
+                                  [0.12, 0.12, 0.52, 0.52]]], np.float32))
+    cls_prob = nd.array(np.array(
+        [[[0.1, 0.2], [0.9, 0.8]]], np.float32))
+    loc_pred = nd.array(np.zeros((1, 8), np.float32))
+    out = nd.contrib.MultiBoxDetection(cls_prob, loc_pred, anchors,
+                                       nms_threshold=0.5)
+    res = out.asnumpy()[0]
+    kept = res[res[:, 0] >= 0]
+    assert len(kept) == 1
+    assert abs(kept[0, 1] - 0.9) < 1e-6
+
+
+def test_proposal_shapes_and_validity():
+    B, A, H, W = 1, 12, 8, 8
+    rng = np.random.RandomState(0)
+    cls_prob = nd.array(rng.rand(B, 2 * A, H, W).astype(np.float32))
+    bbox_pred = nd.array((rng.rand(B, 4 * A, H, W).astype(np.float32)
+                          - 0.5) * 0.1)
+    im_info = nd.array(np.array([[128, 128, 1.0]], np.float32))
+    rois = nd.contrib.Proposal(cls_prob, bbox_pred, im_info,
+                               rpn_pre_nms_top_n=200,
+                               rpn_post_nms_top_n=50)
+    r = rois.asnumpy()
+    assert r.shape == (50, 5)
+    assert (r[:, 0] == 0).all()
+    assert (r[:, 1] <= r[:, 3]).all() and (r[:, 2] <= r[:, 4]).all()
+    assert (r[:, 1:] >= 0).all() and (r[:, [1, 3]] <= 127).all()
+
+
+def test_proposal_output_score():
+    B, A, H, W = 1, 1, 4, 4   # scales=(8,) x ratios=(1.0,) -> A=1
+    rng = np.random.RandomState(1)
+    cls_prob = nd.array(rng.rand(B, 2 * A, H, W).astype(np.float32))
+    bbox_pred = nd.array(np.zeros((B, 4 * A, H, W), np.float32))
+    im_info = nd.array(np.array([[64, 64, 1.0]], np.float32))
+    rois, scores = nd.contrib.Proposal(
+        cls_prob, bbox_pred, im_info, scales=(8,), ratios=(1.0,),
+        rpn_post_nms_top_n=10, output_score=True)
+    assert rois.shape == (10, 5)
+    assert scores.shape == (10, 1)
+    s = scores.asnumpy().ravel()
+    assert (np.diff(s[:3]) <= 1e-6).all()  # descending scores
+
+
+def test_multibox_target_in_symbol():
+    a = mx.sym.var("a")
+    l = mx.sym.var("l")
+    c = mx.sym.var("c")
+    outs = mx.sym.contrib.MultiBoxTarget(a, l, c)
+    ex = outs.bind(args={
+        "a": nd.array(np.array([[[0.1, 0.1, 0.5, 0.5]]], np.float32)),
+        "l": nd.array(np.array([[[1.0, 0.1, 0.1, 0.5, 0.5]]], np.float32)),
+        "c": nd.array(np.zeros((1, 3, 1), np.float32))})
+    res = ex.forward()
+    assert res[2].asnumpy()[0, 0] == 2.0
