@@ -2,3 +2,5 @@
 from . import quantization  # noqa: F401
 from . import ndarray  # noqa: F401
 from . import symbol  # noqa: F401
+from . import onnx  # noqa: F401
+from . import compression  # noqa: F401

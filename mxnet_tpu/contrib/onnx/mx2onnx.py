@@ -1,0 +1,269 @@
+"""Symbol -> ONNX exporter.
+
+Reference parity: ``python/mxnet/contrib/onnx/mx2onnx/export_model.py``
+(same ``export_model(sym, params, input_shape, ...)`` surface).  The
+graph walk emits ONNX opset-12 nodes for the core layer vocabulary;
+serialization uses the self-contained wire codec in ``_proto`` (no onnx
+package needed).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from ...ops.utils import pbool, pfloat, pint, ptuple
+from . import _proto as P
+
+__all__ = ["export_model"]
+
+# opset 13: Softmax/LogSoftmax gained per-axis semantics (pre-13 they
+# flatten trailing dims), matching the mx ops we map onto them
+_OPSET = 13
+
+
+def _attr(name, value):
+    """Build an AttributeProto from a python value."""
+    if isinstance(value, bool):
+        return {"name": name, "type": P.ATTR_INT, "i": int(value)}
+    if isinstance(value, int):
+        return {"name": name, "type": P.ATTR_INT, "i": value}
+    if isinstance(value, float):
+        return {"name": name, "type": P.ATTR_FLOAT, "f": value}
+    if isinstance(value, str):
+        return {"name": name, "type": P.ATTR_STRING,
+                "s": value.encode("utf-8")}
+    if isinstance(value, (tuple, list)):
+        if all(isinstance(v, int) for v in value):
+            return {"name": name, "type": P.ATTR_INTS,
+                    "ints": list(value)}
+        return {"name": name, "type": P.ATTR_FLOATS,
+                "floats": [float(v) for v in value]}
+    raise MXNetError("unsupported attribute %s=%r" % (name, value))
+
+
+def _tensor(name, arr):
+    arr = np.ascontiguousarray(arr)
+    dt = {np.dtype(np.float32): P.TP_FLOAT,
+          np.dtype(np.float64): P.TP_DOUBLE,
+          np.dtype(np.int32): P.TP_INT32,
+          np.dtype(np.int64): P.TP_INT64,
+          np.dtype(np.int8): P.TP_INT8,
+          np.dtype(np.uint8): P.TP_UINT8}.get(arr.dtype)
+    if dt is None:
+        arr = arr.astype(np.float32)
+        dt = P.TP_FLOAT
+    return {"name": name, "dims": list(arr.shape), "data_type": dt,
+            "raw_data": arr.tobytes()}
+
+
+def _vinfo(name, shape, elem_type=P.TP_FLOAT):
+    return {"name": name, "type": {"tensor_type": {
+        "elem_type": elem_type,
+        "shape": {"dim": [{"dim_value": int(d)} for d in shape]}}}}
+
+
+def _conv_attrs(attrs):
+    kernel = ptuple(attrs.get("kernel"))
+    nd = len(kernel)
+    stride = ptuple(attrs.get("stride"), ndim=nd, default=(1,) * nd)
+    pad = ptuple(attrs.get("pad"), ndim=nd, default=(0,) * nd)
+    dilate = ptuple(attrs.get("dilate"), ndim=nd, default=(1,) * nd)
+    return [_attr("kernel_shape", kernel),
+            _attr("strides", stride),
+            _attr("pads", pad + pad),
+            _attr("dilations", dilate),
+            _attr("group", pint(attrs.get("num_group"), 1))]
+
+
+class _Exporter:
+    def __init__(self, params):
+        self.params = params      # name -> numpy
+        self.nodes = []
+        self.initializers = []
+        self.used_params = set()
+
+    def emit(self, op_type, inputs, outputs, name, attrs=()):
+        self.nodes.append({"op_type": op_type, "input": list(inputs),
+                           "output": list(outputs), "name": name,
+                           "attribute": list(attrs)})
+
+    def add_init(self, name, arr):
+        if name not in self.used_params:
+            self.used_params.add(name)
+            self.initializers.append(_tensor(name, np.asarray(arr)))
+
+    def const(self, name, arr):
+        self.add_init(name, arr)
+        return name
+
+
+def _export_node(ex, node, ins, out):
+    """Emit ONNX node(s) for one mx symbol node; returns nothing (writes
+    into ex).  ``ins`` are input value names, ``out`` the output name."""
+    op, attrs, name = node.op, node.attrs, node.name
+    if op == "FullyConnected":
+        data = ins[0]
+        if pbool(attrs.get("flatten"), True):
+            flat = name + "_flat"
+            ex.emit("Flatten", [data], [flat], name + "_flatten",
+                    [_attr("axis", 1)])
+            data = flat
+        no_bias = pbool(attrs.get("no_bias"))
+        if no_bias:
+            # Gemm requires C in opset<13? C optional since 11; keep 2-in
+            ex.emit("Gemm", [data, ins[1]], [out], name,
+                    [_attr("transB", 1)])
+        else:
+            ex.emit("Gemm", [data, ins[1], ins[2]], [out], name,
+                    [_attr("transB", 1)])
+    elif op == "Convolution":
+        ex.emit("Conv", ins[:2] if pbool(attrs.get("no_bias")) else ins,
+                [out], name, _conv_attrs(attrs))
+    elif op == "Activation":
+        act = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+               "softrelu": "Softplus"}[attrs.get("act_type", "relu")]
+        ex.emit(act, ins, [out], name)
+    elif op == "LeakyReLU":
+        ex.emit("LeakyRelu", ins[:1], [out], name,
+                [_attr("alpha", pfloat(attrs.get("slope"), 0.25))])
+    elif op == "BatchNorm":
+        eps = pfloat(attrs.get("eps"), 1e-3)
+        mom = pfloat(attrs.get("momentum"), 0.9)
+        if pbool(attrs.get("fix_gamma"), True):
+            gamma = ex.params.get(ins[1])
+            if gamma is not None:
+                ex.params[ins[1]] = np.ones_like(gamma)
+        ex.emit("BatchNormalization", ins, [out], name,
+                [_attr("epsilon", eps), _attr("momentum", mom)])
+    elif op == "Pooling":
+        kind = attrs.get("pool_type", "max")
+        if pbool(attrs.get("global_pool")):
+            ex.emit("GlobalMaxPool" if kind == "max" else
+                    "GlobalAveragePool", ins, [out], name)
+        else:
+            kernel = ptuple(attrs.get("kernel"))
+            nd = len(kernel)
+            stride = ptuple(attrs.get("stride"), ndim=nd,
+                            default=(1,) * nd)
+            pad = ptuple(attrs.get("pad"), ndim=nd, default=(0,) * nd)
+            ex.emit("MaxPool" if kind == "max" else "AveragePool", ins,
+                    [out], name,
+                    [_attr("kernel_shape", kernel),
+                     _attr("strides", stride),
+                     _attr("pads", pad + pad)])
+    elif op == "Flatten":
+        ex.emit("Flatten", ins, [out], name, [_attr("axis", 1)])
+    elif op in ("softmax", "SoftmaxOutput", "log_softmax"):
+        onnx_op = "LogSoftmax" if op == "log_softmax" else "Softmax"
+        axis = pint(attrs.get("axis"), -1 if op == "softmax" else 1)
+        ex.emit(onnx_op, ins[:1], [out], name, [_attr("axis", axis)])
+    elif op in ("elemwise_add", "_plus", "broadcast_add"):
+        ex.emit("Add", ins, [out], name)
+    elif op in ("elemwise_sub", "_minus", "broadcast_sub"):
+        ex.emit("Sub", ins, [out], name)
+    elif op in ("elemwise_mul", "_mul", "broadcast_mul"):
+        ex.emit("Mul", ins, [out], name)
+    elif op in ("elemwise_div", "_div", "broadcast_div"):
+        ex.emit("Div", ins, [out], name)
+    elif op == "Concat":
+        ex.emit("Concat", ins, [out], name,
+                [_attr("axis", pint(attrs.get("dim"), 1))])
+    elif op == "Dropout":
+        ex.emit("Dropout", ins, [out], name)
+    elif op == "Reshape":
+        shape = ptuple(attrs.get("shape"))
+        shp = ex.const(name + "_shape",
+                       np.asarray(shape, np.int64))
+        ex.emit("Reshape", [ins[0], shp], [out], name)
+    elif op == "transpose":
+        axes = ptuple(attrs.get("axes"), default=())
+        a = [_attr("perm", axes)] if axes else []
+        ex.emit("Transpose", ins, [out], name, a)
+    else:
+        raise MXNetError("ONNX export: unsupported operator %r" % op)
+
+
+def export_model(sym, params, input_shape, input_type=np.float32,
+                 onnx_file_path="model.onnx", verbose=False):
+    """Export a Symbol + params to a real .onnx protobuf file.
+
+    ``params`` accepts plain names or the checkpoint's "arg:"/"aux:"
+    prefixes.  ``input_shape`` is one shape tuple or a list of them (one
+    per data input).  Returns the file path.
+    """
+    from ...ndarray.ndarray import NDArray
+
+    clean = {}
+    for k, v in params.items():
+        k = k.split(":", 1)[1] if k.startswith(("arg:", "aux:")) else k
+        clean[k] = v.asnumpy() if isinstance(v, NDArray) else np.asarray(v)
+
+    nodes = sym._topo_nodes()
+    out_names = {}
+    # ops whose extra mx outputs are training-side (mean/var, mask) and
+    # are exported as single-output ONNX nodes: references to idx > 0
+    # come from the symbol layer's output fan-out and must be dropped
+    _TRAIN_ONLY_EXTRA = {"BatchNorm", "Dropout"}
+
+    def name_of(node, idx):
+        if node.op is None:
+            return node.name
+        if idx > 0 and node.op in _TRAIN_ONLY_EXTRA:
+            return None
+        base = out_names[id(node)]
+        return base if idx == 0 else "%s_out%d" % (base, idx)
+
+    ex = _Exporter(clean)
+    data_inputs = []
+    for node in nodes:
+        if node.op is None:
+            if node.name in clean:
+                ex.add_init(node.name, clean[node.name])
+            else:
+                data_inputs.append(node.name)
+            continue
+        out_names[id(node)] = node.name
+        ins = [nm for nm in (name_of(n, i) for (n, i) in node.inputs)
+               if nm is not None]
+        _export_node(ex, node, ins, node.name)
+
+    # re-emit initializers after fix_gamma rewrites
+    inits = [_tensor(t["name"], ex.params[t["name"]])
+             if t["name"] in ex.params else t for t in ex.initializers]
+
+    shapes = [input_shape] if isinstance(input_shape[0], int) \
+        else list(input_shape)
+    if len(shapes) != len(data_inputs):
+        raise MXNetError("export_model: %d input shapes for %d data "
+                         "inputs %s" % (len(shapes), len(data_inputs),
+                                        data_inputs))
+    in_elem = {np.dtype(np.float32): P.TP_FLOAT,
+               np.dtype(np.float64): P.TP_DOUBLE,
+               np.dtype(np.int32): P.TP_INT32,
+               np.dtype(np.int64): P.TP_INT64}.get(
+                   np.dtype(input_type), P.TP_FLOAT)
+    # ONNX requires typed graph outputs: get shapes via inference
+    _, out_shapes, _ = sym.infer_shape(
+        **{n: s for n, s in zip(data_inputs, shapes)})
+    graph_outputs = [
+        _vinfo(name_of(node, i), shape)
+        for (node, i), shape in zip(sym._entries, out_shapes)]
+    graph = {
+        "name": "mxnet_tpu_exported",
+        "node": ex.nodes,
+        "initializer": inits,
+        "input": [_vinfo(n, s, in_elem)
+                  for n, s in zip(data_inputs, shapes)],
+        "output": graph_outputs,
+    }
+    model = {
+        "ir_version": 7,
+        "producer_name": "mxnet_tpu",
+        "opset_import": [{"domain": "", "version": _OPSET}],
+        "graph": graph,
+    }
+    with open(onnx_file_path, "wb") as f:
+        f.write(P.encode(model, "ModelProto"))
+    if verbose:
+        print("exported %d nodes -> %s" % (len(ex.nodes), onnx_file_path))
+    return onnx_file_path

@@ -1,0 +1,221 @@
+"""ONNX -> Symbol importer.
+
+Reference parity: ``python/mxnet/contrib/onnx/onnx2mx/import_model.py``
+(``import_model(file) -> (sym, arg_params, aux_params)`` and
+``get_model_metadata``).  Parses real .onnx protobuf via ``_proto``.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ...base import MXNetError
+from . import _proto as P
+
+__all__ = ["import_model", "get_model_metadata"]
+
+_NP_OF = {P.TP_FLOAT: np.float32, P.TP_DOUBLE: np.float64,
+          P.TP_INT32: np.int32, P.TP_INT64: np.int64,
+          P.TP_INT8: np.int8, P.TP_UINT8: np.uint8,
+          P.TP_BOOL: np.bool_}
+
+
+def _tensor_to_np(t):
+    dt = _NP_OF.get(t.get("data_type", P.TP_FLOAT), np.float32)
+    dims = t.get("dims", [])
+    if "raw_data" in t:
+        return np.frombuffer(t["raw_data"], dt).reshape(dims).copy()
+    if "float_data" in t:
+        return np.asarray(t["float_data"], np.float32).reshape(dims)
+    if "int64_data" in t:
+        return np.asarray(t["int64_data"], np.int64).reshape(dims)
+    return np.zeros(dims, dt)
+
+
+def _attrs_of(node):
+    out = {}
+    for a in node.get("attribute", []):
+        t = a.get("type")
+        if t == P.ATTR_INT:
+            out[a["name"]] = a.get("i", 0)
+        elif t == P.ATTR_FLOAT:
+            out[a["name"]] = a.get("f", 0.0)
+        elif t == P.ATTR_STRING:
+            out[a["name"]] = a.get("s", b"").decode("utf-8")
+        elif t == P.ATTR_INTS:
+            out[a["name"]] = tuple(a.get("ints", []))
+        elif t == P.ATTR_FLOATS:
+            out[a["name"]] = tuple(a.get("floats", []))
+        elif t == P.ATTR_TENSOR:
+            out[a["name"]] = _tensor_to_np(a["t"])
+    return out
+
+
+def _split_pads(pads, nd):
+    if not pads:
+        return (0,) * nd
+    begin, end = pads[:nd], pads[nd:]
+    if tuple(begin) != tuple(end):
+        raise MXNetError("asymmetric ONNX pads %s unsupported" % (pads,))
+    return tuple(begin)
+
+
+def _convert_node(S, node, ins, initializers, aux_names, consumed):
+    """Return the mx Symbol for one ONNX node."""
+    op = node["op_type"]
+    a = _attrs_of(node)
+    name = node.get("name") or node["output"][0]
+    if op == "Gemm":
+        if a.get("transA"):
+            raise MXNetError("Gemm transA unsupported")
+        w_name = node["input"][1]
+        num_hidden = initializers[w_name].shape[0] if a.get("transB") \
+            else initializers[w_name].shape[1]
+        if not a.get("transB"):
+            initializers[w_name] = np.ascontiguousarray(
+                initializers[w_name].T)
+        return S._invoke_sym("FullyConnected", ins,
+                             {"num_hidden": int(num_hidden),
+                              "no_bias": len(ins) < 3,
+                              "flatten": False}, name=name)
+    if op == "Conv":
+        kernel = a.get("kernel_shape")
+        nd = len(kernel)
+        w_name = node["input"][1]
+        return S._invoke_sym(
+            "Convolution", ins,
+            {"kernel": tuple(kernel),
+             "stride": tuple(a.get("strides", (1,) * nd)),
+             "pad": _split_pads(a.get("pads"), nd),
+             "dilate": tuple(a.get("dilations", (1,) * nd)),
+             "num_filter": int(initializers[w_name].shape[0]),
+             "num_group": int(a.get("group", 1)),
+             "no_bias": len(ins) < 3}, name=name)
+    if op in ("Relu", "Sigmoid", "Tanh", "Softplus"):
+        act = {"Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh",
+               "Softplus": "softrelu"}[op]
+        return S._invoke_sym("Activation", ins, {"act_type": act},
+                             name=name)
+    if op == "LeakyRelu":
+        return S._invoke_sym("LeakyReLU", ins,
+                             {"act_type": "leaky",
+                              "slope": float(a.get("alpha", 0.01))},
+                             name=name)
+    if op == "BatchNormalization":
+        aux_names.update(node["input"][3:5])
+        return S._invoke_sym(
+            "BatchNorm", ins,
+            {"eps": float(a.get("epsilon", 1e-5)),
+             "momentum": float(a.get("momentum", 0.9)),
+             "fix_gamma": False}, name=name)
+    if op in ("MaxPool", "AveragePool"):
+        kernel = a.get("kernel_shape")
+        nd = len(kernel)
+        return S._invoke_sym(
+            "Pooling", ins,
+            {"kernel": tuple(kernel),
+             "stride": tuple(a.get("strides", (1,) * nd)),
+             "pad": _split_pads(a.get("pads"), nd),
+             "pool_type": "max" if op == "MaxPool" else "avg"},
+            name=name)
+    if op in ("GlobalMaxPool", "GlobalAveragePool"):
+        return S._invoke_sym(
+            "Pooling", ins,
+            {"kernel": (1, 1), "global_pool": True,
+             "pool_type": "max" if op == "GlobalMaxPool" else "avg"},
+            name=name)
+    if op == "Flatten":
+        return S._invoke_sym("Flatten", ins, {}, name=name)
+    if op == "Softmax":
+        return S._invoke_sym("softmax", ins,
+                             {"axis": int(a.get("axis", -1))}, name=name)
+    if op == "LogSoftmax":
+        return S._invoke_sym("log_softmax", ins,
+                             {"axis": int(a.get("axis", -1))}, name=name)
+    if op in ("Add", "Sub", "Mul", "Div"):
+        mx_op = {"Add": "broadcast_add", "Sub": "broadcast_sub",
+                 "Mul": "broadcast_mul", "Div": "broadcast_div"}[op]
+        return S._invoke_sym(mx_op, ins, {}, name=name)
+    if op == "Concat":
+        return S._invoke_sym("Concat", ins,
+                             {"dim": int(a.get("axis", 1)),
+                              "num_args": len(ins)}, name=name)
+    if op == "Dropout":
+        return S._invoke_sym("Dropout", ins[:1], {}, name=name)
+    if op == "Reshape":
+        shape_name = node["input"][1]
+        if shape_name not in initializers:
+            raise MXNetError("dynamic Reshape shape unsupported")
+        # non-destructive: the shape tensor may feed several Reshapes
+        consumed.add(shape_name)
+        shape = tuple(int(v) for v in initializers[shape_name])
+        return S._invoke_sym("Reshape", ins[:1], {"shape": shape},
+                             name=name)
+    if op == "Transpose":
+        axes = a.get("perm")
+        attrs = {"axes": tuple(axes)} if axes else {}
+        return S._invoke_sym("transpose", ins, attrs, name=name)
+    raise MXNetError("ONNX import: unsupported operator %r" % op)
+
+
+def import_model(model_file):
+    """Parse a .onnx file -> (sym, arg_params, aux_params)."""
+    from ...ndarray.ndarray import array
+    from ...symbol import symbol as S
+
+    with open(model_file, "rb") as f:
+        model = P.decode(f.read(), "ModelProto")
+    graph = model["graph"]
+    initializers = {t["name"]: _tensor_to_np(t)
+                    for t in graph.get("initializer", [])}
+
+    value_syms = {}
+
+    def sym_of(name):
+        if name not in value_syms:
+            value_syms[name] = S.var(name)
+        return value_syms[name]
+
+    aux_names, consumed = set(), set()
+    for node in graph.get("node", []):
+        ins = [sym_of(n) for n in node.get("input", [])]
+        if node["op_type"] == "Reshape":
+            ins = ins[:1]  # shape initializer is consumed as an attr
+        out_sym = _convert_node(S, node, ins, initializers, aux_names,
+                                consumed)
+        outs = list(out_sym) if len(out_sym) > 1 else [out_sym]
+        for i, out_name in enumerate(node.get("output", [])):
+            if i < len(outs):
+                value_syms[out_name] = outs[i]
+
+    outputs = [value_syms[o["name"]] for o in graph.get("output", [])]
+    sym = S.Group(outputs) if len(outputs) > 1 else outputs[0]
+
+    arg_params, aux_params = {}, {}
+    for name, arr in initializers.items():
+        if name in consumed:
+            continue  # attr-folded (e.g. Reshape shape tensors)
+        target = aux_params if name in aux_names else arg_params
+        target[name] = array(arr.astype(np.float32)
+                             if arr.dtype == np.float64 else arr)
+    return sym, arg_params, aux_params
+
+
+def get_model_metadata(model_file):
+    """Input/output names + shapes of an .onnx file (parity:
+    onnx2mx.import_model.get_model_metadata)."""
+    with open(model_file, "rb") as f:
+        model = P.decode(f.read(), "ModelProto")
+    graph = model["graph"]
+
+    def fmt(vi):
+        tt = vi.get("type", {}).get("tensor_type", {})
+        dims = tuple(d.get("dim_value", 0)
+                     for d in tt.get("shape", {}).get("dim", []))
+        return (vi["name"], dims)
+
+    inits = {t["name"] for t in graph.get("initializer", [])}
+    return {
+        "input_tensor_data": [fmt(v) for v in graph.get("input", [])
+                              if v["name"] not in inits],
+        "output_tensor_data": [fmt(v) for v in graph.get("output", [])],
+    }

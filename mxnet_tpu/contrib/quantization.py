@@ -169,7 +169,7 @@ def _eligible_nodes(sym, excluded):
     return eligible
 
 
-def _quantize_symbol(sym, excluded):
+def _quantize_symbol(sym, eligible):
     """Graph rewrite (reference: quantize_graph_pass.cc): every eligible
     FullyConnected/Convolution becomes
 
@@ -179,7 +179,6 @@ def _quantize_symbol(sym, excluded):
     so the matmul/conv really executes in int8 on the MXU."""
     from ..symbol import symbol as S
 
-    eligible = _eligible_nodes(sym, excluded)
     memo = {}
 
     def rebuild(node):
@@ -238,9 +237,8 @@ def _emit_quantized(S, node, ins):
     return out
 
 
-def _quantized_layer_weights(sym, excluded):
+def _quantized_layer_weights(sym, eligible):
     """Map weight-param name -> quantized layer name for eligible nodes."""
-    eligible = _eligible_nodes(sym, excluded)
     out = {}
     for node in sym._topo_nodes():
         if id(node) in eligible:
@@ -266,8 +264,9 @@ def quantize_model(sym, arg_params, aux_params, data_names=("data",),
         raise MXNetError("quantized_dtype %r unsupported (int8 only)"
                          % quantized_dtype)
     excluded = set(excluded_sym_names or [])
-    qsym = _quantize_symbol(sym, excluded)
-    wmap = _quantized_layer_weights(sym, excluded)
+    eligible = _eligible_nodes(sym, excluded)
+    qsym = _quantize_symbol(sym, eligible)
+    wmap = _quantized_layer_weights(sym, eligible)
     qarg_params = {}
     for name, arr in arg_params.items():
         layer = wmap.get(name)

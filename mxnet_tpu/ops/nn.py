@@ -228,7 +228,9 @@ def softmax_cross_entropy(data, label, **kw):
 # ---------------------------------------------------------------------------
 
 
-@register("BatchNorm", num_inputs=5, num_outputs=3)
+@register("BatchNorm", num_inputs=5, num_outputs=3,
+          visible_outputs=lambda attrs: 3 if pbool(
+              attrs.get("output_mean_var")) else 1)
 def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
                momentum=0.9, fix_gamma=True, use_global_stats=False,
                output_mean_var=False, axis=1, cudnn_off=None, **kw):

@@ -44,12 +44,12 @@ class OpInfo:
 
     __slots__ = (
         "name", "fn", "num_inputs", "num_outputs", "differentiable",
-        "mutate_inputs", "doc", "aliases", "uses_rng",
+        "mutate_inputs", "doc", "aliases", "uses_rng", "visible_outputs",
     )
 
     def __init__(self, name, fn, num_inputs=1, num_outputs=1,
                  differentiable=True, mutate_inputs=(), doc=None,
-                 uses_rng=False):
+                 uses_rng=False, visible_outputs=None):
         self.name = name
         self.fn = fn
         self.num_inputs = num_inputs
@@ -59,23 +59,36 @@ class OpInfo:
         self.doc = doc or (fn.__doc__ if fn else None)
         self.aliases = []
         self.uses_rng = uses_rng  # fn draws from the framework PRNG stream
+        # reference FNumVisibleOutputs: outputs beyond this count are
+        # training-internal (BatchNorm mean/var) and hidden from symbol
+        # composition
+        self.visible_outputs = visible_outputs
 
     def n_outputs(self, attrs=None):
         if callable(self.num_outputs):
             return self.num_outputs(attrs or {})
         return self.num_outputs
 
+    def n_visible_outputs(self, attrs=None):
+        if self.visible_outputs is None:
+            return self.n_outputs(attrs)
+        if callable(self.visible_outputs):
+            return self.visible_outputs(attrs or {})
+        return self.visible_outputs
+
     def __repr__(self):
         return "OpInfo(%s)" % self.name
 
 
 def register(name, num_inputs=1, num_outputs=1, differentiable=True,
-             mutate_inputs=(), aliases=(), uses_rng=False):
+             mutate_inputs=(), aliases=(), uses_rng=False,
+             visible_outputs=None):
     """Decorator: register a jax-traceable function as an operator."""
 
     def _reg(fn):
         info = OpInfo(name, fn, num_inputs, num_outputs, differentiable,
-                      mutate_inputs, uses_rng=uses_rng)
+                      mutate_inputs, uses_rng=uses_rng,
+                      visible_outputs=visible_outputs)
         if name in _OP_REGISTRY:
             raise MXNetError("op %r already registered" % name)
         _OP_REGISTRY[name] = info

@@ -251,7 +251,11 @@ class Symbol:
     def list_outputs(self):
         out = []
         for (node, idx) in self._entries:
-            if node.num_outputs > 1:
+            if node.op is None:
+                n_vis = 1
+            else:
+                n_vis = get_op(node.op).n_visible_outputs(node.attrs)
+            if n_vis > 1:
                 out.append("%s_output%d" % (node.name, idx))
             else:
                 out.append("%s_output" % node.name)
@@ -723,7 +727,10 @@ def _invoke_sym(op_name, inputs, attrs, name=None):
 
     node = _Node(op_name, attrs, entries, name,
                  AttrScope.current().get({}))
-    n_out = node.num_outputs
+    # composition sees only visible outputs (reference FNumVisibleOutputs:
+    # BatchNorm's mean/var are internal) — the executor still receives
+    # the op fn's full output tuple
+    n_out = info.n_visible_outputs(attrs)
     return Symbol([(node, i) for i in range(n_out)]) if n_out > 1 \
         else Symbol([(node, 0)])
 

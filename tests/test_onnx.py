@@ -1,0 +1,133 @@
+"""ONNX export/import round-trip tests (modeled on the reference
+tests/python-pytest/onnx/ cases, self-contained protobuf codec)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.contrib import onnx as onnx_mxnet
+
+
+def _mlp():
+    data = mx.sym.var("data")
+    h = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu", name="relu1")
+    out = mx.sym.FullyConnected(h, num_hidden=4, name="fc2")
+    rng = np.random.RandomState(0)
+    params = {"fc1_weight": nd.array(rng.randn(16, 8).astype(np.float32)),
+              "fc1_bias": nd.array(rng.randn(16).astype(np.float32)),
+              "fc2_weight": nd.array(rng.randn(4, 16).astype(np.float32)),
+              "fc2_bias": nd.array(rng.randn(4).astype(np.float32))}
+    return out, params
+
+
+def _convnet():
+    data = mx.sym.var("data")
+    h = mx.sym.Convolution(data, num_filter=6, kernel=(3, 3), pad=(1, 1),
+                           name="c1")
+    h = mx.sym.Activation(h, act_type="relu", name="r1")
+    h = mx.sym.Pooling(h, kernel=(2, 2), stride=(2, 2), pool_type="max",
+                       name="p1")
+    h = mx.sym.Flatten(h, name="fl")
+    out = mx.sym.FullyConnected(h, num_hidden=3, name="fc")
+    rng = np.random.RandomState(1)
+    params = {"c1_weight": nd.array(rng.randn(6, 2, 3, 3)
+                                    .astype(np.float32) * 0.2),
+              "c1_bias": nd.array(np.zeros(6, np.float32)),
+              "fc_weight": nd.array(rng.randn(3, 6 * 4 * 4)
+                                    .astype(np.float32) * 0.1),
+              "fc_bias": nd.array(np.zeros(3, np.float32))}
+    return out, params
+
+
+def _run(sym, params, x):
+    ex = sym.bind(args=dict(params, data=nd.array(x)))
+    return ex.forward()[0].asnumpy()
+
+
+def test_mlp_roundtrip(tmp_path):
+    sym, params = _mlp()
+    x = np.random.RandomState(2).randn(5, 8).astype(np.float32)
+    path = str(tmp_path / "mlp.onnx")
+    onnx_mxnet.export_model(sym, params, (5, 8), onnx_file_path=path)
+
+    sym2, args2, aux2 = onnx_mxnet.import_model(path)
+    got = _run(sym2, args2, x)
+    expect = _run(sym, params, x)
+    np.testing.assert_allclose(got, expect, rtol=1e-5, atol=1e-5)
+
+
+def test_convnet_roundtrip(tmp_path):
+    sym, params = _convnet()
+    x = np.random.RandomState(3).randn(2, 2, 8, 8).astype(np.float32)
+    path = str(tmp_path / "conv.onnx")
+    onnx_mxnet.export_model(sym, params, (2, 2, 8, 8),
+                            onnx_file_path=path)
+    sym2, args2, aux2 = onnx_mxnet.import_model(path)
+    got = _run(sym2, args2, x)
+    expect = _run(sym, params, x)
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_metadata(tmp_path):
+    sym, params = _mlp()
+    path = str(tmp_path / "meta.onnx")
+    onnx_mxnet.export_model(sym, params, (5, 8), onnx_file_path=path)
+    meta = onnx_mxnet.get_model_metadata(path)
+    assert meta["input_tensor_data"] == [("data", (5, 8))]
+    assert len(meta["output_tensor_data"]) == 1
+
+
+def test_batchnorm_and_global_pool_roundtrip(tmp_path):
+    data = mx.sym.var("data")
+    h = mx.sym.Convolution(data, num_filter=4, kernel=(3, 3), no_bias=True,
+                           name="c")
+    h = mx.sym.BatchNorm(h, fix_gamma=False, name="bn")
+    h = mx.sym.Pooling(h, kernel=(1, 1), global_pool=True,
+                       pool_type="avg", name="gap")
+    out = mx.sym.Flatten(h, name="flat")
+    rng = np.random.RandomState(4)
+    params = {"c_weight": nd.array(rng.randn(4, 3, 3, 3)
+                                   .astype(np.float32) * 0.3),
+              "bn_gamma": nd.array(np.abs(rng.randn(4))
+                                   .astype(np.float32) + 0.5),
+              "bn_beta": nd.array(rng.randn(4).astype(np.float32)),
+              "bn_moving_mean": nd.array(rng.randn(4)
+                                         .astype(np.float32) * 0.1),
+              "bn_moving_var": nd.array(np.abs(rng.randn(4))
+                                        .astype(np.float32) + 1.0)}
+    x = rng.randn(2, 3, 6, 6).astype(np.float32)
+    path = str(tmp_path / "bn.onnx")
+    onnx_mxnet.export_model(sym=out, params=params,
+                            input_shape=(2, 3, 6, 6),
+                            onnx_file_path=path)
+    sym2, args2, aux2 = onnx_mxnet.import_model(path)
+    assert "bn_moving_mean" in aux2 and "bn_moving_var" in aux2
+    ex = sym2.bind(args=dict(args2, data=nd.array(x)), aux_states=aux2)
+    got = ex.forward()[0].asnumpy()
+    fex = out.bind(args=dict({k: v for k, v in params.items()
+                              if not k.startswith("bn_moving")},
+                             data=nd.array(x)),
+                   aux_states={"bn_moving_mean": params["bn_moving_mean"],
+                               "bn_moving_var": params["bn_moving_var"]})
+    expect = fex.forward()[0].asnumpy()
+    np.testing.assert_allclose(got, expect, rtol=1e-4, atol=1e-5)
+
+
+def test_wire_format_parses_with_real_onnx_if_available(tmp_path):
+    onnx = pytest.importorskip("onnx")
+    sym, params = _mlp()
+    path = str(tmp_path / "check.onnx")
+    onnx_mxnet.export_model(sym, params, (5, 8), onnx_file_path=path)
+    model = onnx.load(path)
+    onnx.checker.check_model(model)
+
+
+def test_batchnorm_output_mean_var_visible():
+    """output_mean_var=True exposes 3 outputs, like the reference."""
+    bn = mx.sym.BatchNorm(mx.sym.var("data"), output_mean_var=True,
+                          name="bnv")
+    assert len(bn) == 3
+    bn1 = mx.sym.BatchNorm(mx.sym.var("data"), name="bnv2")
+    assert len(bn1) == 1
+    assert bn1.list_outputs() == ["bnv2_output"]
