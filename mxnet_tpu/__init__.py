@@ -48,8 +48,13 @@ from .attribute import AttrScope  # noqa: F401
 from . import name  # noqa: F401
 from .name import NameManager  # noqa: F401
 from . import rtc  # noqa: F401
+from . import config  # noqa: F401
 from . import contrib  # noqa: F401
 from . import operator  # noqa: F401
 from . import util  # noqa: F401
 
 __version__ = "2.0.0.tpu1"
+
+config.warn_unknown()
+if config.get("MXNET_PROFILER_AUTOSTART"):
+    profiler.start()

@@ -1,0 +1,104 @@
+"""Central MXNET_* environment-flag registry.
+
+Reference parity: ``docs/faq/env_var.md`` — the reference scatters
+``dmlc::GetEnv`` calls through the C++ tree; here every recognized knob
+is declared once with its parser, default, and TPU-native disposition
+(honored / delegated to XLA / not applicable), and ``describe()`` prints
+the table.  Unknown ``MXNET_*`` variables in the environment trigger a
+one-time warning instead of being silently ignored.
+"""
+from __future__ import annotations
+
+import os
+import warnings
+
+__all__ = ["get", "describe", "FLAGS"]
+
+
+def _pint(v):
+    return int(v)
+
+
+def _pbool(v):
+    return str(v).lower() in ("1", "true", "yes", "on")
+
+
+# name -> (default, parser, disposition, note)
+FLAGS = {
+    "MXNET_ENGINE_TYPE": (
+        "ThreadedEnginePerDevice", str, "honored",
+        "NaiveEngine forces synchronous dispatch (race-detection oracle); "
+        "anything else keeps jax async dispatch (engine.py)"),
+    "MXNET_PROFILER_AUTOSTART": (
+        "0", _pbool, "honored", "start the jax trace at import"),
+    "MXNET_PROFILER_MODE": (
+        "0", _pint, "honored", "profiler facade config (profiler.py)"),
+    "MXNET_CPU_WORKER_NTHREADS": (
+        "1", _pint, "honored",
+        "default preprocess_threads for ImageRecordIter"),
+    "MXNET_SAFE_ACCUMULATION": (
+        "0", _pbool, "honored",
+        "accumulate fp16 reductions in fp32 (ops/tensor reductions)"),
+    "MXNET_EXEC_BULK_EXEC_INFERENCE": (
+        "1", _pbool, "delegated",
+        "operator bulking — XLA fusion always bulks whole programs"),
+    "MXNET_EXEC_BULK_EXEC_TRAIN": (
+        "1", _pbool, "delegated", "see MXNET_EXEC_BULK_EXEC_INFERENCE"),
+    "MXNET_EXEC_ENABLE_ADDTO": (
+        "0", _pbool, "delegated",
+        "gradient add-to elision — XLA does buffer donation/aliasing"),
+    "MXNET_GPU_MEM_POOL_RESERVE": (
+        "5", _pint, "delegated",
+        "memory pooling is the XLA allocator's job on TPU"),
+    "MXNET_GPU_WORKER_NTHREADS": (
+        "2", _pint, "n/a", "no CUDA worker threads on TPU"),
+    "MXNET_CUDNN_AUTOTUNE_DEFAULT": (
+        "1", _pint, "n/a", "no cuDNN on TPU; XLA autotunes convolutions"),
+    "MXNET_KVSTORE_REDUCTION_NTHREADS": (
+        "4", _pint, "delegated",
+        "reduction happens in one jitted program / ICI collective"),
+    "MXNET_KVSTORE_BIGARRAY_BOUND": (
+        "1000000", _pint, "honored", "kvstore sharding threshold"),
+    "MXNET_ENABLE_GPU_P2P": ("1", _pbool, "n/a", "ICI replaces P2P"),
+    "MXNET_UPDATE_ON_KVSTORE": (
+        "1", _pbool, "honored", "Module/Trainer update placement"),
+    "DMLC_ROLE": ("worker", str, "honored", "dist kvstore role"),
+    "DMLC_PS_ROOT_URI": ("", str, "honored", "dist kvstore server host"),
+    "DMLC_PS_ROOT_PORT": ("0", _pint, "honored",
+                          "dist kvstore server port"),
+    "DMLC_NUM_WORKER": ("1", _pint, "honored", "dist worker count"),
+    "DMLC_NUM_SERVER": ("1", _pint, "honored", "dist server count"),
+}
+
+_warned = set()
+
+
+def get(name):
+    """Parsed value of a registered flag (env overrides default)."""
+    default, parser, _disp, _note = FLAGS[name]
+    raw = os.environ.get(name, default)
+    try:
+        return parser(raw)
+    except (TypeError, ValueError):
+        if name not in _warned:
+            _warned.add(name)
+            warnings.warn("invalid value %r for %s; using default %r"
+                          % (raw, name, default))
+        return parser(default)
+
+
+def warn_unknown():
+    """One-time warning for unrecognized MXNET_* environment variables."""
+    for name in os.environ:
+        if name.startswith("MXNET_") and name not in FLAGS and \
+                name not in _warned:
+            _warned.add(name)
+            warnings.warn("environment variable %s is not recognized by "
+                          "mxnet_tpu (see mxnet_tpu.config.FLAGS)" % name)
+
+
+def describe():
+    """Human-readable flag table (reference env_var.md equivalent)."""
+    rows = ["%-36s %-9s default=%-10s %s" % (n, d[2], d[0], d[3])
+            for n, d in sorted(FLAGS.items())]
+    return "\n".join(rows)

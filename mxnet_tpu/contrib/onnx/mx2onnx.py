@@ -124,8 +124,20 @@ def _export_node(ex, node, ins, out):
                "softrelu": "Softplus"}[attrs.get("act_type", "relu")]
         ex.emit(act, ins, [out], name)
     elif op == "LeakyReLU":
-        ex.emit("LeakyRelu", ins[:1], [out], name,
-                [_attr("alpha", pfloat(attrs.get("slope"), 0.25))])
+        kind = attrs.get("act_type", "leaky")
+        if kind == "leaky":
+            ex.emit("LeakyRelu", ins[:1], [out], name,
+                    [_attr("alpha", pfloat(attrs.get("slope"), 0.25))])
+        elif kind == "elu":
+            ex.emit("Elu", ins[:1], [out], name,
+                    [_attr("alpha", pfloat(attrs.get("slope"), 0.25))])
+        elif kind == "selu":
+            ex.emit("Selu", ins[:1], [out], name)
+        elif kind == "gelu":
+            ex.emit("Gelu", ins[:1], [out], name)
+        else:
+            raise MXNetError("ONNX export: LeakyReLU act_type %r "
+                             "unsupported" % kind)
     elif op == "BatchNorm":
         eps = pfloat(attrs.get("eps"), 1e-3)
         mom = pfloat(attrs.get("momentum"), 0.9)
@@ -141,21 +153,32 @@ def _export_node(ex, node, ins, out):
             ex.emit("GlobalMaxPool" if kind == "max" else
                     "GlobalAveragePool", ins, [out], name)
         else:
+            if attrs.get("pooling_convention", "valid") == "full":
+                raise MXNetError("ONNX export: pooling_convention='full' "
+                                 "has no ONNX equivalent")
             kernel = ptuple(attrs.get("kernel"))
             nd = len(kernel)
             stride = ptuple(attrs.get("stride"), ndim=nd,
                             default=(1,) * nd)
             pad = ptuple(attrs.get("pad"), ndim=nd, default=(0,) * nd)
+            pool_attrs = [_attr("kernel_shape", kernel),
+                          _attr("strides", stride),
+                          _attr("pads", pad + pad)]
+            if kind != "max":
+                # mx defaults count_include_pad=True; ONNX defaults 0
+                pool_attrs.append(_attr(
+                    "count_include_pad",
+                    1 if pbool(attrs.get("count_include_pad"), True)
+                    else 0))
             ex.emit("MaxPool" if kind == "max" else "AveragePool", ins,
-                    [out], name,
-                    [_attr("kernel_shape", kernel),
-                     _attr("strides", stride),
-                     _attr("pads", pad + pad)])
+                    [out], name, pool_attrs)
     elif op == "Flatten":
         ex.emit("Flatten", ins, [out], name, [_attr("axis", 1)])
     elif op in ("softmax", "SoftmaxOutput", "log_softmax"):
         onnx_op = "LogSoftmax" if op == "log_softmax" else "Softmax"
-        axis = pint(attrs.get("axis"), -1 if op == "softmax" else 1)
+        # softmax/log_softmax default to the last axis; SoftmaxOutput
+        # normalizes over the class axis (1)
+        axis = pint(attrs.get("axis"), 1 if op == "SoftmaxOutput" else -1)
         ex.emit(onnx_op, ins[:1], [out], name, [_attr("axis", axis)])
     elif op in ("elemwise_add", "_plus", "broadcast_add"):
         ex.emit("Add", ins, [out], name)
@@ -245,9 +268,15 @@ def export_model(sym, params, input_shape, input_type=np.float32,
     # ONNX requires typed graph outputs: get shapes via inference
     _, out_shapes, _ = sym.infer_shape(
         **{n: s for n, s in zip(data_inputs, shapes)})
-    graph_outputs = [
-        _vinfo(name_of(node, i), shape)
-        for (node, i), shape in zip(sym._entries, out_shapes)]
+    graph_outputs = []
+    for (node, i), shape in zip(sym._entries, out_shapes):
+        out_name = name_of(node, i)
+        if out_name is None:
+            raise MXNetError(
+                "ONNX export: graph output %d is a training-internal "
+                "extra output of %s (%s); export the primary output "
+                "only" % (i, node.op, node.name))
+        graph_outputs.append(_vinfo(out_name, shape))
     graph = {
         "name": "mxnet_tpu_exported",
         "node": ex.nodes,

@@ -67,6 +67,10 @@ def _convert_node(S, node, ins, initializers, aux_names, consumed):
     if op == "Gemm":
         if a.get("transA"):
             raise MXNetError("Gemm transA unsupported")
+        if a.get("alpha", 1.0) != 1.0 or \
+                (len(ins) > 2 and a.get("beta", 1.0) != 1.0):
+            raise MXNetError("Gemm alpha/beta scaling unsupported "
+                             "(fold them into the weights/bias)")
         w_name = node["input"][1]
         num_hidden = initializers[w_name].shape[0] if a.get("transB") \
             else initializers[w_name].shape[1]
@@ -100,6 +104,12 @@ def _convert_node(S, node, ins, initializers, aux_names, consumed):
                              {"act_type": "leaky",
                               "slope": float(a.get("alpha", 0.01))},
                              name=name)
+    if op in ("Elu", "Selu", "Gelu"):
+        kind = {"Elu": "elu", "Selu": "selu", "Gelu": "gelu"}[op]
+        attrs = {"act_type": kind}
+        if op == "Elu":
+            attrs["slope"] = float(a.get("alpha", 1.0))
+        return S._invoke_sym("LeakyReLU", ins, attrs, name=name)
     if op == "BatchNormalization":
         aux_names.update(node["input"][3:5])
         return S._invoke_sym(
@@ -110,13 +120,15 @@ def _convert_node(S, node, ins, initializers, aux_names, consumed):
     if op in ("MaxPool", "AveragePool"):
         kernel = a.get("kernel_shape")
         nd = len(kernel)
-        return S._invoke_sym(
-            "Pooling", ins,
-            {"kernel": tuple(kernel),
-             "stride": tuple(a.get("strides", (1,) * nd)),
-             "pad": _split_pads(a.get("pads"), nd),
-             "pool_type": "max" if op == "MaxPool" else "avg"},
-            name=name)
+        attrs = {"kernel": tuple(kernel),
+                 "stride": tuple(a.get("strides", (1,) * nd)),
+                 "pad": _split_pads(a.get("pads"), nd),
+                 "pool_type": "max" if op == "MaxPool" else "avg"}
+        if op == "AveragePool":
+            # ONNX defaults count_include_pad=0; mx defaults True
+            attrs["count_include_pad"] = bool(
+                a.get("count_include_pad", 0))
+        return S._invoke_sym("Pooling", ins, attrs, name=name)
     if op in ("GlobalMaxPool", "GlobalAveragePool"):
         return S._invoke_sym(
             "Pooling", ins,

@@ -33,7 +33,8 @@ class Engine:
     _lock = threading.Lock()
 
     def __init__(self):
-        self._engine_type = os.environ.get("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice")
+        from . import config as _config
+        self._engine_type = _config.get("MXNET_ENGINE_TYPE")
         self._bulk_size = 0
         self._deferred_exc = []
         self._exc_lock = threading.Lock()

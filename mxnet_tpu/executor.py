@@ -125,7 +125,13 @@ class Executor:
             self._pending = (values, rng)
             self._outputs = None
         else:
-            outs, aux = self._jit_fwd_infer(values, rng)
+            try:
+                outs, aux = self._jit_fwd_infer(values, rng)
+            except MXNetError:
+                raise
+            except Exception as e:
+                # parity: graph-execution failures surface as MXNetError
+                raise MXNetError("error executing graph: %s" % e) from e
             self._outputs = [NDArray(o, self._ctx) for o in outs]
             self._pending = None
         if self.monitor_callback is not None:

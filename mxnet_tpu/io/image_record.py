@@ -44,7 +44,7 @@ class ImageRecordIter(DataIter):
 
     def __init__(self, path_imgrec, data_shape, batch_size,
                  path_imgidx=None, label_width=1, shuffle=False,
-                 part_index=0, num_parts=1, preprocess_threads=4,
+                 part_index=0, num_parts=1, preprocess_threads=None,
                  prefetch_buffer=4, resize=-1, rand_crop=False,
                  rand_mirror=False, mean_r=0.0, mean_g=0.0, mean_b=0.0,
                  std_r=1.0, std_g=1.0, std_b=1.0, scale=1.0, seed=0,
@@ -70,6 +70,15 @@ class ImageRecordIter(DataIter):
         self._data_name = data_name
         self._label_name = label_name
 
+        if preprocess_threads is None:
+            import os as _os
+
+            from .. import config as _config
+
+            # reference default is 4; the env flag overrides when set
+            preprocess_threads = (
+                _config.get("MXNET_CPU_WORKER_NTHREADS")
+                if "MXNET_CPU_WORKER_NTHREADS" in _os.environ else 4)
         self._positions = self._index_positions(part_index, num_parts)
         if not self._positions:
             raise MXNetError("shard %d/%d of %s holds no records"

@@ -32,6 +32,14 @@ __all__ = ["NDArray", "array", "zeros", "ones", "empty", "full", "arange",
            "load", "sparse", "csr_matrix", "row_sparse_array"] + _list_ops()
 
 
+from ..ops.utils import scalar_or_array as _soa  # noqa: E402
+
+maximum = _soa(NDArray, _invoke_nd, "broadcast_maximum", "_maximum_scalar")
+minimum = _soa(NDArray, _invoke_nd, "broadcast_minimum", "_minimum_scalar")
+hypot = _soa(NDArray, _invoke_nd, "broadcast_hypot", "_hypot_scalar")
+__all__ += ["maximum", "minimum", "hypot"]
+
+
 def __getattr__(name):
     # lazy alias: mx.nd.contrib -> mx.contrib.ndarray (avoids import cycle)
     if name == "contrib":

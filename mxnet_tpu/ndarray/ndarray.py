@@ -18,9 +18,12 @@ import inspect
 
 import numpy as np
 
+from time import perf_counter as _perf_counter
+
 from ..base import MXNetError, dtype_np_to_str, dtype_str_to_np
 from ..context import Context, current_context, cpu
 from .. import engine as _engine
+from .. import profiler as _profiler
 from ..ops.registry import get_op, clean_attrs
 
 __all__ = ["NDArray", "array", "zeros", "ones", "empty", "full", "arange",
@@ -704,7 +707,12 @@ def _invoke_nd(op_name, inputs, attrs, out=None):
         rng = _random.next_key()
 
     try:
-        result = _eager_apply(info, raw, attrs, rng=rng)
+        if _profiler.aggregate_enabled():
+            _t0 = _perf_counter()
+            result = _eager_apply(info, raw, attrs, rng=rng)
+            _profiler.record_op_time(info.name, _perf_counter() - _t0)
+        else:
+            result = _eager_apply(info, raw, attrs, rng=rng)
     except Exception as e:
         raise MXNetError("error in operator %s: %s" % (op_name, e)) from e
 

@@ -86,3 +86,22 @@ def normalize_axis(axis, ndim):
     if axis < 0:
         axis += ndim
     return axis
+
+
+def scalar_or_array(array_type, invoke, broadcast_op, scalar_op):
+    """Build a reference-style maximum/minimum/hypot dispatcher:
+    array-array -> the broadcast op, array-scalar -> the scalar op.
+    Shared by the nd and sym namespaces (commutative ops only)."""
+
+    def fn(lhs, rhs):
+        if isinstance(lhs, array_type) and isinstance(rhs, array_type):
+            return invoke(broadcast_op, [lhs, rhs], {})
+        if isinstance(lhs, array_type):
+            return invoke(scalar_op, [lhs], {"scalar": float(rhs)})
+        if isinstance(rhs, array_type):
+            return invoke(scalar_op, [rhs], {"scalar": float(lhs)})
+        raise TypeError("need at least one %s argument"
+                        % array_type.__name__)
+
+    fn.__name__ = broadcast_op.replace("broadcast_", "")
+    return fn

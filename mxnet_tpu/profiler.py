@@ -22,6 +22,7 @@ _config = {"profile_all": False, "filename": "profile.json",
            "aggregate_stats": False}
 _state = {"running": False, "dir": None}
 _records = []
+_op_stats = {}  # name -> [total_s, count, min_s, max_s]
 
 
 def set_config(**kwargs):
@@ -79,16 +80,44 @@ def dump(finished=True, profile_process="worker"):
         stop()
 
 
+def aggregate_enabled():
+    """True when per-op aggregate stats collection is on."""
+    return bool(_config.get("aggregate_stats"))
+
+
+def record_op_time(name, dur_s):
+    """Called by the NDArray dispatch layer per op when aggregation is
+    enabled.  O(#op-names) running counters, like the reference's
+    aggregate_stats.cc — not an unbounded event log."""
+    st = _op_stats.get(name)
+    if st is None:
+        _op_stats[name] = [dur_s, 1, dur_s, dur_s]
+    else:
+        st[0] += dur_s
+        st[1] += 1
+        if dur_s < st[2]:
+            st[2] = dur_s
+        if dur_s > st[3]:
+            st[3] = dur_s
+
+
 def dumps(reset=False):
-    out = ["Profile Statistics:"]
-    agg = {}
-    for name, dur in _records:
-        tot, cnt = agg.get(name, (0.0, 0))
-        agg[name] = (tot + dur, cnt + 1)
-    for name, (tot, cnt) in sorted(agg.items()):
-        out.append("%-40s calls=%d total_ms=%.3f" % (name, cnt, tot * 1e3))
+    """Aggregate per-op statistics (reference aggregate_stats.cc table:
+    name, count, total/min/max/avg ms)."""
+    agg = dict(_op_stats)
+    for name, dur in _records:   # scope timers (Task/Event/Frame)
+        tot, cnt, mn, mx = agg.get(name, (0.0, 0, float("inf"), 0.0))
+        agg[name] = [tot + dur, cnt + 1, min(mn, dur), max(mx, dur)]
+    out = ["Profile Statistics:",
+           "%-32s %10s %12s %12s %12s %12s" % (
+               "Name", "Calls", "Total(ms)", "Min(ms)", "Max(ms)",
+               "Avg(ms)")]
+    for name, (tot, cnt, mn, mx) in sorted(agg.items()):
+        out.append("%-32s %10d %12.4f %12.4f %12.4f %12.4f" % (
+            name, cnt, tot * 1e3, mn * 1e3, mx * 1e3, tot / cnt * 1e3))
     if reset:
         _records.clear()
+        _op_stats.clear()
     return "\n".join(out)
 
 

@@ -9,6 +9,13 @@ from ..operator import make_sym_custom as _make_sym_custom  # noqa: E402
 Custom = _make_sym_custom()
 
 
+from ..ops.utils import scalar_or_array as _soa  # noqa: E402
+
+maximum = _soa(Symbol, _invoke_sym, "broadcast_maximum", "_maximum_scalar")
+minimum = _soa(Symbol, _invoke_sym, "broadcast_minimum", "_minimum_scalar")
+hypot = _soa(Symbol, _invoke_sym, "broadcast_hypot", "_hypot_scalar")
+
+
 def __getattr__(name):
     # lazy alias: mx.sym.contrib -> mx.contrib.symbol (avoids import cycle)
     if name == "contrib":

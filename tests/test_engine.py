@@ -48,3 +48,43 @@ def test_wait_for_var():
     y = nd.dot(x, x)
     y.wait_to_read()
     assert y.shape == (1000, 1000)
+
+
+def test_config_registry():
+    import warnings
+    import mxnet_tpu as mx
+
+    assert mx.config.get("MXNET_ENGINE_TYPE") == "ThreadedEnginePerDevice"
+    assert isinstance(mx.config.get("MXNET_CPU_WORKER_NTHREADS"), int)
+    table = mx.config.describe()
+    assert "MXNET_ENGINE_TYPE" in table and "honored" in table
+    import os
+    os.environ["MXNET_TOTALLY_UNKNOWN_FLAG"] = "1"
+    try:
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            mx.config._warned.discard("MXNET_TOTALLY_UNKNOWN_FLAG")
+            mx.config.warn_unknown()
+        assert any("MXNET_TOTALLY_UNKNOWN_FLAG" in str(x.message)
+                   for x in w)
+    finally:
+        del os.environ["MXNET_TOTALLY_UNKNOWN_FLAG"]
+
+
+def test_profiler_aggregate_stats():
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, profiler
+
+    profiler.set_config(aggregate_stats=True)
+    try:
+        x = nd.array(np.random.rand(8, 8).astype(np.float32))
+        for _ in range(3):
+            (x * 2 + 1).sum().asnumpy()
+        text = profiler.dumps(reset=True)
+        assert "Profile Statistics" in text
+        assert "Calls" in text and "Avg(ms)" in text
+        # the dispatched ops show up with real counts
+        assert "_mul_scalar" in text
+    finally:
+        profiler.set_config(aggregate_stats=False)

