@@ -32,13 +32,14 @@ FLAGS = {
     "MXNET_PROFILER_AUTOSTART": (
         "0", _pbool, "honored", "start the jax trace at import"),
     "MXNET_PROFILER_MODE": (
-        "0", _pint, "honored", "profiler facade config (profiler.py)"),
+        "0", _pint, "declared", "recognized; facade config is set via "
+        "profiler.set_config"),
     "MXNET_CPU_WORKER_NTHREADS": (
-        "1", _pint, "honored",
+        "4", _pint, "honored",
         "default preprocess_threads for ImageRecordIter"),
     "MXNET_SAFE_ACCUMULATION": (
         "0", _pbool, "honored",
-        "accumulate fp16 reductions in fp32 (ops/tensor reductions)"),
+        "accumulate fp16 sum/mean/norm in fp32 (ops/tensor.py)"),
     "MXNET_EXEC_BULK_EXEC_INFERENCE": (
         "1", _pbool, "delegated",
         "operator bulking — XLA fusion always bulks whole programs"),
@@ -58,14 +59,17 @@ FLAGS = {
         "4", _pint, "delegated",
         "reduction happens in one jitted program / ICI collective"),
     "MXNET_KVSTORE_BIGARRAY_BOUND": (
-        "1000000", _pint, "honored", "kvstore sharding threshold"),
+        "1000000", _pint, "declared",
+        "recognized; the TCP PS does not shard big arrays"),
     "MXNET_ENABLE_GPU_P2P": ("1", _pbool, "n/a", "ICI replaces P2P"),
     "MXNET_UPDATE_ON_KVSTORE": (
         "1", _pbool, "honored", "Module/Trainer update placement"),
     "DMLC_ROLE": ("worker", str, "honored", "dist kvstore role"),
     "DMLC_PS_ROOT_URI": ("", str, "honored", "dist kvstore server host"),
-    "DMLC_PS_ROOT_PORT": ("0", _pint, "honored",
+    "DMLC_PS_ROOT_PORT": ("9091", _pint, "honored",
                           "dist kvstore server port"),
+    "DMLC_WORKER_RANK": ("0", _pint, "honored", "dist worker rank"),
+    "DMLC_RANK": ("0", _pint, "honored", "dist rank (fallback name)"),
     "DMLC_NUM_WORKER": ("1", _pint, "honored", "dist worker count"),
     "DMLC_NUM_SERVER": ("1", _pint, "honored", "dist server count"),
 }

@@ -133,11 +133,10 @@ def _export_node(ex, node, ins, out):
                     [_attr("alpha", pfloat(attrs.get("slope"), 0.25))])
         elif kind == "selu":
             ex.emit("Selu", ins[:1], [out], name)
-        elif kind == "gelu":
-            ex.emit("Gelu", ins[:1], [out], name)
         else:
-            raise MXNetError("ONNX export: LeakyReLU act_type %r "
-                             "unsupported" % kind)
+            # Gelu only exists from opset 20; prelu needs a second input
+            raise MXNetError("ONNX export: LeakyReLU act_type %r is not "
+                             "expressible at opset %d" % (kind, _OPSET))
     elif op == "BatchNorm":
         eps = pfloat(attrs.get("eps"), 1e-3)
         mom = pfloat(attrs.get("momentum"), 0.9)

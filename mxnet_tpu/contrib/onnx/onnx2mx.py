@@ -105,6 +105,9 @@ def _convert_node(S, node, ins, initializers, aux_names, consumed):
                               "slope": float(a.get("alpha", 0.01))},
                              name=name)
     if op in ("Elu", "Selu", "Gelu"):
+        if op == "Gelu" and a.get("approximate", "none") == "tanh":
+            raise MXNetError("Gelu approximate='tanh' unsupported "
+                             "(erf-based gelu only)")
         kind = {"Elu": "elu", "Selu": "selu", "Gelu": "gelu"}[op]
         attrs = {"act_type": kind}
         if op == "Elu":

@@ -71,14 +71,9 @@ class ImageRecordIter(DataIter):
         self._label_name = label_name
 
         if preprocess_threads is None:
-            import os as _os
-
             from .. import config as _config
 
-            # reference default is 4; the env flag overrides when set
-            preprocess_threads = (
-                _config.get("MXNET_CPU_WORKER_NTHREADS")
-                if "MXNET_CPU_WORKER_NTHREADS" in _os.environ else 4)
+            preprocess_threads = _config.get("MXNET_CPU_WORKER_NTHREADS")
         self._positions = self._index_positions(part_index, num_parts)
         if not self._positions:
             raise MXNetError("shard %d/%d of %s holds no records"

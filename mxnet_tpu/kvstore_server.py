@@ -169,11 +169,13 @@ class WorkerClient:
 
     @classmethod
     def from_env(cls):
+        from . import config as _config
+
         host = os.environ["DMLC_PS_ROOT_URI"]
-        port = int(os.environ.get("DMLC_PS_ROOT_PORT", "9091"))
+        port = _config.get("DMLC_PS_ROOT_PORT")
         rank = int(os.environ.get("DMLC_WORKER_RANK",
                                   os.environ.get("DMLC_RANK", "0")))
-        num_workers = int(os.environ.get("DMLC_NUM_WORKER", "1"))
+        num_workers = _config.get("DMLC_NUM_WORKER")
         return cls(host, port, rank, num_workers)
 
     def _rpc(self, **msg):
@@ -207,9 +209,11 @@ class WorkerClient:
 
 
 def _init_params():
+    from . import config as _config
+
     return (os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
-            int(os.environ.get("DMLC_PS_ROOT_PORT", "9091")),
-            int(os.environ.get("DMLC_NUM_WORKER", "1")))
+            _config.get("DMLC_PS_ROOT_PORT"),
+            _config.get("DMLC_NUM_WORKER"))
 
 
 def run_server(sync_mode=None):

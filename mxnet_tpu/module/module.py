@@ -275,8 +275,11 @@ class Module(BaseModule):
         if self._params_dirty:
             self._sync_params_from_devices()
 
+        from .. import config as _config
+
         store = kvs.create(kvstore) if isinstance(kvstore, str) else kvstore
-        update_on_kvstore = bool(store) and store.type.startswith("dist")
+        update_on_kvstore = bool(store) and store.type.startswith("dist") \
+            and _config.get("MXNET_UPDATE_ON_KVSTORE")
         rescale = 1.0 / self._effective_batch_size(store)
         self._optimizer = self._build_optimizer(optimizer, optimizer_params,
                                                 rescale)

@@ -9,6 +9,7 @@ from ..ops import optimizer_ops as _ops_opt  # noqa: F401
 from ..ops import contrib_ops as _ops_contrib  # noqa: F401
 from ..ops import control_flow as _ops_cf  # noqa: F401
 from ..ops import ssd_ops as _ops_ssd  # noqa: F401
+from ..ops import extended as _ops_ext  # noqa: F401
 
 from .ndarray import (  # noqa: F401
     NDArray, array, zeros, ones, empty, full, arange, concatenate, concat,

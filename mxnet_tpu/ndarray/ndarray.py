@@ -708,8 +708,13 @@ def _invoke_nd(op_name, inputs, attrs, out=None):
 
     try:
         if _profiler.aggregate_enabled():
+            import jax as _jax
+
             _t0 = _perf_counter()
             result = _eager_apply(info, raw, attrs, rng=rng)
+            # async dispatch returns futures: block so the timing covers
+            # device execution, not just dispatch
+            _jax.block_until_ready(result)
             _profiler.record_op_time(info.name, _perf_counter() - _t0)
         else:
             result = _eager_apply(info, raw, attrs, rng=rng)

@@ -1,0 +1,710 @@
+"""Extended operator coverage: the remaining reference op families.
+
+Reference parity targets (all under /root/reference/src/operator/):
+- elemwise (non-broadcast) binary variants: elemwise_op_extended.cc
+- tensor utilities: ravel.cc, histogram.cc, square_sum*, matrix_op.cc
+  (_split_v2, _slice_assign, reshape_like)
+- training heads: make_loss.cc, svm_output.cc, regression_output.cc kin
+- spatial: bilinear_sampler.cc, grid_generator.cc,
+  spatial_transformer.cc, crop.cc, contrib/adaptive_avg_pooling.cc
+- contrib: fft.cc / ifft, gradient_multiplier_op.cc, boolean_mask.cc,
+  bipartite_matching.cc, multi_proposal.cc
+- multi-tensor optimizers: optimizer_op.cc (multi_sgd_*, mp_adamw)
+- per-row sampling: random/sample_op.cc (_sample_*) and *_like
+
+Everything is one jnp/lax expression per op unless the reference
+semantics are inherently sequential (bipartite matching: host op).
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import random as _random
+from .registry import alias, register
+from .utils import (normalize_axis, paxis, pbool, pdtype, pfloat, pint,
+                    ptuple)
+
+# ---------------------------------------------------------------------------
+# elemwise (same-shape) variants — jnp broadcasts anyway, so the
+# broadcast kernels serve both spellings
+# ---------------------------------------------------------------------------
+for _b, _e in [("broadcast_equal", "_equal"),
+               ("broadcast_not_equal", "_not_equal"),
+               ("broadcast_greater", "_greater"),
+               ("broadcast_greater_equal", "_greater_equal"),
+               ("broadcast_lesser", "_lesser"),
+               ("broadcast_lesser_equal", "_lesser_equal"),
+               ("broadcast_logical_and", "_logical_and"),
+               ("broadcast_logical_or", "_logical_or"),
+               ("broadcast_logical_xor", "_logical_xor"),
+               ("broadcast_maximum", "_maximum"),
+               ("broadcast_minimum", "_minimum"),
+               ("broadcast_mod", "_mod"),
+               ("broadcast_power", "_power"),
+               ("broadcast_hypot", "_hypot"),
+               ("elemwise_add", "_grad_add")]:
+    try:
+        alias(_b, _e)
+    except KeyError:
+        pass
+
+
+@register("add_n", num_inputs=-1, aliases=("ElementWiseSum",))
+def _add_n(*arrays, num_args=None, **kw):
+    out = arrays[0]
+    for a in arrays[1:]:
+        out = out + a
+    return out
+
+
+@register("round")
+def _round(data, **kw):
+    return jnp.round(data)
+
+
+@register("reshape_like", num_inputs=2)
+def _reshape_like(lhs, rhs, **kw):
+    return lhs.reshape(rhs.shape)
+
+
+@register("_identity_with_attr_like_rhs", num_inputs=2)
+def _identity_like_rhs(lhs, rhs, **kw):
+    return lhs
+
+
+@register("_zeros_without_dtype", num_inputs=0, differentiable=False)
+def _zeros_without_dtype(shape=None, ctx=None, dtype=None, **kw):
+    return jnp.zeros(ptuple(shape, default=()),
+                     pdtype(dtype) if dtype is not None else jnp.float32)
+
+
+@register("_histogram", num_inputs=-1, num_outputs=2,
+          differentiable=False)
+def _histogram(data, *maybe_bins, bin_cnt=None, range=None, **kw):
+    if maybe_bins:
+        edges = maybe_bins[0]
+        counts = jnp.histogram(data.reshape(-1), bins=edges)[0]
+        return counts.astype(jnp.int64), edges
+    cnt = pint(bin_cnt, 10)
+    lo, hi = ptuple(range, default=(0, 1))[:2] if range is not None \
+        else (jnp.min(data), jnp.max(data))
+    counts, edges = jnp.histogram(data.reshape(-1), bins=cnt,
+                                  range=(lo, hi))
+    return counts.astype(jnp.int64), edges
+
+
+@register("_ravel_multi_index", differentiable=False)
+def _ravel_multi_index(data, shape=None, **kw):
+    dims = ptuple(shape)
+    strides = np.concatenate([np.cumprod(dims[::-1])[::-1][1:], [1]])
+    return jnp.sum(data * jnp.asarray(strides)[:, None], axis=0) \
+        .astype(data.dtype)
+
+
+@register("_unravel_index", differentiable=False)
+def _unravel_index(data, shape=None, **kw):
+    dims = ptuple(shape)
+    out = jnp.stack(jnp.unravel_index(data.astype(jnp.int32), dims))
+    return out.astype(data.dtype)
+
+
+@register("_square_sum")
+def _square_sum(data, axis=None, keepdims=False, **kw):
+    return jnp.sum(jnp.square(data), axis=paxis(axis),
+                   keepdims=pbool(keepdims))
+
+
+@register("_split_v2", num_outputs=lambda attrs: (
+    pint(attrs.get("sections"), 0) or
+    len(ptuple(attrs.get("indices"), default=())) + 1))
+def _split_v2(data, indices=None, axis=0, squeeze_axis=False, sections=0,
+              **kw):
+    ax = normalize_axis(pint(axis, 0), data.ndim)
+    sections = pint(sections, 0)
+    if sections:
+        parts = jnp.split(data, sections, axis=ax)
+    else:
+        parts = jnp.split(data, list(ptuple(indices, default=())), axis=ax)
+    if pbool(squeeze_axis):
+        parts = [jnp.squeeze(p, axis=ax) for p in parts]
+    return tuple(parts) if len(parts) > 1 else parts[0]
+
+
+@register("_slice_assign", num_inputs=2)
+def _slice_assign(data, value, begin=None, end=None, step=None, **kw):
+    idx = _slice_tuple(data, begin, end, step)
+    return data.at[idx].set(value)
+
+
+@register("_slice_assign_scalar")
+def _slice_assign_scalar(data, scalar=0.0, begin=None, end=None,
+                         step=None, **kw):
+    idx = _slice_tuple(data, begin, end, step)
+    return data.at[idx].set(pfloat(scalar, 0.0))
+
+
+def _slice_tuple(data, begin, end, step):
+    b = ptuple(begin, default=())
+    e = ptuple(end, default=())
+    s = ptuple(step, default=()) or (1,) * len(b)
+    return tuple(slice(bb if bb is not None else None,
+                       ee if ee is not None else None, ss or 1)
+                 for bb, ee, ss in zip(b, e, s))
+
+
+@register("cast_storage")
+def _cast_storage_op(data, stype="default", **kw):
+    return data  # storage casting is an NDArray-layer concept on TPU
+
+
+# ---------------------------------------------------------------------------
+# training heads (make_loss.cc, svm_output.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("MakeLoss")
+def _make_loss(data, grad_scale=1.0, valid_thresh=0.0,
+               normalization="null", **kw):
+    """Identity forward; backward seeds grad_scale (custom_vjp)."""
+    scale = pfloat(grad_scale, 1.0)
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    f.defvjp(lambda x: (x, None),
+             lambda _, g: (g * scale,))
+    return f(data)
+
+
+@register("_contrib_gradientmultiplier")
+def _gradient_multiplier(data, scalar=1.0, **kw):
+    s = pfloat(scalar, 1.0)
+
+    @jax.custom_vjp
+    def f(x):
+        return x
+
+    f.defvjp(lambda x: (x, None), lambda _, g: (g * s,))
+    return f(data)
+
+
+@register("SVMOutput", num_inputs=2)
+def _svm_output(data, label, margin=1.0, regularization_coefficient=1.0,
+                use_linear=False, **kw):
+    """Forward is identity (scores); the hinge loss drives backward."""
+    m = pfloat(margin, 1.0)
+    reg = pfloat(regularization_coefficient, 1.0)
+    linear = pbool(use_linear)
+
+    @jax.custom_vjp
+    def f(x, y):
+        return x
+
+    def fwd(x, y):
+        return x, (x, y)
+
+    def bwd(saved, g):
+        # loss head: gradient comes from the labels, out_grad is ignored
+        # (reference svm_output.cc behavior, like SoftmaxOutput)
+        x, y = saved
+        yi = y.astype(jnp.int32)
+        target = jax.nn.one_hot(yi, x.shape[1], dtype=x.dtype) * 2 - 1
+        viol = (m - target * x) > 0
+        if linear:
+            gx = jnp.where(viol, -target * reg, jnp.zeros_like(x))
+        else:
+            gx = jnp.where(viol, -2 * (m - target * x) * target * reg,
+                           jnp.zeros_like(x))
+        return gx, None
+
+    f.defvjp(fwd, bwd)
+    return f(data, label)
+
+
+@register("IdentityAttachKLSparseReg")
+def _identity_kl_sparse(data, sparseness_target=0.1, penalty=0.001,
+                        momentum=0.9, **kw):
+    return data  # regularization gradient is a training-time side input
+
+
+# ---------------------------------------------------------------------------
+# spatial ops (bilinear_sampler.cc, grid_generator.cc,
+# spatial_transformer.cc, crop.cc, contrib/adaptive_avg_pooling.cc)
+# ---------------------------------------------------------------------------
+
+
+def _bilinear_gather(data, gx, gy):
+    """Sample data (N,C,H,W) at fractional pixel coords gx/gy (N,Ho,Wo);
+    zero padding outside (the reference's border behavior for sampling
+    grids is zero-fill)."""
+    N, C, H, W = data.shape
+    x0 = jnp.floor(gx)
+    y0 = jnp.floor(gy)
+    wx = gx - x0
+    wy = gy - y0
+
+    def tap(xi, yi):
+        inb = (xi >= 0) & (xi <= W - 1) & (yi >= 0) & (yi <= H - 1)
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        # gather per batch: data (N,C,H,W), idx (N,Ho,Wo)
+        g = jax.vmap(lambda d, yy, xx: d[:, yy, xx])(data, yc, xc)
+        return g * inb[:, None, :, :]
+
+    out = (tap(x0, y0) * ((1 - wx) * (1 - wy))[:, None] +
+           tap(x0 + 1, y0) * (wx * (1 - wy))[:, None] +
+           tap(x0, y0 + 1) * ((1 - wx) * wy)[:, None] +
+           tap(x0 + 1, y0 + 1) * (wx * wy)[:, None])
+    return out
+
+
+@register("BilinearSampler", num_inputs=2)
+def _bilinear_sampler(data, grid, cudnn_off=None, **kw):
+    """grid is normalized [-1,1] (N,2,Ho,Wo): grid[:,0]=x, grid[:,1]=y."""
+    _N, _C, H, W = data.shape
+    gx = (grid[:, 0] + 1) * (W - 1) / 2
+    gy = (grid[:, 1] + 1) * (H - 1) / 2
+    return _bilinear_gather(data, gx, gy)
+
+
+@register("GridGenerator", num_inputs=-1)
+def _grid_generator(data, transform_type="affine", target_shape=None,
+                    **kw):
+    H, W = ptuple(target_shape, default=(0, 0))
+    if transform_type == "affine":
+        N = data.shape[0]
+        theta = data.reshape(N, 2, 3)
+        ys = jnp.linspace(-1, 1, H)
+        xs = jnp.linspace(-1, 1, W)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx.ravel(), gy.ravel(),
+                          jnp.ones(H * W)], axis=0)
+        out = jnp.einsum("nij,jk->nik", theta, base)  # (N,2,HW)
+        return out.reshape(N, 2, H, W)
+    # warp: data is (N,2,H,W) flow added to the identity grid
+    N, _two, H, W = data.shape
+    ys = jnp.linspace(-1, 1, H)
+    xs = jnp.linspace(-1, 1, W)
+    gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+    ident = jnp.stack([gx, gy])[None]
+    norm = jnp.asarray([2.0 / max(W - 1, 1),
+                        2.0 / max(H - 1, 1)]).reshape(1, 2, 1, 1)
+    return ident + data * norm
+
+
+@register("SpatialTransformer", num_inputs=2)
+def _spatial_transformer(data, loc, target_shape=None,
+                         transform_type="affine",
+                         sampler_type="bilinear", cudnn_off=None, **kw):
+    grid = _grid_generator(loc, transform_type="affine",
+                           target_shape=target_shape)
+    return _bilinear_sampler(data, grid)
+
+
+@register("Crop", num_inputs=-1)
+def _crop(*inputs, offset=(0, 0), h_w=(0, 0), center_crop=False,
+          num_args=1, **kw):
+    data = inputs[0]
+    if len(inputs) > 1:
+        th, tw = inputs[1].shape[2], inputs[1].shape[3]
+    else:
+        th, tw = ptuple(h_w, default=(0, 0))
+    H, W = data.shape[2], data.shape[3]
+    if pbool(center_crop):
+        y0, x0 = (H - th) // 2, (W - tw) // 2
+    else:
+        y0, x0 = ptuple(offset, default=(0, 0))
+    return data[:, :, y0:y0 + th, x0:x0 + tw]
+
+
+@register("_contrib_AdaptiveAvgPooling2D")
+def _adaptive_avg_pool(data, output_size=None, **kw):
+    size = ptuple(output_size, default=(1, 1))
+    if len(size) == 1:
+        size = size * 2
+    oh, ow = size
+    N, C, H, W = data.shape
+    if oh == 1 and ow == 1:
+        return jnp.mean(data, axis=(2, 3), keepdims=True)
+    # exact reference binning: cell (i,j) averages rows
+    # [floor(iH/oh), ceil((i+1)H/oh))
+    rows = []
+    for i in range(oh):
+        y0, y1 = (i * H) // oh, -(-((i + 1) * H) // oh)
+        cols = []
+        for j in range(ow):
+            x0, x1 = (j * W) // ow, -(-((j + 1) * W) // ow)
+            cols.append(jnp.mean(data[:, :, y0:y1, x0:x1], axis=(2, 3)))
+        rows.append(jnp.stack(cols, axis=-1))
+    return jnp.stack(rows, axis=-2)
+
+
+# ---------------------------------------------------------------------------
+# contrib: fft / boolean_mask / bipartite_matching
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_fft", differentiable=False)
+def _fft(data, compute_size=128, **kw):
+    """Last-axis FFT; complex output packed [re, im] interleaved on the
+    last axis (reference fft.cc layout: output dim doubles)."""
+    out = jnp.fft.fft(data.astype(jnp.complex64), axis=-1)
+    packed = jnp.stack([out.real, out.imag], axis=-1)
+    return packed.reshape(data.shape[:-1] + (2 * data.shape[-1],)) \
+        .astype(jnp.float32)
+
+
+@register("_contrib_ifft", differentiable=False)
+def _ifft(data, compute_size=128, **kw):
+    n = data.shape[-1] // 2
+    pairs = data.reshape(data.shape[:-1] + (n, 2))
+    cplx = pairs[..., 0] + 1j * pairs[..., 1]
+    # reference ifft does NOT normalize (caller divides by n)
+    return jnp.fft.ifft(cplx, axis=-1).real.astype(jnp.float32) * n
+
+
+@register("_contrib_boolean_mask", num_inputs=2, differentiable=False)
+def _boolean_mask(data, index, axis=0, **kw):
+    if isinstance(data, jax.core.Tracer):
+        raise NotImplementedError(
+            "boolean_mask produces a data-dependent shape and cannot run "
+            "inside jit; call it eagerly")
+    keep = np.where(np.asarray(index) != 0)[0]
+    return jnp.take(data, jnp.asarray(keep), axis=pint(axis, 0))
+
+
+@register("_contrib_bipartite_matching", num_outputs=2,
+          differentiable=False)
+def _bipartite_matching(data, is_ascend=False, threshold=None, topk=-1,
+                        **kw):
+    """Greedy bipartite matching over score matrix rows/cols
+    (reference contrib/krprod... bipartite_matching.cc). Host op."""
+    thr = pfloat(threshold, 0.5)
+    asc = pbool(is_ascend)
+    k = pint(topk, -1)
+
+    def host(d):
+        d = np.asarray(d)
+        batch = d.reshape((-1,) + d.shape[-2:])
+        rows_out = np.full(batch.shape[:2], -1, np.float32)
+        cols_out = np.full((batch.shape[0], batch.shape[2]), -1,
+                           np.float32)
+        for b, m in enumerate(batch):
+            work = m.copy()
+            n = 0
+            while True:
+                if asc:
+                    i, j = np.unravel_index(np.argmin(work), work.shape)
+                    ok = work[i, j] <= thr
+                else:
+                    i, j = np.unravel_index(np.argmax(work), work.shape)
+                    ok = work[i, j] >= thr
+                if not ok or (0 < k <= n):
+                    break
+                rows_out[b, i] = j
+                cols_out[b, j] = i
+                work[i, :] = -np.inf if not asc else np.inf
+                work[:, j] = -np.inf if not asc else np.inf
+                n += 1
+        return (rows_out.reshape(d.shape[:-1]),
+                cols_out.reshape(d.shape[:-2] + (d.shape[-1],)))
+
+    if isinstance(data, jax.core.Tracer):
+        out_shapes = (jax.ShapeDtypeStruct(data.shape[:-1], np.float32),
+                      jax.ShapeDtypeStruct(data.shape[:-2]
+                                           + (data.shape[-1],),
+                                           np.float32))
+        return jax.pure_callback(host, out_shapes, data)
+    return tuple(jnp.asarray(o) for o in host(data))
+
+
+# ---------------------------------------------------------------------------
+# image ops (src/operator/image/image_random.cc, resize.cc, crop.cc)
+# ---------------------------------------------------------------------------
+
+
+@register("_image_to_tensor")
+def _image_to_tensor(data, **kw):
+    """HWC uint8 [0,255] -> CHW float32 [0,1] (batched: NHWC -> NCHW)."""
+    x = data.astype(jnp.float32) / 255.0
+    if x.ndim == 3:
+        return x.transpose(2, 0, 1)
+    return x.transpose(0, 3, 1, 2)
+
+
+@register("_image_normalize")
+def _image_normalize(data, mean=(0, 0, 0), std=(1, 1, 1), **kw):
+    from .utils import pftuple
+
+    m = jnp.asarray(pftuple(mean, default=(0, 0, 0)), jnp.float32)
+    s = jnp.asarray(pftuple(std, default=(1, 1, 1)), jnp.float32)
+    c = data.shape[0] if data.ndim == 3 else data.shape[1]
+    shape = (c, 1, 1) if data.ndim == 3 else (1, c, 1, 1)
+    return (data - m[:c].reshape(shape)) / s[:c].reshape(shape)
+
+
+@register("_image_resize", differentiable=False)
+def _image_resize(data, size=None, keep_ratio=False, interp=1, **kw):
+    sz = ptuple(size, default=(0, 0))
+    if len(sz) == 1:
+        sz = sz * 2
+    w, h = sz
+    method = "linear" if pint(interp, 1) else "nearest"
+    if data.ndim == 3:                      # HWC
+        return jax.image.resize(data, (h, w, data.shape[2]), method)
+    return jax.image.resize(data, (data.shape[0], h, w, data.shape[3]),
+                            method)
+
+
+@register("_image_crop", differentiable=False)
+def _image_crop(data, x=0, y=0, width=0, height=0, **kw):
+    x0, y0 = pint(x, 0), pint(y, 0)
+    w, h = pint(width, 0), pint(height, 0)
+    if data.ndim == 3:                      # HWC
+        return data[y0:y0 + h, x0:x0 + w, :]
+    return data[:, y0:y0 + h, x0:x0 + w, :]
+
+
+# ---------------------------------------------------------------------------
+# per-row sampling ops (random/sample_op.cc) and *_like variants
+# ---------------------------------------------------------------------------
+
+
+def _rowwise(params_shape, shape):
+    s = ptuple(shape, default=()) or ()
+    return tuple(params_shape) + tuple(s)
+
+
+@register("_sample_exponential", uses_rng=True, differentiable=False)
+def _sample_exponential(lam, shape=None, dtype="float32", **kw):
+    e = jax.random.exponential(_random.next_key(),
+                               _rowwise(lam.shape, shape),
+                               dtype=pdtype(dtype))
+    s = ptuple(shape, default=()) or ()
+    return e / lam.reshape(lam.shape + (1,) * len(s))
+
+
+@register("_sample_gamma", uses_rng=True, num_inputs=2,
+          differentiable=False)
+def _sample_gamma(alpha, beta, shape=None, dtype="float32", **kw):
+    s = ptuple(shape, default=()) or ()
+    a = alpha.reshape(alpha.shape + (1,) * len(s))
+    g = jax.random.gamma(_random.next_key(),
+                         jnp.broadcast_to(a, _rowwise(alpha.shape, shape)),
+                         dtype=pdtype(dtype))
+    return g * beta.reshape(beta.shape + (1,) * len(s))
+
+
+@register("_sample_poisson", uses_rng=True, differentiable=False)
+def _sample_poisson(lam, shape=None, dtype="float32", **kw):
+    s = ptuple(shape, default=()) or ()
+    l = lam.reshape(lam.shape + (1,) * len(s))
+    p = jax.random.poisson(_random.next_key(),
+                           jnp.broadcast_to(l, _rowwise(lam.shape, shape)))
+    return p.astype(pdtype(dtype))
+
+
+@register("_sample_negative_binomial", uses_rng=True, num_inputs=2,
+          differentiable=False)
+def _sample_negative_binomial(k, p, shape=None, dtype="float32", **kw):
+    s = ptuple(shape, default=()) or ()
+    kk = jnp.broadcast_to(k.reshape(k.shape + (1,) * len(s)),
+                          _rowwise(k.shape, shape)).astype(jnp.float32)
+    pp = jnp.broadcast_to(p.reshape(p.shape + (1,) * len(s)),
+                          _rowwise(p.shape, shape))
+    key1, key2 = jax.random.split(_random.next_key())
+    lam = jax.random.gamma(key1, kk) * (1 - pp) / pp
+    return jax.random.poisson(key2, lam).astype(pdtype(dtype))
+
+
+@register("_sample_generalized_negative_binomial", uses_rng=True,
+          num_inputs=2, differentiable=False)
+def _sample_gen_negative_binomial(mu, alpha, shape=None, dtype="float32",
+                                  **kw):
+    s = ptuple(shape, default=()) or ()
+    m = jnp.broadcast_to(mu.reshape(mu.shape + (1,) * len(s)),
+                         _rowwise(mu.shape, shape))
+    a = jnp.broadcast_to(alpha.reshape(alpha.shape + (1,) * len(s)),
+                         _rowwise(alpha.shape, shape))
+    key1, key2 = jax.random.split(_random.next_key())
+    r = 1.0 / jnp.maximum(a, 1e-12)
+    lam = jax.random.gamma(key1, r) * m * a
+    return jax.random.poisson(key2, lam).astype(pdtype(dtype))
+
+
+def _register_like(name, base_fn):
+    @register(name, uses_rng=True, differentiable=False)
+    def _like(data, loc=0.0, scale=1.0, lam=1.0, low=0.0, high=1.0,
+              alpha=1.0, beta=1.0, mu=1.0, k=1, p=1, **kw):
+        shape, dt = data.shape, data.dtype
+        return base_fn(shape, dt, dict(loc=pfloat(loc, 0.0),
+                                       scale=pfloat(scale, 1.0),
+                                       lam=pfloat(lam, 1.0),
+                                       low=pfloat(low, 0.0),
+                                       high=pfloat(high, 1.0),
+                                       alpha=pfloat(alpha, 1.0),
+                                       beta=pfloat(beta, 1.0),
+                                       mu=pfloat(mu, 1.0),
+                                       k=pfloat(k, 1),
+                                       p=pfloat(p, 1)))
+    return _like
+
+
+_register_like("_random_uniform_like", lambda s, d, a: jax.random.uniform(
+    _random.next_key(), s, minval=a["low"], maxval=a["high"]).astype(d))
+_register_like("_random_normal_like", lambda s, d, a: (
+    jax.random.normal(_random.next_key(), s) * a["scale"]
+    + a["loc"]).astype(d))
+_register_like("_random_exponential_like", lambda s, d, a: (
+    jax.random.exponential(_random.next_key(), s) / a["lam"]).astype(d))
+_register_like("_random_gamma_like", lambda s, d, a: (
+    jax.random.gamma(_random.next_key(), a["alpha"], s)
+    * a["beta"]).astype(d))
+_register_like("_random_poisson_like", lambda s, d, a: jax.random.poisson(
+    _random.next_key(), a["lam"], s).astype(d))
+_register_like("_random_negative_binomial_like", lambda s, d, a: (
+    jax.random.poisson(
+        _random.next_key(),
+        jax.random.gamma(_random.next_key(), a["k"], s)
+        * (1 - a["p"]) / max(a["p"], 1e-12))).astype(d))
+_register_like(
+    "_random_generalized_negative_binomial_like",
+    lambda s, d, a: jax.random.poisson(
+        _random.next_key(),
+        jax.random.gamma(_random.next_key(), 1.0 / max(a["alpha"], 1e-12),
+                         s) * a["mu"] * a["alpha"]).astype(d))
+
+
+# ---------------------------------------------------------------------------
+# multi-tensor fused optimizer kernels (optimizer_op.cc multi_sgd_*)
+# ---------------------------------------------------------------------------
+
+
+def _multi_attrs(kw, n):
+    from .utils import pftuple
+
+    lrs = list(pftuple(kw.get("lrs"), default=(0.01,) * n))
+    wds = list(pftuple(kw.get("wds"), default=(0.0,) * n))
+    return lrs, wds
+
+
+@register("multi_sgd_update", num_inputs=-1,
+          num_outputs=lambda a: pint(a.get("num_weights"), 1),
+          mutate_inputs=tuple(2 * i for i in range(32)),
+          differentiable=False)
+def _multi_sgd_update(*arrays, num_weights=None, rescale_grad=1.0,
+                      clip_gradient=-1.0, **kw):
+    n = pint(num_weights, len(arrays) // 2)
+    lrs, wds = _multi_attrs(kw, n)
+    rs = pfloat(rescale_grad, 1.0)
+    cg = pfloat(clip_gradient, -1.0)
+    outs = []
+    for i in range(n):
+        w, g = arrays[2 * i], arrays[2 * i + 1]
+        g = g * rs
+        if cg > 0:
+            g = jnp.clip(g, -cg, cg)
+        outs.append(w - lrs[i] * (g + wds[i] * w))
+    return tuple(outs) if n > 1 else outs[0]
+
+
+@register("multi_sgd_mom_update", num_inputs=-1,
+          num_outputs=lambda a: 2 * pint(a.get("num_weights"), 1),
+          mutate_inputs=tuple(x for i in range(21)
+                              for x in (3 * i, 3 * i + 2)),
+          differentiable=False)
+def _multi_sgd_mom_update(*arrays, num_weights=None, momentum=0.0,
+                          rescale_grad=1.0, clip_gradient=-1.0, **kw):
+    n = pint(num_weights, len(arrays) // 3)
+    lrs, wds = _multi_attrs(kw, n)
+    mom = pfloat(momentum, 0.0)
+    rs = pfloat(rescale_grad, 1.0)
+    cg = pfloat(clip_gradient, -1.0)
+    outs = []
+    for i in range(n):
+        w, g, m = arrays[3 * i], arrays[3 * i + 1], arrays[3 * i + 2]
+        g = g * rs
+        if cg > 0:
+            g = jnp.clip(g, -cg, cg)
+        new_m = mom * m - lrs[i] * (g + wds[i] * w)
+        outs.extend([w + new_m, new_m])
+    return tuple(outs)
+
+
+@register("_mp_adamw_update", num_inputs=5, num_outputs=4,
+          mutate_inputs=(0, 2, 3, 4), differentiable=False)
+def _mp_adamw_update(weight, grad, mean, var, weight32, lr=None,
+                     beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
+                     eta=1.0, rescale_grad=1.0, clip_gradient=-1.0, **kw):
+    g = grad.astype(jnp.float32) * pfloat(rescale_grad, 1.0)
+    cg = pfloat(clip_gradient, -1.0)
+    if cg > 0:
+        g = jnp.clip(g, -cg, cg)
+    b1, b2 = pfloat(beta1, 0.9), pfloat(beta2, 0.999)
+    new_mean = b1 * mean + (1 - b1) * g
+    new_var = b2 * var + (1 - b2) * jnp.square(g)
+    w32 = weight32 - pfloat(eta, 1.0) * (
+        pfloat(lr) * new_mean / (jnp.sqrt(new_var) + pfloat(epsilon, 1e-8))
+        + pfloat(wd, 0.0) * weight32)
+    return w32.astype(weight.dtype), new_mean, new_var, w32
+
+
+@register("_contrib_group_adagrad_update", num_inputs=3, num_outputs=2,
+          mutate_inputs=(0, 2), differentiable=False)
+def _group_adagrad_update(weight, grad, history, lr=None,
+                          rescale_grad=1.0, clip_gradient=-1.0,
+                          epsilon=1e-5, **kw):
+    g = grad * pfloat(rescale_grad, 1.0)
+    cg = pfloat(clip_gradient, -1.0)
+    if cg > 0:
+        g = jnp.clip(g, -cg, cg)
+    grp = jnp.mean(jnp.square(g), axis=tuple(range(1, g.ndim)),
+                   keepdims=True) if g.ndim > 1 else jnp.square(g)
+    new_hist = history + grp
+    return (weight - pfloat(lr) * g / (jnp.sqrt(new_hist)
+                                       + pfloat(epsilon, 1e-5)), new_hist)
+
+
+# ---------------------------------------------------------------------------
+# quantized pass-through kernels (int8 stays int8)
+# ---------------------------------------------------------------------------
+
+
+@register("_contrib_quantized_act", num_inputs=3, num_outputs=3,
+          differentiable=False)
+def _quantized_act(data, min_range, max_range, act_type="relu", **kw):
+    if act_type != "relu":
+        raise NotImplementedError("quantized activation only supports relu")
+    return jnp.maximum(data, 0), jnp.zeros_like(min_range), max_range
+
+
+@register("_contrib_quantized_flatten", num_inputs=3, num_outputs=3,
+          differentiable=False)
+def _quantized_flatten(data, min_range, max_range, **kw):
+    return data.reshape(data.shape[0], -1), min_range, max_range
+
+
+@register("_contrib_quantized_pooling", num_inputs=3, num_outputs=3,
+          differentiable=False)
+def _quantized_pooling(data, min_range, max_range, **kw):
+    from .nn import pooling
+
+    return pooling(data, **kw), min_range, max_range
+
+
+# misc aliases: MultiProposal IS batched Proposal; SparseEmbedding's
+# forward equals Embedding (sparse grad handled at the NDArray layer);
+# SyncBatchNorm = BatchNorm (stat sync is the mesh program's psum when
+# training data-parallel); _rnn_param_concat = Concat
+try:
+    alias("_contrib_Proposal", "_contrib_MultiProposal")
+    alias("Embedding", "_contrib_SparseEmbedding")
+    alias("Concat", "_rnn_param_concat")
+    alias("BatchNorm", "SyncBatchNorm")
+    alias("BatchNorm", "_contrib_SyncBatchNorm")
+except KeyError:
+    pass

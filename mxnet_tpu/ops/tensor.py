@@ -184,6 +184,12 @@ register("ones_like", differentiable=False)(lambda data, **kw: jnp.ones_like(dat
 # ---------------------------------------------------------------------------
 
 
+def _safe_accumulation():
+    from .. import config as _config
+
+    return _config.get("MXNET_SAFE_ACCUMULATION")
+
+
 def _reduce(fn, data, axis=None, keepdims=False, exclude=False):
     axis = paxis(axis)
     keepdims = pbool(keepdims)
@@ -191,6 +197,10 @@ def _reduce(fn, data, axis=None, keepdims=False, exclude=False):
         ax = axis if isinstance(axis, tuple) else (axis,)
         ax = tuple(normalize_axis(a, data.ndim) for a in ax)
         axis = tuple(i for i in range(data.ndim) if i not in ax)
+    if data.dtype in (jnp.float16, jnp.bfloat16) and _safe_accumulation():
+        # MXNET_SAFE_ACCUMULATION: accumulate halves in fp32
+        return fn(data.astype(jnp.float32), axis=axis,
+                  keepdims=keepdims).astype(data.dtype)
     return fn(data, axis=axis, keepdims=keepdims)
 
 

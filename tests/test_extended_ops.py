@@ -1,0 +1,207 @@
+"""Tests for the extended op families (spatial, fft, sampling, multi-
+tensor optimizers, training heads)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.ndarray.ndarray import _invoke_nd
+
+
+def test_elemwise_alias_family():
+    a = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    b = nd.array(np.array([3.0, 2.0, 1.0], np.float32))
+    np.testing.assert_array_equal(
+        _invoke_nd("_equal", [a, b], {}).asnumpy(), [0, 1, 0])
+    np.testing.assert_array_equal(
+        _invoke_nd("_maximum", [a, b], {}).asnumpy(), [3, 2, 3])
+    np.testing.assert_allclose(
+        _invoke_nd("_power", [a, b], {}).asnumpy(), [1, 4, 3])
+
+
+def test_add_n_round_reshape_like():
+    a = nd.array(np.ones((2, 3), np.float32))
+    out = _invoke_nd("add_n", [a, a, a], {})
+    np.testing.assert_array_equal(out.asnumpy(), 3 * np.ones((2, 3)))
+    r = _invoke_nd("round", [nd.array(np.array([1.4, 2.6]))], {})
+    np.testing.assert_array_equal(r.asnumpy(), [1.0, 3.0])
+    rl = _invoke_nd("reshape_like",
+                    [a, nd.array(np.zeros((3, 2), np.float32))], {})
+    assert rl.shape == (3, 2)
+
+
+def test_histogram_and_ravel():
+    data = nd.array(np.array([0.1, 0.4, 0.6, 0.9], np.float32))
+    counts, edges = _invoke_nd("_histogram", [data],
+                               {"bin_cnt": 2, "range": (0.0, 1.0)})
+    np.testing.assert_array_equal(counts.asnumpy(), [2, 2])
+    idx = nd.array(np.array([[0, 1], [2, 0]], np.float32))
+    rav = _invoke_nd("_ravel_multi_index", [idx], {"shape": (3, 4)})
+    np.testing.assert_array_equal(rav.asnumpy(), [2, 4])
+    unr = _invoke_nd("_unravel_index",
+                     [nd.array(np.array([2, 4], np.float32))],
+                     {"shape": (3, 4)})
+    np.testing.assert_array_equal(unr.asnumpy(), [[0, 1], [2, 0]])
+
+
+def test_split_v2_and_slice_assign():
+    x = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    parts = _invoke_nd("_split_v2", [x], {"indices": (1, 2), "axis": 0})
+    assert len(parts) == 3 and parts[1].shape == (1, 4)
+    out = _invoke_nd("_slice_assign_scalar", [x],
+                     {"scalar": -1.0, "begin": (0, 0), "end": (2, 2)})
+    got = out.asnumpy()
+    assert (got[:2, :2] == -1).all() and got[2, 3] == 11
+
+
+def test_make_loss_and_gradient_multiplier():
+    x = nd.array(np.array([1.0, 2.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = _invoke_nd("MakeLoss", [x], {"grad_scale": 3.0})
+        y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [3.0, 3.0])
+    with autograd.record():
+        y = _invoke_nd("_contrib_gradientmultiplier", [x], {"scalar": -2.0})
+        y.sum().backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [-2.0, -2.0])
+
+
+def test_bilinear_sampler_identity():
+    data = nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    ys = np.linspace(-1, 1, 4)
+    xs = np.linspace(-1, 1, 4)
+    gy, gx = np.meshgrid(ys, xs, indexing="ij")
+    grid = nd.array(np.stack([gx, gy])[None].astype(np.float32))
+    out = _invoke_nd("BilinearSampler", [data, grid], {})
+    np.testing.assert_allclose(out.asnumpy(), data.asnumpy(), atol=1e-5)
+
+
+def test_spatial_transformer_identity():
+    data = nd.array(np.random.rand(2, 3, 5, 5).astype(np.float32))
+    theta = nd.array(np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32),
+                             (2, 1)))
+    out = _invoke_nd("SpatialTransformer", [data, theta],
+                     {"target_shape": (5, 5),
+                      "transform_type": "affine",
+                      "sampler_type": "bilinear"})
+    np.testing.assert_allclose(out.asnumpy(), data.asnumpy(), atol=1e-4)
+
+
+def test_grid_generator_affine_shape():
+    theta = nd.array(np.array([[2, 0, 0.5, 0, 2, -0.5]], np.float32))
+    grid = _invoke_nd("GridGenerator", [theta],
+                      {"transform_type": "affine", "target_shape": (3, 4)})
+    assert grid.shape == (1, 2, 3, 4)
+
+
+def test_adaptive_avg_pooling():
+    x = nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    out = _invoke_nd("_contrib_AdaptiveAvgPooling2D", [x],
+                     {"output_size": (2, 2)})
+    np.testing.assert_allclose(
+        out.asnumpy()[0, 0],
+        [[(0 + 1 + 4 + 5) / 4, (2 + 3 + 6 + 7) / 4],
+         [(8 + 9 + 12 + 13) / 4, (10 + 11 + 14 + 15) / 4]])
+    gap = _invoke_nd("_contrib_AdaptiveAvgPooling2D", [x],
+                     {"output_size": (1,)})
+    np.testing.assert_allclose(gap.asnumpy().ravel(), [7.5])
+
+
+def test_fft_roundtrip():
+    x = nd.array(np.random.rand(2, 8).astype(np.float32))
+    f = _invoke_nd("_contrib_fft", [x], {})
+    assert f.shape == (2, 16)
+    back = _invoke_nd("_contrib_ifft", [f], {})
+    np.testing.assert_allclose(back.asnumpy() / 8, x.asnumpy(),
+                               atol=1e-5)
+
+
+def test_boolean_mask():
+    data = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    index = nd.array(np.array([1, 0, 1, 0], np.float32))
+    out = _invoke_nd("_contrib_boolean_mask", [data, index], {})
+    np.testing.assert_array_equal(out.asnumpy(),
+                                  data.asnumpy()[[0, 2]])
+
+
+def test_bipartite_matching():
+    score = nd.array(np.array([[[0.9, 0.1], [0.2, 0.8]]], np.float32))
+    rows, cols = _invoke_nd("_contrib_bipartite_matching", [score],
+                            {"threshold": 0.5})
+    np.testing.assert_array_equal(rows.asnumpy()[0], [0, 1])
+    np.testing.assert_array_equal(cols.asnumpy()[0], [0, 1])
+
+
+def test_image_ops():
+    img = nd.array(np.random.randint(0, 255, (6, 8, 3)).astype(np.uint8))
+    t = _invoke_nd("_image_to_tensor", [img], {})
+    assert t.shape == (3, 6, 8) and float(t.asnumpy().max()) <= 1.0
+    n = _invoke_nd("_image_normalize", [t],
+                   {"mean": (0.5, 0.5, 0.5), "std": (0.5, 0.5, 0.5)})
+    assert abs(float(n.asnumpy().mean())) < 1.5
+    r = _invoke_nd("_image_resize", [img], {"size": (4, 3)})
+    assert r.shape == (3, 4, 3)
+    c = _invoke_nd("_image_crop", [img],
+                   {"x": 1, "y": 2, "width": 4, "height": 3})
+    assert c.shape == (3, 4, 3)
+
+
+def test_sample_ops_rowwise():
+    lam = nd.array(np.array([1.0, 100.0], np.float32))
+    s = _invoke_nd("_sample_poisson", [lam], {"shape": (500,)})
+    assert s.shape == (2, 500)
+    means = s.asnumpy().mean(axis=1)
+    assert abs(means[0] - 1.0) < 0.5 and abs(means[1] - 100.0) < 5.0
+    a = nd.array(np.array([2.0], np.float32))
+    b = nd.array(np.array([3.0], np.float32))
+    g = _invoke_nd("_sample_gamma", [a, b], {"shape": (2000,)})
+    assert abs(g.asnumpy().mean() - 6.0) < 1.0   # E[gamma(2, scale 3)] = 6
+
+
+def test_random_like_ops():
+    x = nd.array(np.zeros((3, 4), np.float32))
+    u = _invoke_nd("_random_uniform_like", [x], {"low": 2.0, "high": 3.0})
+    assert u.shape == (3, 4)
+    arr = u.asnumpy()
+    assert (arr >= 2.0).all() and (arr < 3.0).all()
+    n = _invoke_nd("_random_normal_like", [x], {"loc": 5.0, "scale": 0.1})
+    assert abs(n.asnumpy().mean() - 5.0) < 0.5
+
+
+def test_multi_sgd_update():
+    w1 = nd.array(np.ones(4, np.float32))
+    g1 = nd.array(np.full(4, 0.5, np.float32))
+    w2 = nd.array(np.full(3, 2.0, np.float32))
+    g2 = nd.array(np.ones(3, np.float32))
+    outs = _invoke_nd("multi_sgd_update", [w1, g1, w2, g2],
+                      {"num_weights": 2, "lrs": (0.1, 0.2),
+                       "wds": (0.0, 0.0)})
+    np.testing.assert_allclose(w1.asnumpy(), np.full(4, 0.95), rtol=1e-6)
+    np.testing.assert_allclose(w2.asnumpy(), np.full(3, 1.8), rtol=1e-6)
+
+
+def test_mp_adamw_update():
+    w = nd.array(np.ones(3, np.float16))
+    g = nd.array(np.full(3, 0.1, np.float16))
+    mean = nd.array(np.zeros(3, np.float32))
+    var = nd.array(np.zeros(3, np.float32))
+    w32 = nd.array(np.ones(3, np.float32))
+    _invoke_nd("_mp_adamw_update", [w, g, mean, var, w32],
+               {"lr": 0.1, "wd": 0.0})
+    assert w.asnumpy().dtype == np.float16
+    assert (np.abs(mean.asnumpy()) > 0).all()   # state updated in place
+    assert (w32.asnumpy() < 1.0).all()
+
+
+def test_svm_output_backward():
+    x = nd.array(np.array([[2.0, -1.0], [0.2, 0.1]], np.float32))
+    y = nd.array(np.array([0.0, 1.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        out = _invoke_nd("SVMOutput", [x, y], {"margin": 1.0})
+        out.sum().backward()
+    g = x.grad.asnumpy()
+    # row 0 class 0 margin satisfied (2 > 1): some entries zero
+    assert g[0, 0] == 0.0
+    assert g[0, 1] != 0.0 or g[1, 0] != 0.0
