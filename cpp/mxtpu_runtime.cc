@@ -1,0 +1,291 @@
+// mxtpu native runtime: the C++ components of the TPU-native framework.
+//
+// Reference parity niches (cnzhanj/incubator-mxnet):
+//  - src/io/iter_image_recordio_2.cc : the threaded RecordIO -> JPEG ->
+//    batch pipeline.  Here: record index scan, pread-based record
+//    fetch (thread safe, no fd seek races), and a libjpeg batch
+//    decoder that runs on std::thread workers -- fully outside the
+//    Python GIL.
+//  - src/storage/ (pooled_memory_storage) : a size-bucketed buffer
+//    pool with allocation statistics, backing the IO pipeline's batch
+//    staging buffers.
+//
+// Exposed as a plain C ABI consumed via ctypes (no pybind11 in this
+// environment).  Build: see cpp/Makefile (g++ -O2 -shared -fPIC,
+// linked against the system libjpeg).
+#include <algorithm>
+#include <atomic>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+#include <fcntl.h>
+#include <unistd.h>
+
+#include <jpeglib.h>
+#include <setjmp.h>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// RecordIO (format: little-endian u32 magic 0xCED7230A, u32 lrec =
+// cflag<<29 | length, payload, pad to 4)
+// ---------------------------------------------------------------------------
+
+static const uint32_t kMagic = 0xCED7230A;
+
+struct MXTPURecordFile {
+  int fd;
+};
+
+void* mxtpu_recordio_open(const char* path) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return nullptr;
+  return new MXTPURecordFile{fd};
+}
+
+void mxtpu_recordio_close(void* handle) {
+  if (!handle) return;
+  auto* f = static_cast<MXTPURecordFile*>(handle);
+  ::close(f->fd);
+  delete f;
+}
+
+// Scan the file once, writing each record's byte offset into out_pos.
+// Returns the number of records (may exceed cap; only cap offsets are
+// stored), or -1 on a framing error.
+int64_t mxtpu_recordio_index(const char* path, int64_t* out_pos,
+                             int64_t cap) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return -1;
+  int64_t pos = 0, n = 0;
+  uint32_t hdr[2];
+  while (true) {
+    ssize_t got = ::pread(fd, hdr, 8, pos);
+    if (got < 8) break;
+    if (hdr[0] != kMagic) { ::close(fd); return -1; }
+    if (n < cap) out_pos[n] = pos;
+    ++n;
+    int64_t len = hdr[1] & ((1u << 29) - 1);
+    pos += 8 + len + ((4 - (len % 4)) % 4);
+  }
+  ::close(fd);
+  return n;
+}
+
+// Read the record at `pos` into buf (cap bytes).  Returns payload
+// length (even if > cap: caller re-sizes and retries), or -1 on error.
+int64_t mxtpu_recordio_read_at(void* handle, int64_t pos, uint8_t* buf,
+                               int64_t cap) {
+  auto* f = static_cast<MXTPURecordFile*>(handle);
+  uint32_t hdr[2];
+  if (::pread(f->fd, hdr, 8, pos) < 8 || hdr[0] != kMagic) return -1;
+  int64_t len = hdr[1] & ((1u << 29) - 1);
+  if (len <= cap && ::pread(f->fd, buf, len, pos + 8) < len) return -1;
+  return len;
+}
+
+void* mxtpu_pool_alloc(int64_t size);
+void mxtpu_pool_release(void* ptr, int64_t size);
+
+// ---------------------------------------------------------------------------
+// libjpeg decode (error handling via setjmp, libjpeg idiom)
+// ---------------------------------------------------------------------------
+
+struct JpegErr {
+  jpeg_error_mgr mgr;
+  jmp_buf jump;
+};
+
+static void jpeg_err_exit(j_common_ptr cinfo) {
+  auto* err = reinterpret_cast<JpegErr*>(cinfo->err);
+  longjmp(err->jump, 1);
+}
+
+// Decode one JPEG into an RGB HWC uint8 buffer of exactly h*w*3 bytes
+// by center-cropping.  Sources smaller than the target return -2 (the
+// caller falls back to the Python path, whose resize-then-crop
+// semantics we must not silently diverge from).  Returns 0 on success.
+// NOTE: no C++ objects with destructors may be live across setjmp —
+// the row buffer is raw malloc, freed on both exits (longjmp rule).
+static int decode_jpeg_rgb(const uint8_t* data, int64_t size,
+                           uint8_t* out, int out_h, int out_w) {
+  jpeg_decompress_struct cinfo;
+  JpegErr jerr;
+  uint8_t* row = nullptr;
+  cinfo.err = jpeg_std_error(&jerr.mgr);
+  jerr.mgr.error_exit = jpeg_err_exit;
+  if (setjmp(jerr.jump)) {
+    jpeg_destroy_decompress(&cinfo);
+    ::free(row);
+    return -1;
+  }
+  jpeg_create_decompress(&cinfo);
+  jpeg_mem_src(&cinfo, data, size);
+  jpeg_read_header(&cinfo, TRUE);
+  cinfo.out_color_space = JCS_RGB;
+  jpeg_start_decompress(&cinfo);
+  const int w = cinfo.output_width, h = cinfo.output_height;
+  if (w < out_w || h < out_h) {
+    jpeg_abort_decompress(&cinfo);
+    jpeg_destroy_decompress(&cinfo);
+    return -2;
+  }
+  row = static_cast<uint8_t*>(::malloc(static_cast<size_t>(w) * 3));
+  JSAMPROW rowp = row;
+  const int y_off = (h - out_h) / 2;
+  const int x_off = (w - out_w) / 2;
+  int y = 0;
+  while (cinfo.output_scanline < cinfo.output_height) {
+    jpeg_read_scanlines(&cinfo, &rowp, 1);
+    const int oy = y - y_off;
+    if (oy >= 0 && oy < out_h)
+      std::memcpy(out + static_cast<int64_t>(oy) * out_w * 3,
+                  row + static_cast<int64_t>(x_off) * 3,
+                  static_cast<size_t>(out_w) * 3);
+    ++y;
+  }
+  jpeg_finish_decompress(&cinfo);
+  jpeg_destroy_decompress(&cinfo);
+  ::free(row);
+  return 0;
+}
+
+// Batch pipeline step: for each of n records at positions pos[i],
+// pread + parse the IRHeader (flag u32, label f32, id u64, id2 u64
+// [+ flag extra label floats]) + JPEG-decode the image into
+// out[i] = batch + i*out_h*out_w*3 (CHW=false: HWC layout), and write
+// labels[i].  Runs on `threads` C++ threads.  Returns the number of
+// failed records (their slots are zero-filled).
+int64_t mxtpu_decode_batch(const char* path, const int64_t* pos,
+                           int64_t n, uint8_t* batch, float* labels,
+                           int out_h, int out_w, int threads) {
+  int fd = ::open(path, O_RDONLY);
+  if (fd < 0) return n;
+  std::atomic<int64_t> next{0}, failed{0};
+  const int64_t img_bytes = static_cast<int64_t>(out_h) * out_w * 3;
+
+  auto worker = [&]() {
+    // record staging comes from the pooled storage manager so repeated
+    // batches reuse buffers instead of re-mallocing
+    int64_t cap = 1 << 20;
+    uint8_t* rec = static_cast<uint8_t*>(mxtpu_pool_alloc(cap));
+    uint32_t hdr[2];
+    while (true) {
+      int64_t i = next.fetch_add(1);
+      if (i >= n) break;
+      uint8_t* out = batch + i * img_bytes;
+      bool ok = false;
+      do {
+        if (::pread(fd, hdr, 8, pos[i]) < 8 || hdr[0] != kMagic) break;
+        int64_t len = hdr[1] & ((1u << 29) - 1);
+        if (len > cap) {
+          mxtpu_pool_release(rec, cap);
+          while (cap < len) cap <<= 1;
+          rec = static_cast<uint8_t*>(mxtpu_pool_alloc(cap));
+        }
+        if (::pread(fd, rec, len, pos[i] + 8) < len) break;
+        if (len < 24) break;
+        uint32_t flag;
+        float label;
+        std::memcpy(&flag, rec, 4);
+        std::memcpy(&label, rec + 4, 4);
+        int64_t ir = 24 + static_cast<int64_t>(flag) * 4;
+        if (flag > 0) std::memcpy(&label, rec + 24, 4);
+        if (ir >= len) break;
+        if (decode_jpeg_rgb(rec + ir, len - ir, out, out_h, out_w) != 0)
+          break;
+        labels[i] = label;
+        ok = true;
+      } while (false);
+      if (!ok) {
+        std::memset(out, 0, img_bytes);
+        labels[i] = -1.0f;
+        failed.fetch_add(1);
+      }
+    }
+    mxtpu_pool_release(rec, cap);
+  };
+
+  int nt = std::max(1, threads);
+  std::vector<std::thread> pool;
+  pool.reserve(nt - 1);
+  for (int t = 1; t < nt; ++t) pool.emplace_back(worker);
+  worker();
+  for (auto& th : pool) th.join();
+  ::close(fd);
+  return failed.load();
+}
+
+// ---------------------------------------------------------------------------
+// Pooled storage manager (reference src/storage/pooled_memory_storage)
+// ---------------------------------------------------------------------------
+
+struct Pool {
+  std::mutex mu;
+  std::map<int64_t, std::vector<void*>> free_list;  // size -> buffers
+  int64_t bytes_allocated = 0;   // live from the OS
+  int64_t bytes_pooled = 0;      // idle in the free list
+  int64_t n_alloc = 0, n_reuse = 0, n_free = 0;
+};
+
+static Pool g_pool;
+
+void* mxtpu_pool_alloc(int64_t size) {
+  std::lock_guard<std::mutex> lk(g_pool.mu);
+  auto it = g_pool.free_list.find(size);
+  if (it != g_pool.free_list.end() && !it->second.empty()) {
+    void* p = it->second.back();
+    it->second.pop_back();
+    g_pool.bytes_pooled -= size;
+    ++g_pool.n_reuse;
+    return p;
+  }
+  void* p = ::malloc(size);
+  if (p) {
+    g_pool.bytes_allocated += size;
+    ++g_pool.n_alloc;
+  }
+  return p;
+}
+
+void mxtpu_pool_release(void* ptr, int64_t size) {
+  if (!ptr) return;
+  std::lock_guard<std::mutex> lk(g_pool.mu);
+  g_pool.free_list[size].push_back(ptr);
+  g_pool.bytes_pooled += size;
+  ++g_pool.n_free;
+}
+
+// stats layout: [bytes_allocated, bytes_pooled, n_alloc, n_reuse, n_free]
+void mxtpu_pool_stats(int64_t* out) {
+  std::lock_guard<std::mutex> lk(g_pool.mu);
+  out[0] = g_pool.bytes_allocated;
+  out[1] = g_pool.bytes_pooled;
+  out[2] = g_pool.n_alloc;
+  out[3] = g_pool.n_reuse;
+  out[4] = g_pool.n_free;
+}
+
+void mxtpu_pool_clear() {
+  std::lock_guard<std::mutex> lk(g_pool.mu);
+  for (auto& kv : g_pool.free_list) {
+    for (void* p : kv.second) {
+      ::free(p);
+      g_pool.bytes_allocated -= kv.first;
+    }
+    kv.second.clear();
+  }
+  g_pool.bytes_pooled = 0;
+  // counters restart with the emptied pool (outstanding buffers keep
+  // their bytes_allocated accounting)
+  g_pool.n_alloc = g_pool.n_reuse = g_pool.n_free = 0;
+}
+
+int mxtpu_version() { return 1; }
+
+}  // extern "C"

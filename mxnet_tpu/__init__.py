@@ -49,6 +49,8 @@ from . import name  # noqa: F401
 from .name import NameManager  # noqa: F401
 from . import rtc  # noqa: F401
 from . import config  # noqa: F401
+from . import native  # noqa: F401
+from . import storage  # noqa: F401
 from . import contrib  # noqa: F401
 from . import operator  # noqa: F401
 from . import util  # noqa: F401

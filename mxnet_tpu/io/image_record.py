@@ -74,6 +74,20 @@ class ImageRecordIter(DataIter):
             from .. import config as _config
 
             preprocess_threads = _config.get("MXNET_CPU_WORKER_NTHREADS")
+        self._nthreads = int(preprocess_threads)
+        # native C++ fast path (cpp/mxtpu_runtime.cc): pread + libjpeg
+        # batch decode on C++ threads, usable when the augmentation is
+        # plain center-crop on 3-channel data with scalar labels
+        from .. import native as _native
+
+        self._native_ok = (
+            _native.available() and self._label_width == 1
+            and self._data_shape[0] == 3 and self._resize <= 0
+            and not self._rand_crop and not self._rand_mirror)
+        # one native call in flight at a time: decode_batch parallelizes
+        # internally with nthreads C++ threads, so letting every pool
+        # worker spawn its own crew would oversubscribe nthreads^2-fold
+        self._native_lock = threading.Lock()
         self._positions = self._index_positions(part_index, num_parts)
         if not self._positions:
             raise MXNetError("shard %d/%d of %s holds no records"
@@ -108,14 +122,19 @@ class ImageRecordIter(DataIter):
                     if len(parts) >= 2:
                         positions.append(int(parts[1]))
         else:
-            # one sequential scan to build the offset table
-            rec = MXRecordIO(self._path_rec, "r")
-            while True:
-                pos = rec.tell()
-                if rec.read() is None:
-                    break
-                positions.append(pos)
-            rec.close()
+            from .. import native as _native
+
+            if _native.available():
+                positions = _native.recordio_index(self._path_rec)
+            else:
+                # one sequential scan to build the offset table
+                rec = MXRecordIO(self._path_rec, "r")
+                while True:
+                    pos = rec.tell()
+                    if rec.read() is None:
+                        break
+                    positions.append(pos)
+                rec.close()
         # contiguous shard per worker, reference-style
         n = len(positions)
         lo = (n * part_index) // num_parts
@@ -216,6 +235,10 @@ class ImageRecordIter(DataIter):
     # ------------------------------------------------------------------
     def _load_batch(self, order_idx, pad, batch_id):
         c, h, w = self._data_shape
+        if self._native_ok:
+            got = self._load_batch_native(order_idx, pad)
+            if got is not None:
+                return got
         data = np.empty((self.batch_size, c, h, w), np.uint8)
         if self._label_width == 1:
             label = np.empty((self.batch_size,), np.float32)
@@ -234,6 +257,22 @@ class ImageRecordIter(DataIter):
             label[slot] = lab[0] if self._label_width == 1 else \
                 lab[:self._label_width]
         return data, label, pad
+
+    def _load_batch_native(self, order_idx, pad):
+        """Whole-batch read+decode in C++ (no GIL); None on failure —
+        non-JPEG payloads permanently fall back to the Python path."""
+        from .. import native as _native
+
+        _c, h, w = self._data_shape
+        positions = [self._positions[int(i)] for i in order_idx]
+        with self._native_lock:
+            batch_hwc, labels, failed = _native.decode_batch(
+                self._path_rec, positions, h, w, threads=self._nthreads)
+        if failed:
+            self._native_ok = False
+            return None
+        data = np.ascontiguousarray(batch_hwc.transpose(0, 3, 1, 2))
+        return data, labels, pad
 
     @staticmethod
     def _read_at(reader, pos):

@@ -1,0 +1,34 @@
+"""Storage-manager facade.
+
+Reference parity: ``src/storage/`` (PooledStorageManager and the
+profiler's pool statistics).  On TPU the tensor allocator is XLA's —
+the host-side pool that remains ours is the native batch-staging pool
+in ``cpp/mxtpu_runtime.cc``; this module surfaces its statistics and
+release hook, matching the role of the reference's pool counters.
+"""
+from __future__ import annotations
+
+from . import native as _native
+
+__all__ = ["pool_stats", "release_all", "available"]
+
+
+def available():
+    """True when the native pooled storage manager is loaded."""
+    return _native.available()
+
+
+def pool_stats():
+    """Allocation counters: bytes_allocated (live), bytes_pooled (idle
+    in the free list), n_alloc / n_reuse / n_free."""
+    if not _native.available():
+        return {"bytes_allocated": 0, "bytes_pooled": 0, "n_alloc": 0,
+                "n_reuse": 0, "n_free": 0}
+    return _native.pool_stats()
+
+
+def release_all():
+    """Drop every pooled buffer back to the OS (reference
+    Storage::ReleaseAll / MXStorageEmptyCache)."""
+    if _native.available():
+        _native.pool_clear()

@@ -1,0 +1,131 @@
+"""Native C++ runtime tests (cpp/mxtpu_runtime.cc via ctypes)."""
+import ctypes
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import native, recordio
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native runtime did not build")
+
+
+@pytest.fixture(scope="module")
+def jpeg_rec(tmp_path_factory):
+    root = tmp_path_factory.mktemp("nativerec")
+    rec = str(root / "n.rec")
+    idx = str(root / "n.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    rng = np.random.RandomState(0)
+    for i in range(10):
+        img = np.full((24, 24, 3), i * 20, np.uint8)
+        img[0, 0] = [255, 0, 0]
+        w.write_idx(i, recordio.pack_img(
+            recordio.IRHeader(0, float(i), i, 0), img, quality=95))
+    w.close()
+    return rec
+
+
+def test_native_index_matches_python(jpeg_rec):
+    got = native.recordio_index(jpeg_rec)
+    rec = recordio.MXRecordIO(jpeg_rec, "r")
+    expect = []
+    while True:
+        pos = rec.tell()
+        if rec.read() is None:
+            break
+        expect.append(pos)
+    rec.close()
+    assert got == expect
+
+
+def test_native_read_at_matches(jpeg_rec):
+    positions = native.recordio_index(jpeg_rec)
+    reader = native.RecordReader(jpeg_rec)
+    pyrec = recordio.MXRecordIO(jpeg_rec, "r")
+    for pos in positions:
+        pyrec.seek(pos)
+        assert reader.read_at(pos) == pyrec.read()
+    reader.close()
+    pyrec.close()
+
+
+def test_native_decode_batch(jpeg_rec):
+    positions = native.recordio_index(jpeg_rec)
+    batch, labels, failed = native.decode_batch(jpeg_rec, positions,
+                                                24, 24, threads=2)
+    assert failed == 0
+    assert batch.shape == (10, 24, 24, 3)
+    np.testing.assert_array_equal(labels, np.arange(10, dtype=np.float32))
+    # solid-color body survives JPEG within tolerance
+    for i in range(10):
+        assert abs(int(batch[i, 12, 12, 0]) - i * 20) <= 6
+
+
+def test_native_decode_center_crop(jpeg_rec):
+    positions = native.recordio_index(jpeg_rec)
+    batch, labels, failed = native.decode_batch(jpeg_rec, positions[:2],
+                                                16, 16)
+    assert failed == 0 and batch.shape == (2, 16, 16, 3)
+
+
+def test_pool_stats_counters():
+    native.pool_clear()
+    l = native.lib()
+    l.mxtpu_pool_alloc.restype = ctypes.c_void_p
+    l.mxtpu_pool_alloc.argtypes = [ctypes.c_int64]
+    l.mxtpu_pool_release.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+    p1 = l.mxtpu_pool_alloc(4096)
+    l.mxtpu_pool_release(p1, 4096)
+    p2 = l.mxtpu_pool_alloc(4096)      # must come from the free list
+    stats = native.pool_stats()
+    assert stats["n_alloc"] == 1
+    assert stats["n_reuse"] == 1
+    assert stats["bytes_allocated"] == 4096
+    l.mxtpu_pool_release(p2, 4096)
+    native.pool_clear()
+    assert native.pool_stats()["bytes_allocated"] == 0
+
+
+def test_imagerecorditer_native_fast_path(jpeg_rec):
+    import mxnet_tpu as mx
+
+    it = mx.io.ImageRecordIter(path_imgrec=jpeg_rec, batch_size=5,
+                               data_shape=(3, 24, 24))
+    assert it._native_ok
+    batches = list(it)
+    labels = np.concatenate([b.label[0].asnumpy() for b in batches])
+    assert sorted(labels.tolist()) == list(map(float, range(10)))
+    assert batches[0].data[0].shape == (5, 3, 24, 24)
+
+
+def test_native_undersized_falls_back_to_python(tmp_path):
+    """Images smaller than data_shape must use the Python resize path
+    (identical semantics regardless of whether the native lib built)."""
+    import mxnet_tpu as mx
+
+    rec = str(tmp_path / "small.rec")
+    w = recordio.MXRecordIO(rec, "w")
+    for i in range(4):
+        img = np.full((10, 10, 3), 50 * i, np.uint8)
+        w.write(recordio.pack_img(recordio.IRHeader(0, float(i), i, 0),
+                                  img, quality=95))
+    w.close()
+    it = mx.io.ImageRecordIter(path_imgrec=rec, batch_size=4,
+                               data_shape=(3, 24, 24))
+    batch = next(iter(it))
+    assert not it._native_ok          # flipped off on first undersize
+    assert batch.data[0].shape == (4, 3, 24, 24)
+    labels = np.sort(batch.label[0].asnumpy())
+    np.testing.assert_array_equal(labels, [0.0, 1.0, 2.0, 3.0])
+
+
+def test_pool_used_by_decode(jpeg_rec):
+    native.pool_clear()
+    positions = native.recordio_index(jpeg_rec)
+    native.decode_batch(jpeg_rec, positions, 24, 24, threads=2)
+    native.decode_batch(jpeg_rec, positions, 24, 24, threads=2)
+    stats = native.pool_stats()
+    assert stats["n_alloc"] >= 1
+    assert stats["n_reuse"] >= 1      # second batch reused staging
+    native.pool_clear()
