@@ -1,0 +1,66 @@
+"""contrib IO: gluon DataLoader -> Module DataIter bridge.
+
+Reference parity: ``python/mxnet/contrib/io.py`` (DataLoaderIter).
+Re-designed around the DataBatch-first DataIter contract used in this
+codebase: one lookahead batch determines the shapes, short final
+batches are zero-padded with ``pad`` set.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..io.io import DataBatch, DataDesc, DataIter
+from ..ndarray.ndarray import NDArray, array
+
+__all__ = ["DataLoaderIter"]
+
+
+class DataLoaderIter(DataIter):
+    """Wrap a gluon DataLoader so Module/fit can consume it."""
+
+    def __init__(self, loader, data_name="data",
+                 label_name="softmax_label", dtype="float32"):
+        self._loader = loader
+        self.dtype = dtype
+        probe_data, probe_label = next(iter(loader))
+        super().__init__(int(probe_data.shape[0]))
+        np_dtype = np.dtype(dtype)
+        self._data_desc = DataDesc(data_name, tuple(probe_data.shape),
+                                   np_dtype)
+        self._label_desc = DataDesc(label_name, tuple(probe_label.shape),
+                                    np_dtype)
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [self._data_desc]
+
+    @property
+    def provide_label(self):
+        return [self._label_desc]
+
+    def reset(self):
+        self._iter = iter(self._loader)
+
+    def _full(self, arr):
+        """Cast and zero-pad a short batch to the canonical batch size."""
+        raw = arr._data if isinstance(arr, NDArray) else np.asarray(arr)
+        out = array(np.asarray(raw)).astype(self.dtype)
+        short = self.batch_size - out.shape[0]
+        if short <= 0:
+            return out, 0
+        padded = np.zeros((self.batch_size,) + out.shape[1:],
+                          np.dtype(self.dtype))
+        padded[:out.shape[0]] = out.asnumpy()
+        return array(padded), short
+
+    def next(self):
+        try:
+            data, label = next(self._iter)
+        except StopIteration:
+            raise
+        data, pad = self._full(data)
+        label, _ = self._full(label)
+        return DataBatch(data=[data], label=[label], pad=pad,
+                         provide_data=self.provide_data,
+                         provide_label=self.provide_label)

@@ -1,0 +1,3 @@
+"""mx.contrib.svrg_optimization (reference parity:
+python/mxnet/contrib/svrg_optimization/)."""
+from .svrg_module import SVRGModule  # noqa: F401

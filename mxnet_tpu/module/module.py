@@ -277,7 +277,16 @@ class Module(BaseModule):
 
         from .. import config as _config
 
-        store = kvs.create(kvstore) if isinstance(kvstore, str) else kvstore
+        if isinstance(kvstore, str):
+            # reference _create_kvstore: a local store with one device
+            # is skipped entirely — the store's accumulate semantics are
+            # only meaningful as a cross-device reduce buffer
+            if "dist" not in kvstore and len(self._context) == 1:
+                store = None
+            else:
+                store = kvs.create(kvstore)
+        else:
+            store = kvstore
         update_on_kvstore = bool(store) and store.type.startswith("dist") \
             and _config.get("MXNET_UPDATE_ON_KVSTORE")
         rescale = 1.0 / self._effective_batch_size(store)

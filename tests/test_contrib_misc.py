@@ -1,0 +1,126 @@
+"""contrib text / svrg / tensorboard / io tests (reference:
+tests/python/unittest/test_contrib_text.py, test_contrib_svrg_module.py)."""
+import json
+from collections import Counter
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.contrib.text import Vocabulary, embedding, utils
+
+
+def test_count_tokens_and_vocab():
+    counter = utils.count_tokens_from_str("a b b c c c\nd d d d")
+    assert counter == Counter({"d": 4, "c": 3, "b": 2, "a": 1})
+    vocab = Vocabulary(counter, min_freq=2, unknown_token="<unk>",
+                       reserved_tokens=["<pad>"])
+    assert vocab.idx_to_token == ["<unk>", "<pad>", "d", "c", "b"]
+    assert vocab.to_indices(["d", "zzz"]) == [2, 0]
+    assert vocab.to_tokens([3, 4]) == ["c", "b"]
+    assert len(vocab) == 5
+
+
+def test_vocab_most_freq_count():
+    vocab = Vocabulary(Counter({"a": 5, "b": 4, "c": 3}),
+                       most_freq_count=2)
+    assert vocab.idx_to_token == ["<unk>", "a", "b"]
+
+
+def test_custom_embedding_roundtrip(tmp_path):
+    path = str(tmp_path / "emb.txt")
+    with open(path, "w") as f:
+        f.write("hello 0.1 0.2 0.3\nworld 0.4 0.5 0.6\n")
+    emb = embedding.CustomEmbedding(path)
+    assert emb.vec_len == 3 and len(emb) == 3   # <unk> + 2 tokens
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("world").asnumpy(), [0.4, 0.5, 0.6],
+        rtol=1e-6)
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("missing").asnumpy(), [0, 0, 0])
+    emb.update_token_vectors("hello", nd.array(np.array([1., 1., 1.])))
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("hello").asnumpy(), [1, 1, 1])
+
+
+def test_embedding_registry(tmp_path):
+    path = str(tmp_path / "e.txt")
+    with open(path, "w") as f:
+        f.write("tok 1.0 2.0\n")
+    emb = embedding.create("customembedding",
+                           pretrained_file_path=path)
+    assert emb.vec_len == 2
+    names = embedding.get_pretrained_file_names()
+    assert "glove" in names and "fasttext" in names
+
+
+def test_composite_embedding(tmp_path):
+    p1, p2 = str(tmp_path / "a.txt"), str(tmp_path / "b.txt")
+    with open(p1, "w") as f:
+        f.write("x 1.0 2.0\n")
+    with open(p2, "w") as f:
+        f.write("x 3.0\n")
+    vocab = Vocabulary(Counter({"x": 1}))
+    comp = embedding.CompositeEmbedding(
+        vocab, [embedding.CustomEmbedding(p1),
+                embedding.CustomEmbedding(p2)])
+    np.testing.assert_allclose(
+        comp.get_vecs_by_tokens("x").asnumpy(), [1.0, 2.0, 3.0])
+
+
+def test_svrg_module_trains():
+    from mxnet_tpu.contrib.svrg_optimization import SVRGModule
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(64, 8).astype(np.float32)
+    w_true = rng.rand(8, 1).astype(np.float32)
+    y = (x @ w_true).ravel()
+    it = mx.io.NDArrayIter(x, y, batch_size=16, label_name="lin_label")
+
+    data = mx.sym.var("data")
+    out = mx.sym.FullyConnected(data, num_hidden=1, name="fc")
+    out = mx.sym.LinearRegressionOutput(out, mx.sym.var("lin_label"),
+                                        name="lin")
+    mod = SVRGModule(out, data_names=("data",),
+                     label_names=("lin_label",), update_freq=2)
+    mod.fit(it, num_epoch=15, eval_metric="mse",
+            optimizer_params=(("learning_rate", 0.3),))
+    mod.forward(next(iter(it)), is_train=False)
+    pred = mod.get_outputs()[0].asnumpy().ravel()
+    it.reset()
+    mse = float(np.mean((pred - y[:16]) ** 2))
+    assert mse < 0.05, mse
+
+
+def test_tensorboard_callback_jsonl(tmp_path, monkeypatch):
+    from mxnet_tpu.contrib import tensorboard as tb_mod
+    from mxnet_tpu.contrib.tensorboard import LogMetricsCallback
+    from mxnet_tpu.module.base_module import BatchEndParam
+
+    # force the JSONL fallback even when a real tensorboard package
+    # (torch's) is importable
+    monkeypatch.setattr(tb_mod, "_make_writer", tb_mod._JsonlWriter)
+    cb = LogMetricsCallback(str(tmp_path / "tb"), prefix="train")
+    m = mx.metric.create("acc")
+    m.update([nd.array(np.array([0.0, 1.0]))],
+             [nd.array(np.array([[0.9, 0.1], [0.2, 0.8]]))])
+    cb(BatchEndParam(epoch=0, nbatch=1, eval_metric=m))
+    logged = [json.loads(l) for l in
+              open(str(tmp_path / "tb" / "scalars.jsonl"))]
+    assert logged and logged[0]["tag"].startswith("train-")
+
+
+def test_dataloader_iter_bridge():
+    from mxnet_tpu.contrib.io import DataLoaderIter
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    x = np.random.rand(10, 4).astype(np.float32)
+    y = np.arange(10, dtype=np.float32)
+    loader = DataLoader(ArrayDataset(x, y), batch_size=4)
+    it = DataLoaderIter(loader)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (4, 4)
+    assert batches[-1].pad == 2
+    it.reset()
+    assert len(list(it)) == 3
