@@ -35,7 +35,7 @@ def main():
     from mxnet_tpu import nd, gluon, parallel
     from mxnet_tpu.gluon.model_zoo import vision
 
-    batch = int(os.environ.get("BENCH_BATCH", "128"))
+    batch = int(os.environ.get("BENCH_BATCH", "256"))  # best measured MXU utilization
     steps = int(os.environ.get("BENCH_STEPS", "20"))
     warmup = int(os.environ.get("BENCH_WARMUP", "2"))
     on_tpu = any(d.platform != "cpu" for d in jax.devices())

@@ -33,8 +33,10 @@ class _JsonlWriter:
 
 
 def _make_writer(logging_dir):
-    for mod, cls in (("torch.utils.tensorboard", "SummaryWriter"),
-                     ("tensorboardX", "SummaryWriter")):
+    # lightest first: tensorboardX; torch's writer drags the whole
+    # torch runtime into a jax process, so it is the last resort
+    for mod, cls in (("tensorboardX", "SummaryWriter"),
+                     ("torch.utils.tensorboard", "SummaryWriter")):
         try:
             m = __import__(mod, fromlist=[cls])
             if hasattr(m, cls):

@@ -68,6 +68,9 @@ class KVServer:
         self._sock.bind((host, port))
         self._sock.listen(num_workers + 2)
         self._done = threading.Event()
+        # failure detection (reference kvstore_dist.h:121-126 node-death
+        # handling): ranks whose connection dropped without shutdown
+        self._dead = set()
 
     def serve(self):
         threads = []
@@ -95,12 +98,30 @@ class KVServer:
         else:
             self._store[key] = self._store[key] + agg
 
+    def _wait_error(self):
+        if self._dead:
+            return {"ok": False,
+                    "error": "worker failure detected: dead rank(s) %s"
+                             % sorted(self._dead)}
+        return {"ok": False,
+                "error": "timed out waiting for peers (no failure "
+                         "detected; a worker may be stalled)"}
+
     def _handle(self, conn):
+        rank = None
+        clean_exit = False
         try:
             while not self._done.is_set():
                 msg = _recv_msg(conn)
                 op = msg["op"]
-                if op == "init":
+                if op == "hello":
+                    rank = msg.get("rank")
+                    _send_msg(conn, {"ok": True})
+                elif op == "health":
+                    with self._cv:
+                        dead = sorted(self._dead)
+                    _send_msg(conn, {"ok": True, "dead": dead})
+                elif op == "init":
                     with self._cv:
                         self._store.setdefault(msg["key"], msg["value"])
                     _send_msg(conn, {"ok": True})
@@ -123,8 +144,12 @@ class KVServer:
                             self._push_buf[key] = (acc, cnt, gen)
                             target = gen + 1
                             self._cv.wait_for(
-                                lambda: self._push_buf[key][2] >= target,
-                                timeout=600)
+                                lambda: self._push_buf[key][2] >= target
+                                or self._dead, timeout=600)
+                            if self._push_buf[key][2] < target:
+                                # failed round: fail fast
+                                _send_msg(conn, self._wait_error())
+                                continue
                     _send_msg(conn, {"ok": True})
                 elif op == "pull":
                     with self._cv:
@@ -144,17 +169,33 @@ class KVServer:
                             self._cv.notify_all()
                         else:
                             self._cv.wait_for(
-                                lambda: self._barrier_gen > gen, timeout=600)
+                                lambda: self._barrier_gen > gen
+                                or self._dead, timeout=600)
+                            if self._barrier_gen <= gen:
+                                _send_msg(conn, self._wait_error())
+                                continue
                     _send_msg(conn, {"ok": True})
                 elif op == "command":
                     _send_msg(conn, {"ok": True})
                 elif op == "shutdown":
                     _send_msg(conn, {"ok": True})
                     self._done.set()
+                    clean_exit = True
                     break
-        except (ConnectionError, OSError):
+        except (ConnectionError, OSError, EOFError):
             pass
         finally:
+            if not clean_exit and not self._done.is_set():
+                with self._cv:
+                    self._dead.add(-1 if rank is None else int(rank))
+                    # discard the broken round's partial state: with a
+                    # dead peer no collective can complete, and a retry
+                    # must not double-count the survivors' contributions
+                    self._push_buf = {k: (0.0, 0, gen)
+                                      for k, (_a, _c, gen)
+                                      in self._push_buf.items()}
+                    self._barrier_count = 0
+                    self._cv.notify_all()
             conn.close()
 
 
@@ -166,6 +207,7 @@ class WorkerClient:
         self.num_workers = num_workers
         self._sock = socket.create_connection((host, port), timeout=600)
         self._lock = threading.Lock()
+        self._rpc(op="hello", rank=rank)
 
     @classmethod
     def from_env(cls):
@@ -179,9 +221,19 @@ class WorkerClient:
         return cls(host, port, rank, num_workers)
 
     def _rpc(self, **msg):
+        from .base import MXNetError
+
         with self._lock:
             _send_msg(self._sock, msg)
-            return _recv_msg(self._sock)
+            resp = _recv_msg(self._sock)
+        if not resp.get("ok", True):
+            # a peer died mid-collective (reference node-failure surface)
+            raise MXNetError(resp.get("error", "kvstore server error"))
+        return resp
+
+    def health(self):
+        """Dead ranks the server has detected so far."""
+        return self._rpc(op="health").get("dead", [])
 
     def init(self, key, value):
         self._rpc(op="init", key=key, value=np.asarray(value))

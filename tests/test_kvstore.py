@@ -163,3 +163,47 @@ def test_dist_sync_kvstore_local_processes():
     expect = sum(range(1, num_workers + 1))  # 1+2+3
     for r in range(num_workers):
         assert results.get(r) == expect, results
+
+
+def test_dist_dead_worker_detection():
+    """A worker dying mid-round surfaces an error at the peers instead of
+    a hang (reference kvstore_dist.h node-failure handling)."""
+    import socket
+    import threading
+    import time
+
+    import numpy as np
+
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.kvstore_server import KVServer, WorkerClient
+
+    srv_sock = socket.socket()
+    srv_sock.bind(("127.0.0.1", 0))
+    port = srv_sock.getsockname()[1]
+    srv_sock.close()
+    server = KVServer("127.0.0.1", port, num_workers=2)
+    t = threading.Thread(target=server.serve, daemon=True)
+    t.start()
+    time.sleep(0.1)
+
+    w0 = WorkerClient("127.0.0.1", port, rank=0, num_workers=2)
+    w1 = WorkerClient("127.0.0.1", port, rank=1, num_workers=2)
+    w0.init("k", np.zeros(4, np.float32))
+
+    errs = []
+
+    def pusher():
+        try:
+            w0.push("k", np.ones(4, np.float32))
+        except MXNetError as e:
+            errs.append(str(e))
+
+    pt = threading.Thread(target=pusher)
+    pt.start()
+    time.sleep(0.2)          # w0 now waits for w1's contribution
+    w1._sock.close()         # w1 dies without shutdown
+    pt.join(timeout=10)
+    assert not pt.is_alive(), "push hung instead of failing fast"
+    assert errs and "dead rank" in errs[0]
+    assert w0.health() == [1]
+    w0._sock.close()
