@@ -1,0 +1,38 @@
+"""TensorRT toggle surface.
+
+Reference parity: ``python/mxnet/contrib/tensorrt.py``.  TensorRT is a
+CUDA inference runtime with no TPU counterpart — on this stack XLA is
+the graph optimizer, so the toggle is accepted (and remembered) but
+graph rewriting is a no-op and ``tensorrt_bind`` raises with the
+TPU-native alternative spelled out.
+"""
+from __future__ import annotations
+
+from ..base import MXNetError
+
+__all__ = ["set_use_tensorrt", "get_use_tensorrt",
+           "get_optimized_symbol", "tensorrt_bind"]
+
+_use_tensorrt = False
+
+
+def set_use_tensorrt(status):
+    global _use_tensorrt
+    _use_tensorrt = bool(status)
+
+
+def get_use_tensorrt():
+    return _use_tensorrt
+
+
+def get_optimized_symbol(executor):
+    """XLA already owns graph optimization; the bound symbol IS the
+    optimized graph."""
+    return executor._symbol if hasattr(executor, "_symbol") else None
+
+
+def tensorrt_bind(symbol, ctx, all_params, **kwargs):
+    raise MXNetError(
+        "TensorRT is CUDA-only; on TPU, bind the symbol normally (XLA "
+        "optimizes the graph) or use contrib.quantization.quantize_model "
+        "for int8 inference")

@@ -133,6 +133,10 @@ class KVServer:
                         _send_msg(conn, {"ok": True})
                         continue
                     with self._cv:
+                        if self._dead:
+                            # refuse new sync rounds with a dead peer
+                            _send_msg(conn, self._wait_error())
+                            continue
                         acc, cnt, gen = self._push_buf.get(key, (0.0, 0, 0))
                         acc = value if cnt == 0 else acc + value
                         cnt += 1
@@ -147,7 +151,14 @@ class KVServer:
                                 lambda: self._push_buf[key][2] >= target
                                 or self._dead, timeout=600)
                             if self._push_buf[key][2] < target:
-                                # failed round: fail fast
+                                # failed round: withdraw this worker's
+                                # contribution so a retry can never
+                                # double-count it, then fail fast
+                                a2, c2, g2 = self._push_buf[key]
+                                if g2 < target and c2 > 0:
+                                    self._push_buf[key] = (
+                                        (0.0, 0, g2) if c2 == 1
+                                        else (a2 - value, c2 - 1, g2))
                                 _send_msg(conn, self._wait_error())
                                 continue
                     _send_msg(conn, {"ok": True})
@@ -161,6 +172,9 @@ class KVServer:
                     _send_msg(conn, {"ok": True})
                 elif op == "barrier":
                     with self._cv:
+                        if self._dead:
+                            _send_msg(conn, self._wait_error())
+                            continue
                         gen = self._barrier_gen
                         self._barrier_count += 1
                         if self._barrier_count == self._num_workers:
@@ -172,6 +186,8 @@ class KVServer:
                                 lambda: self._barrier_gen > gen
                                 or self._dead, timeout=600)
                             if self._barrier_gen <= gen:
+                                self._barrier_count = max(
+                                    0, self._barrier_count - 1)
                                 _send_msg(conn, self._wait_error())
                                 continue
                     _send_msg(conn, {"ok": True})

@@ -124,3 +124,34 @@ def test_dataloader_iter_bridge():
     assert batches[-1].pad == 2
     it.reset()
     assert len(list(it)) == 3
+
+
+def test_contrib_legacy_autograd():
+    from mxnet_tpu.contrib import autograd as old_ag
+
+    def f(a, b):
+        return a * b + a
+
+    g = old_ag.grad(f)
+    a = nd.array(np.array([2.0], np.float32))
+    b = nd.array(np.array([3.0], np.float32))
+    grads = g(a, b)
+    np.testing.assert_allclose(grads[0].asnumpy(), [4.0])  # b + 1
+    np.testing.assert_allclose(grads[1].asnumpy(), [2.0])  # a
+    gl = old_ag.grad_and_loss(f, argnum=0)
+    grads, out = gl(a, b)
+    np.testing.assert_allclose(out.asnumpy(), [8.0])
+    np.testing.assert_allclose(grads[0].asnumpy(), [4.0])
+
+
+def test_contrib_tensorrt_toggle():
+    import pytest
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.contrib import tensorrt as trt
+
+    assert not trt.get_use_tensorrt()
+    trt.set_use_tensorrt(True)
+    assert trt.get_use_tensorrt()
+    trt.set_use_tensorrt(False)
+    with pytest.raises(MXNetError):
+        trt.tensorrt_bind(None, None, {})

@@ -207,3 +207,51 @@ def test_dist_dead_worker_detection():
     assert errs and "dead rank" in errs[0]
     assert w0.health() == [1]
     w0._sock.close()
+
+
+def test_dist_dead_worker_no_spurious_retry_success():
+    """After a detected failure, retried collectives keep failing — the
+    survivor's contribution must never be double-counted."""
+    import socket
+    import threading
+    import time
+
+    import numpy as np
+    import pytest
+
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.kvstore_server import KVServer, WorkerClient
+
+    srv_sock = socket.socket()
+    srv_sock.bind(("127.0.0.1", 0))
+    port = srv_sock.getsockname()[1]
+    srv_sock.close()
+    server = KVServer("127.0.0.1", port, num_workers=2)
+    threading.Thread(target=server.serve, daemon=True).start()
+    time.sleep(0.1)
+    w0 = WorkerClient("127.0.0.1", port, rank=0, num_workers=2)
+    w1 = WorkerClient("127.0.0.1", port, rank=1, num_workers=2)
+    w0.init("k", np.zeros(2, np.float32))
+
+    first_err = []
+
+    def push_once():
+        try:
+            w0.push("k", np.ones(2, np.float32))
+        except MXNetError as e:
+            first_err.append(str(e))
+
+    pt = threading.Thread(target=push_once)
+    pt.start()
+    time.sleep(0.2)
+    w1._sock.close()
+    pt.join(timeout=10)
+    assert first_err
+    # retries fail too (no spurious completion), store never moved
+    for _ in range(2):
+        with pytest.raises(MXNetError):
+            w0.push("k", np.ones(2, np.float32))
+        with pytest.raises(MXNetError):
+            w0.barrier()
+    np.testing.assert_array_equal(w0.pull("k"), np.zeros(2, np.float32))
+    w0._sock.close()
