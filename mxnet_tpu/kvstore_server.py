@@ -127,7 +127,9 @@ class KVServer:
                     _send_msg(conn, {"ok": True})
                 elif op == "push":
                     key, value = msg["key"], msg["value"]
-                    if not self._sync:
+                    if not self._sync or msg.get("async"):
+                        # server-wide async mode, or an explicit
+                        # per-push async request from the worker
                         with self._cv:
                             self._apply_update(key, value)
                         _send_msg(conn, {"ok": True})
@@ -255,7 +257,12 @@ class WorkerClient:
         self._rpc(op="init", key=key, value=np.asarray(value))
 
     def push(self, key, value, sync=True):
-        self._rpc(op="push", key=key, value=np.asarray(value))
+        """sync=False applies this push immediately server-side instead
+        of waiting for the other workers' contributions."""
+        msg = {"op": "push", "key": key, "value": np.asarray(value)}
+        if not sync:
+            msg["async"] = True
+        self._rpc(**msg)
 
     def pull(self, key):
         return self._rpc(op="pull", key=key)["value"]

@@ -1,0 +1,196 @@
+"""Deformable ConvNets operators.
+
+Reference parity:
+- ``src/operator/contrib/deformable_convolution.cc`` — v1 deformable
+  convolution (Dai et al. 1703.06211): each kernel tap samples the
+  input at a learned fractional offset.
+- ``src/operator/contrib/psroi_pooling.cc`` — R-FCN position-sensitive
+  ROI pooling.
+
+TPU-native design: instead of the reference's deformable_im2col CUDA
+kernel, the sampled patch tensor is built with one vectorized bilinear
+gather (XLA turns it into fused gathers) and the convolution reduces to
+a single MXU matmul over (Cin x KH x KW). PSROIPooling uses the
+integral-image trick — each variable-extent bin average becomes four
+gathers on a 2-D cumulative sum, which keeps the op jit-safe (ROI
+coordinates are traced values) and differentiable w.r.t. the features.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from .registry import register
+from .utils import pbool, pfloat, pint, ptuple
+
+
+def bilinear_mix(tap_gather, py, px, H, W):
+    """Shared zero-padded bilinear combine: ``tap_gather(yc, xc)`` reads
+    integer taps; out-of-bounds taps contribute zero (the reference
+    deformable_im2col / bilinear-sampler border behavior).  Used here
+    and by extended.py's BilinearSampler."""
+    y0 = jnp.floor(py)
+    x0 = jnp.floor(px)
+    wy = py - y0
+    wx = px - x0
+
+    def tap(yi, xi):
+        inb = (yi >= 0) & (yi <= H - 1) & (xi >= 0) & (xi <= W - 1)
+        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
+        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
+        return tap_gather(yc, xc) * inb
+
+    return (tap(y0, x0) * (1 - wy) * (1 - wx) +
+            tap(y0 + 1, x0) * wy * (1 - wx) +
+            tap(y0, x0 + 1) * (1 - wy) * wx +
+            tap(y0 + 1, x0 + 1) * wy * wx)
+
+
+def _bilinear_sample_nck(data, py, px):
+    """Sample data (N,C,H,W) at fractional (py, px) of shape
+    (N,C,K,Ho,Wo)."""
+    _N, _C, H, W = data.shape
+
+    def gather(yc, xc):
+        return jax.vmap(jax.vmap(lambda d, yy, xx: d[yy, xx]))(data, yc,
+                                                               xc)
+
+    return bilinear_mix(gather, py, px, H, W)
+
+
+@register("_contrib_DeformableConvolution", num_inputs=-1)
+def _deformable_convolution(data, offset, weight, bias=None, kernel=None,
+                            stride=None, dilate=None, pad=None,
+                            num_filter=None, num_group=1,
+                            num_deformable_group=1, no_bias=False,
+                            layout=None, workspace=None, **kw):
+    """data (N,C,H,W) + offset (N, 2*G*KH*KW, Ho, Wo) -> (N,F,Ho,Wo)."""
+    kh, kw_ = ptuple(kernel)
+    sh, sw = ptuple(stride, ndim=2, default=(1, 1))
+    dh, dw = ptuple(dilate, ndim=2, default=(1, 1))
+    ph, pw = ptuple(pad, ndim=2, default=(0, 0))
+    G = pint(num_deformable_group, 1)
+    groups = pint(num_group, 1)
+    N, C, H, W = data.shape
+    K = kh * kw_
+    Ho = (H + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+    Wo = (W + 2 * pw - (dw * (kw_ - 1) + 1)) // sw + 1
+
+    # base sampling grid per output position and tap
+    ys = jnp.arange(Ho) * sh - ph
+    xs = jnp.arange(Wo) * sw - pw
+    ky, kx = jnp.meshgrid(jnp.arange(kh) * dh, jnp.arange(kw_) * dw,
+                          indexing="ij")
+    base_y = ys[None, :, None] + ky.reshape(K, 1, 1)    # (K, Ho, 1)
+    base_x = xs[None, None, :] + kx.reshape(K, 1, 1)    # (K, 1, Wo)
+
+    # offsets: channel ((g*K + tap)*2 + {0:y, 1:x})
+    off = offset.reshape(N, G, K, 2, Ho, Wo)
+    py = base_y[None, None] + off[:, :, :, 0]           # (N, G, K, Ho, Wo)
+    px = base_x[None, None] + off[:, :, :, 1]
+    # broadcast each deformable group's grid over its channel slice
+    rep = C // G
+    py = jnp.repeat(py, rep, axis=1)                    # (N, C, K, Ho, Wo)
+    px = jnp.repeat(px, rep, axis=1)
+
+    patches = _bilinear_sample_nck(data, py, px)        # (N, C, K, Ho, Wo)
+
+    # one MXU matmul per conv group: (F, Cin/g*K) x (Cin/g*K, Ho*Wo)
+    F = pint(num_filter)
+    wmat = weight.reshape(F, -1)                        # (F, C/groups*K)
+    cpg, fpg = C // groups, F // groups
+    outs = []
+    for g in range(groups):
+        pg = patches[:, g * cpg:(g + 1) * cpg] \
+            .reshape(N, cpg * K, Ho * Wo)
+        wg = wmat[g * fpg:(g + 1) * fpg]
+        outs.append(jnp.einsum("fk,nko->nfo", wg, pg))
+    out = jnp.concatenate(outs, axis=1).reshape(N, F, Ho, Wo)
+    if not pbool(no_bias) and bias is not None:
+        out = out + bias.reshape(1, -1, 1, 1)
+    return out.astype(data.dtype)
+
+
+def _integral(data):
+    """Zero-padded 2-D integral image over the trailing axes."""
+    s = jnp.cumsum(jnp.cumsum(data, axis=-1), axis=-2)
+    return jnp.pad(s, [(0, 0)] * (data.ndim - 2) + [(1, 0), (1, 0)])
+
+
+@register("_contrib_PSROIPooling", num_inputs=2,
+          aliases=("PSROIPooling",))
+def _psroi_pooling(data, rois, spatial_scale=1.0, output_dim=None,
+                   pooled_size=None, group_size=0, **kw):
+    """Position-sensitive ROI pooling (R-FCN): data channels are laid
+    out as (output_dim, group, group); bin (i, j) of each roi averages
+    its own (i, j) channel group."""
+    scale = pfloat(spatial_scale, 1.0)
+    P = pint(pooled_size)
+    gs = pint(group_size, 0) or P
+    od = pint(output_dim)
+    N, C, H, W = data.shape
+    R = rois.shape[0]
+
+    # center per channel before the cumsum: box sums become differences
+    # of much smaller magnitudes, protecting fp32 precision on large maps
+    ch_mean = jnp.mean(data, axis=(2, 3), keepdims=True)
+    integ = _integral(data - ch_mean)                   # (N, C, H+1, W+1)
+
+    # each output cell (c_top, i, j) reads exactly one input channel:
+    # ((c_top * gs + gi) * gs + gj) with gi/gj = the bin's group row/col
+    sel = jnp.minimum(jnp.arange(P) * gs // P, gs - 1)
+    ch_idx = ((jnp.arange(od)[:, None, None] * gs + sel[None, :, None])
+              * gs + sel[None, None, :])               # (od, P, P)
+
+    def one_roi(roi):
+        b = roi[0].astype(jnp.int32)
+        # reference rounds roi corners then scales
+        x1 = jnp.round(roi[1]) * scale
+        y1 = jnp.round(roi[2]) * scale
+        x2 = (jnp.round(roi[3]) + 1.0) * scale
+        y2 = (jnp.round(roi[4]) + 1.0) * scale
+        rw = jnp.maximum(x2 - x1, 0.1)
+        rh = jnp.maximum(y2 - y1, 0.1)
+        bin_h, bin_w = rh / P, rw / P
+        ii = jnp.arange(P)
+        ys0 = jnp.clip(jnp.floor(y1 + ii * bin_h), 0, H).astype(jnp.int32)
+        ys1 = jnp.clip(jnp.ceil(y1 + (ii + 1) * bin_h), 0, H) \
+            .astype(jnp.int32)
+        xs0 = jnp.clip(jnp.floor(x1 + ii * bin_w), 0, W).astype(jnp.int32)
+        xs1 = jnp.clip(jnp.ceil(x1 + (ii + 1) * bin_w), 0, W) \
+            .astype(jnp.int32)
+        y0g, x0g = jnp.meshgrid(ys0, xs0, indexing="ij")
+        y1g, x1g = jnp.meshgrid(ys1, xs1, indexing="ij")
+        # gather only the selected channel per output cell: indices all
+        # broadcast to (od, P, P), so no wasted full-C box means
+        bi = integ[b]
+
+        def take(yy, xx):
+            return bi[ch_idx, yy[None], xx[None]]
+
+        total = (take(y1g, x1g) - take(y0g, x1g)
+                 - take(y1g, x0g) + take(y0g, x0g))
+        count = jnp.maximum((y1g - y0g) * (x1g - x0g), 1)[None]
+        picked = total / count + ch_mean[b, ch_idx, 0, 0]
+        empty = (y1g <= y0g) | (x1g <= x0g)
+        return jnp.where(empty[None], 0.0, picked)
+
+    return jax.vmap(one_roi)(rois).astype(data.dtype)
+
+
+@register("_contrib_DeformablePSROIPooling", num_inputs=-1)
+def _deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
+                              output_dim=None, group_size=0,
+                              pooled_size=None, part_size=0,
+                              sample_per_part=1, trans_std=0.0,
+                              no_trans=False, **kw):
+    """Deformable PSROIPooling; the no_trans path equals PSROIPooling
+    (reference deformable_psroi_pooling.cc with no_trans=True)."""
+    if not pbool(no_trans) and trans is not None and \
+            pfloat(trans_std, 0.0) != 0.0:
+        raise NotImplementedError(
+            "DeformablePSROIPooling with learned offsets (no_trans=False)"
+            " is not implemented; use no_trans=True")
+    return _psroi_pooling(data, rois, spatial_scale=spatial_scale,
+                          output_dim=output_dim,
+                          pooled_size=pooled_size, group_size=group_size)

@@ -239,26 +239,17 @@ def _identity_kl_sparse(data, sparseness_target=0.1, penalty=0.001,
 
 def _bilinear_gather(data, gx, gy):
     """Sample data (N,C,H,W) at fractional pixel coords gx/gy (N,Ho,Wo);
-    zero padding outside (the reference's border behavior for sampling
-    grids is zero-fill)."""
-    N, C, H, W = data.shape
-    x0 = jnp.floor(gx)
-    y0 = jnp.floor(gy)
-    wx = gx - x0
-    wy = gy - y0
+    zero padding outside (shared tap math lives in deformable.py)."""
+    from .deformable import bilinear_mix
 
-    def tap(xi, yi):
-        inb = (xi >= 0) & (xi <= W - 1) & (yi >= 0) & (yi <= H - 1)
-        xc = jnp.clip(xi, 0, W - 1).astype(jnp.int32)
-        yc = jnp.clip(yi, 0, H - 1).astype(jnp.int32)
-        # gather per batch: data (N,C,H,W), idx (N,Ho,Wo)
-        g = jax.vmap(lambda d, yy, xx: d[:, yy, xx])(data, yc, xc)
-        return g * inb[:, None, :, :]
+    _N, _C, H, W = data.shape
 
-    out = (tap(x0, y0) * ((1 - wx) * (1 - wy))[:, None] +
-           tap(x0 + 1, y0) * (wx * (1 - wy))[:, None] +
-           tap(x0, y0 + 1) * ((1 - wx) * wy)[:, None] +
-           tap(x0 + 1, y0 + 1) * (wx * wy)[:, None])
+    def gather(yc, xc):
+        # data (N,C,H,W), idx (N,1,Ho,Wo) -> (N,C,Ho,Wo)
+        return jax.vmap(lambda d, yy, xx: d[:, yy, xx])(
+            data, yc[:, 0], xc[:, 0])
+
+    out = bilinear_mix(gather, gy[:, None], gx[:, None], H, W)
     return out
 
 

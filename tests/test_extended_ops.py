@@ -205,3 +205,81 @@ def test_svm_output_backward():
     # row 0 class 0 margin satisfied (2 > 1): some entries zero
     assert g[0, 0] == 0.0
     assert g[0, 1] != 0.0 or g[1, 0] != 0.0
+
+
+def test_deformable_conv_zero_offset_matches_conv():
+    """Zero offsets reduce deformable conv to plain convolution."""
+    rng = np.random.RandomState(0)
+    x = nd.array(rng.rand(2, 3, 8, 8).astype(np.float32))
+    w = nd.array(rng.rand(4, 3, 3, 3).astype(np.float32))
+    off = nd.array(np.zeros((2, 2 * 9, 6, 6), np.float32))
+    y = _invoke_nd("_contrib_DeformableConvolution", [x, off, w],
+                   {"kernel": (3, 3), "num_filter": 4, "no_bias": True})
+    ref = _invoke_nd("Convolution", [x, w],
+                     {"kernel": (3, 3), "num_filter": 4, "no_bias": True})
+    np.testing.assert_allclose(y.asnumpy(), ref.asnumpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_deformable_conv_integer_shift():
+    """An integer offset samples the shifted input exactly."""
+    rng = np.random.RandomState(1)
+    x = nd.array(rng.rand(1, 1, 8, 8).astype(np.float32))
+    w = nd.array(np.ones((1, 1, 1, 1), np.float32))
+    # 1x1 kernel, offset (dy, dx) = (0, 1): output = input shifted left
+    off = np.zeros((1, 2, 8, 8), np.float32)
+    off[0, 1] = 1.0
+    y = _invoke_nd("_contrib_DeformableConvolution",
+                   [x, nd.array(off), w],
+                   {"kernel": (1, 1), "num_filter": 1, "no_bias": True})
+    np.testing.assert_allclose(y.asnumpy()[0, 0, :, :-1],
+                               x.asnumpy()[0, 0, :, 1:], rtol=1e-5)
+    # out-of-bounds column samples zero
+    np.testing.assert_allclose(y.asnumpy()[0, 0, :, -1], 0.0)
+
+
+def test_deformable_conv_gradients():
+    x = nd.array(np.random.rand(1, 2, 6, 6).astype(np.float32))
+    w = nd.array(np.random.rand(2, 2, 3, 3).astype(np.float32))
+    off = nd.array(np.random.rand(1, 2 * 9, 4, 4).astype(np.float32) * 0.1)
+    for v in (x, w, off):
+        v.attach_grad()
+    with autograd.record():
+        y = _invoke_nd("_contrib_DeformableConvolution", [x, off, w],
+                       {"kernel": (3, 3), "num_filter": 2,
+                        "no_bias": True})
+        y.sum().backward()
+    assert np.abs(x.grad.asnumpy()).sum() > 0
+    assert np.abs(w.grad.asnumpy()).sum() > 0
+    assert np.abs(off.grad.asnumpy()).sum() > 0   # offsets are learnable
+
+
+def test_psroi_pooling_uniform():
+    """On constant per-group channels, each output bin returns its own
+    group's constant."""
+    od, gs = 2, 3
+    data = np.zeros((1, od * gs * gs, 9, 9), np.float32)
+    for c in range(od * gs * gs):
+        data[0, c] = c
+    rois = nd.array(np.array([[0, 0, 0, 8, 8]], np.float32))
+    out = _invoke_nd("_contrib_PSROIPooling", [nd.array(data), rois],
+                     {"spatial_scale": 1.0, "output_dim": od,
+                      "pooled_size": 3, "group_size": gs})
+    assert out.shape == (1, od, 3, 3)
+    got = out.asnumpy()[0]
+    for ct in range(od):
+        for i in range(3):
+            for j in range(3):
+                assert got[ct, i, j] == (ct * gs + i) * gs + j
+
+
+def test_psroi_pooling_grad_flows():
+    data = nd.array(np.random.rand(1, 4, 6, 6).astype(np.float32))
+    rois = nd.array(np.array([[0, 1, 1, 4, 4]], np.float32))
+    data.attach_grad()
+    with autograd.record():
+        out = _invoke_nd("_contrib_PSROIPooling", [data, rois],
+                         {"spatial_scale": 1.0, "output_dim": 1,
+                          "pooled_size": 2, "group_size": 2})
+        out.sum().backward()
+    assert np.abs(data.grad.asnumpy()).sum() > 0

@@ -255,3 +255,36 @@ def test_dist_dead_worker_no_spurious_retry_success():
             w0.barrier()
     np.testing.assert_array_equal(w0.pull("k"), np.zeros(2, np.float32))
     w0._sock.close()
+
+
+def test_dist_async_mode_applies_immediately():
+    """dist_async semantics: each push applies without waiting for the
+    other workers (reference kvstore_dist_server.h async path)."""
+    import socket
+    import threading
+    import time
+
+    import numpy as np
+
+    from mxnet_tpu.kvstore_server import KVServer, WorkerClient
+
+    srv_sock = socket.socket()
+    srv_sock.bind(("127.0.0.1", 0))
+    port = srv_sock.getsockname()[1]
+    srv_sock.close()
+    server = KVServer("127.0.0.1", port, num_workers=2, sync_mode=False)
+    threading.Thread(target=server.serve, daemon=True).start()
+    time.sleep(0.1)
+    w0 = WorkerClient("127.0.0.1", port, rank=0, num_workers=2)
+    w1 = WorkerClient("127.0.0.1", port, rank=1, num_workers=2)
+    w0.init("k", np.zeros(3, np.float32))
+
+    # w0 pushes twice without any contribution from w1: applied at once
+    w0.push("k", np.ones(3, np.float32), sync=False)
+    w0.push("k", np.ones(3, np.float32), sync=False)
+    np.testing.assert_array_equal(w0.pull("k"), np.full(3, 2.0))
+    # w1's push lands on top whenever it arrives
+    w1.push("k", np.full(3, 5.0, np.float32), sync=False)
+    np.testing.assert_array_equal(w1.pull("k"), np.full(3, 7.0))
+    w0._sock.close()
+    w1._sock.close()
