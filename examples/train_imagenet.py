@@ -10,7 +10,13 @@ import argparse
 import logging
 import time
 
+import os
+import sys
+
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 import mxnet_tpu as mx
 from mxnet_tpu import gluon, autograd, parallel

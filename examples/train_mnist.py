@@ -8,7 +8,13 @@ LeNet with hybridize (jit). Uses local idx-ubyte files when present
 import argparse
 import logging
 
+import os
+import sys
+
 import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 import mxnet_tpu as mx
 from mxnet_tpu import gluon, autograd

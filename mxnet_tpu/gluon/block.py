@@ -401,8 +401,21 @@ class CachedOp:
         block = self._block
         if self._param_list is None:
             params = block.collect_params()
-            self._param_list = [p for p in params.values()
-                                if p._grad_req != "null" or True]
+            # every param is a jit input (frozen ones simply get no
+            # gradient); filtering would change the traced signature
+            self._param_list = list(params.values())
+        if not getattr(self, "_params_committed", False):
+            # params start as host numpy (batched lazy init) and the
+            # optimizer returns committed jit outputs — upload them
+            # committed NOW so the first compile uses the same jit cache
+            # key as every later step (host->committed flip = recompile)
+            dev = jax.devices()[0]
+            for p in self._param_list:
+                d = p.data()
+                arr = d._data
+                if not (hasattr(arr, "committed") and arr.committed):
+                    d._rebind(jax.device_put(arr, dev))
+            self._params_committed = True
         in_arrays = tuple(x._data for x in inputs)
         param_arrays = tuple(p.data()._data for p in self._param_list)
         is_train = autograd.is_training()

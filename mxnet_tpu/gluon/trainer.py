@@ -151,6 +151,8 @@ class Trainer:
         self._update(ignore_stale_grad)
 
     def _update(self, ignore_stale_grad=False):
+        if self._try_fused_update():
+            return
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
                 continue
@@ -160,6 +162,83 @@ class Trainer:
             for upd, arr, grad in zip(self._updaters, param.list_data(),
                                       param.list_grad()):
                 upd(i, grad, arr)
+
+    def _try_fused_update(self):
+        """Single-dispatch SGD: fold every parameter's update into ONE
+        jitted program instead of 2-3 eager dispatches per parameter —
+        the eager-imperative counterpart of the reference's
+        multi_sgd_update fused kernels.  Falls back (returns False) for
+        non-SGD optimizers, kvstore updates, or multi-device params."""
+        o = self._optimizer
+        if type(o) is not opt.SGD or o.multi_precision or \
+                self._kvstore is not None or len(self._contexts) != 1:
+            return False
+        params = [p for p in self._params
+                  if p.grad_req != "null" and p._data is not None]
+        if not params:
+            return False
+        import jax
+        import jax.numpy as jnp
+
+        # the jit closure bakes momentum/clip: key on them so changing
+        # the optimizer (momentum schedule, load_states) re-traces
+        key = (tuple(p.name for p in params), float(o.momentum),
+               o.clip_gradient)
+        if getattr(self, "_fused_key", None) != key:
+            momentum = float(o.momentum)
+            clip = o.clip_gradient
+
+            def fused(ws, gs, ms, lrs, wds, rescale):
+                new_ws, new_ms = [], []
+                for k in range(len(ws)):
+                    g = gs[k] * rescale
+                    if clip:
+                        g = jnp.clip(g, -clip, clip)
+                    g = g + wds[k] * ws[k]
+                    if ms is None:
+                        new_ws.append(ws[k] - lrs[k] * g)
+                    else:
+                        nm = momentum * ms[k] - lrs[k] * g
+                        new_ms.append(nm)
+                        new_ws.append(ws[k] + nm)
+                return new_ws, (None if ms is None else new_ms)
+
+            # no buffer donation: the reference's in-place update keeps
+            # aliases valid, so deleting old buffers would turn stale
+            # NDArray views into hard errors
+            self._fused_fn = jax.jit(fused)
+            self._fused_key = key
+        upd = self._updaters[0]
+        idxs = [self._param2idx[p.name] for p in params]
+        # momentum lives in the Updater's state dict so save_states /
+        # load_states keep working unchanged
+        for i, p in zip(idxs, params):
+            if i not in upd.states:
+                st = o.create_state_multi_precision(i, p.list_data()[0])
+                if st is not None:
+                    # committed like the donated jit outputs that will
+                    # replace it — keeps one stable jit cache key
+                    st._rebind(jax.device_put(st._data,
+                                              jax.devices()[0]))
+                upd.states[i] = st
+                upd.states_synced[i] = True
+            o._update_count(i)
+        ms = None if upd.states[idxs[0]] is None else \
+            [upd.states[i]._data for i in idxs]
+        # python floats trace as scalar args: lr/wd changes need no
+        # recompile and no per-step host->device array round-trip
+        lrs = [float(o._get_lr(i)) for i in idxs]
+        wds = [float(o._get_wd(i)) for i in idxs]
+        ws = [p.list_data()[0]._data for p in params]
+        gs = [p.list_grad()[0]._data for p in params]
+        new_ws, new_ms = self._fused_fn(
+            ws, gs, ms, lrs, wds, float(o.rescale_grad))
+        for p, w in zip(params, new_ws):
+            p.list_data()[0]._rebind(w)
+        if new_ms is not None:
+            for i, nm in zip(idxs, new_ms):
+                upd.states[i]._rebind(nm)
+        return True
 
     def save_states(self, fname):
         assert self._optimizer is not None

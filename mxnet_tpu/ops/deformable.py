@@ -184,8 +184,14 @@ def _deformable_psroi_pooling(data, rois, trans=None, spatial_scale=1.0,
                               pooled_size=None, part_size=0,
                               sample_per_part=1, trans_std=0.0,
                               no_trans=False, **kw):
-    """Deformable PSROIPooling; the no_trans path equals PSROIPooling
-    (reference deformable_psroi_pooling.cc with no_trans=True)."""
+    """Deformable PSROIPooling, no_trans path only.
+
+    APPROXIMATION NOTE: the reference (deformable_psroi_pooling.cc)
+    shifts ROI corners by -0.5 and averages sample_per_part^2 bilinear
+    sub-samples per bin; this port reuses the integer-cell integral
+    average of PSROIPooling, so bin values differ slightly from models
+    expecting exact reference numerics.  sample_per_part is ignored;
+    learned offsets (no_trans=False) raise."""
     if not pbool(no_trans) and trans is not None and \
             pfloat(trans_std, 0.0) != 0.0:
         raise NotImplementedError(
