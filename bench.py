@@ -36,7 +36,7 @@ def main():
     from mxnet_tpu.gluon.model_zoo import vision
 
     batch = int(os.environ.get("BENCH_BATCH", "256"))  # best measured MXU utilization
-    steps = int(os.environ.get("BENCH_STEPS", "20"))
+    steps = int(os.environ.get("BENCH_STEPS", "40"))
     warmup = int(os.environ.get("BENCH_WARMUP", "2"))
     on_tpu = any(d.platform != "cpu" for d in jax.devices())
     if not on_tpu:

@@ -54,6 +54,10 @@ def train_module(args):
     mod.fit(train, eval_data=val, optimizer="sgd",
             optimizer_params={"learning_rate": args.lr, "momentum": 0.9},
             num_epoch=args.epochs,
+            # fit()'s default Uniform(0.01) stalls this MLP for many
+            # epochs; the reference example passes Xavier too
+            # (example/image-classification/common/fit.py:113)
+            initializer=mx.init.Xavier(magnitude=2.0),
             batch_end_callback=mx.callback.Speedometer(args.batch_size, 50))
     return mod.score(val, "acc")
 

@@ -23,3 +23,9 @@ del _op
 
 # control-flow surface (parity: ndarray/contrib.py foreach/while_loop/cond)
 from ..ops.control_flow import foreach, while_loop, cond  # noqa: F401,E402
+
+# DGL graph-sampling ops run host-side on CSR components (see
+# ops/dgl_graph.py for why they are not registry/jit ops)
+from ..ops.dgl_graph import (  # noqa: F401,E402
+    dgl_csr_neighbor_uniform_sample, dgl_csr_neighbor_non_uniform_sample,
+    dgl_subgraph, dgl_graph_compact, dgl_adjacency, edge_id)

@@ -217,9 +217,12 @@ class Trainer:
                 st = o.create_state_multi_precision(i, p.list_data()[0])
                 if st is not None:
                     # committed like the donated jit outputs that will
-                    # replace it — keeps one stable jit cache key
-                    st._rebind(jax.device_put(st._data,
-                                              jax.devices()[0]))
+                    # replace it — keeps one stable jit cache key.  Must
+                    # follow the WEIGHT's device: params living on host
+                    # (e.g. Module on a CPU context) would otherwise mix
+                    # platforms inside one jit call
+                    wdevs = p.list_data()[0]._data.devices()
+                    st._rebind(jax.device_put(st._data, next(iter(wdevs))))
                 upd.states[i] = st
                 upd.states_synced[i] = True
             o._update_count(i)

@@ -303,61 +303,61 @@ class BaseModule:
 
     @property
     def data_names(self):
-        raise NotImplementedError()
+        raise NotImplementedError("data_names: subclass responsibility")
 
     @property
     def output_names(self):
-        raise NotImplementedError()
+        raise NotImplementedError("output_names: subclass responsibility")
 
     @property
     def data_shapes(self):
-        raise NotImplementedError()
+        raise NotImplementedError("data_shapes: subclass responsibility")
 
     @property
     def label_shapes(self):
-        raise NotImplementedError()
+        raise NotImplementedError("label_shapes: subclass responsibility")
 
     @property
     def output_shapes(self):
-        raise NotImplementedError()
+        raise NotImplementedError("output_shapes: subclass responsibility")
 
     def get_params(self):
-        raise NotImplementedError()
+        raise NotImplementedError("get_params: subclass responsibility")
 
     def init_params(self, initializer=None, arg_params=None, aux_params=None,
                     allow_missing=False, force_init=False, allow_extra=False):
-        raise NotImplementedError()
+        raise NotImplementedError("init_params: subclass responsibility")
 
     def install_monitor(self, mon):
-        raise NotImplementedError()
+        raise NotImplementedError("install_monitor: subclass responsibility")
 
     def prepare(self, data_batch, sparse_row_id_fn=None):
         pass
 
     def forward(self, data_batch, is_train=None):
-        raise NotImplementedError()
+        raise NotImplementedError("forward: subclass responsibility")
 
     def backward(self, out_grads=None):
-        raise NotImplementedError()
+        raise NotImplementedError("backward: subclass responsibility")
 
     def get_outputs(self, merge_multi_context=True):
-        raise NotImplementedError()
+        raise NotImplementedError("get_outputs: subclass responsibility")
 
     def get_input_grads(self, merge_multi_context=True):
-        raise NotImplementedError()
+        raise NotImplementedError("get_input_grads: subclass responsibility")
 
     def update(self):
-        raise NotImplementedError()
+        raise NotImplementedError("update: subclass responsibility")
 
     def update_metric(self, eval_metric, labels, pre_sliced=False):
-        raise NotImplementedError()
+        raise NotImplementedError("update_metric: subclass responsibility")
 
     def bind(self, data_shapes, label_shapes=None, for_training=True,
              inputs_need_grad=False, force_rebind=False, shared_module=None,
              grad_req="write"):
-        raise NotImplementedError()
+        raise NotImplementedError("bind: subclass responsibility")
 
     def init_optimizer(self, kvstore="local", optimizer="sgd",
                        optimizer_params=(("learning_rate", 0.01),),
                        force_init=False):
-        raise NotImplementedError()
+        raise NotImplementedError("init_optimizer: subclass responsibility")

@@ -231,7 +231,7 @@ class Module(BaseModule):
         self._exec_group = self._data_shapes = self._label_shapes = None
 
     def reshape(self, data_shapes, label_shapes=None):
-        assert self.binded
+        assert self.binded, "Module not bound"
         self._data_shapes = _descs(data_shapes)
         self._label_shapes = _descs(label_shapes)
         self._exec_group.bind_exec(self._data_shapes, self._label_shapes,
@@ -356,7 +356,7 @@ class Module(BaseModule):
 
     def update(self):
         self._require()
-        assert self.optimizer_initialized
+        assert self.optimizer_initialized, "optimizer not initialized"
         self._params_dirty = True
         if self._update_on_kvstore:
             for idx, _, grads, args in self._grad_walk():
@@ -398,7 +398,7 @@ class Module(BaseModule):
     # Optimizer state persistence
     # ------------------------------------------------------------------
     def save_optimizer_states(self, fname):
-        assert self.optimizer_initialized
+        assert self.optimizer_initialized, "optimizer not initialized"
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
@@ -406,7 +406,7 @@ class Module(BaseModule):
                 f.write(self._updater.get_states())
 
     def load_optimizer_states(self, fname):
-        assert self.optimizer_initialized
+        assert self.optimizer_initialized, "optimizer not initialized"
         if self._update_on_kvstore:
             self._kvstore.load_optimizer_states(fname)
         else:
@@ -414,5 +414,5 @@ class Module(BaseModule):
                 self._updater.set_states(f.read())
 
     def install_monitor(self, mon):
-        assert self.binded
+        assert self.binded, "Module not bound"
         self._exec_group.install_monitor(mon)

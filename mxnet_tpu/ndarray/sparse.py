@@ -198,12 +198,46 @@ class CSRNDArray(BaseSparseNDArray):
     def data(self):
         return self._components()["data"]
 
+    def check_format(self, full_check=True):
+        """Validate CSR invariants (parity: sparse check_format).
+
+        Raises MXNetError on malformed indptr/indices; ``full_check``
+        additionally verifies per-row column bounds on the host."""
+        aux = self._components()
+        indptr = np.asarray(aux["indptr"]._data)
+        indices = np.asarray(aux["indices"]._data)
+        rows, _ = self._sshape
+        if len(indptr) != rows + 1 or indptr[0] != 0:
+            raise MXNetError("csr check_format: bad indptr length/start")
+        if np.any(np.diff(indptr) < 0):
+            raise MXNetError("csr check_format: indptr not non-decreasing")
+        if int(indptr[-1]) != len(indices) or \
+                len(indices) != aux["data"]._data.shape[0]:
+            raise MXNetError("csr check_format: nnz mismatch")
+        if full_check and len(indices):
+            if indices.min() < 0 or indices.max() >= self._sshape[1]:
+                raise MXNetError("csr check_format: column index out of "
+                                 "range")
+            # per-row strictly ascending columns (reference
+            # src/common/utils.h csr_idx_check: duplicates or unsorted
+            # rows are format errors)
+            ascending = np.diff(indices) > 0
+            bound = indptr[1:-1] - 1  # diff positions spanning row breaks
+            bound = bound[(bound >= 0) & (bound < len(ascending))]
+            ascending[bound] = True
+            if not np.all(ascending):
+                raise MXNetError("csr check_format: column indices must "
+                                 "be strictly ascending within each row")
+
 
 def row_sparse_array(arg1, shape=None, ctx=None, dtype=None):
     if isinstance(arg1, (list, tuple)) and len(arg1) == 2:
         data, indices = arg1
+        # array() carries the framework dtype policy: explicit dtype
+        # wins, numpy keeps its dtype (f64 -> f32 with warning), python
+        # lists default to float32
         return RowSparseNDArray(
-            _as_nd(np.asarray(data, dtype=dtype or np.float32)),
+            array(data, dtype=dtype),
             _as_nd(np.asarray(indices)), shape, ctx)
     dense = _as_nd(np.asarray(arg1, dtype=dtype or np.float32)
                    if not isinstance(arg1, NDArray) else arg1)
@@ -214,7 +248,7 @@ def csr_matrix(arg1, shape=None, ctx=None, dtype=None):
     if isinstance(arg1, (list, tuple)) and len(arg1) == 3:
         data, indices, indptr = arg1
         return CSRNDArray(
-            _as_nd(np.asarray(data, dtype=dtype or np.float32)),
+            array(data, dtype=dtype),
             _as_nd(np.asarray(indices)), _as_nd(np.asarray(indptr)),
             shape, ctx)
     return cast_storage(_as_nd(arg1), "csr")

@@ -585,7 +585,7 @@ def _multi_attrs(kw, n):
 
 @register("multi_sgd_update", num_inputs=-1,
           num_outputs=lambda a: pint(a.get("num_weights"), 1),
-          mutate_inputs=tuple(2 * i for i in range(32)),
+          mutate_inputs=tuple(2 * i for i in range(60)),
           differentiable=False)
 def _multi_sgd_update(*arrays, num_weights=None, rescale_grad=1.0,
                       clip_gradient=-1.0, **kw):
@@ -605,7 +605,7 @@ def _multi_sgd_update(*arrays, num_weights=None, rescale_grad=1.0,
 
 @register("multi_sgd_mom_update", num_inputs=-1,
           num_outputs=lambda a: 2 * pint(a.get("num_weights"), 1),
-          mutate_inputs=tuple(x for i in range(21)
+          mutate_inputs=tuple(x for i in range(60)
                               for x in (3 * i, 3 * i + 2)),
           differentiable=False)
 def _multi_sgd_mom_update(*arrays, num_weights=None, momentum=0.0,
@@ -687,6 +687,145 @@ def _quantized_pooling(data, min_range, max_range, **kw):
     return pooling(data, **kw), min_range, max_range
 
 
+@register("_contrib_quantized_concat", num_inputs=-1, num_outputs=3,
+          differentiable=False, aliases=("_quantized_concat",))
+def _quantized_concat(*arrays, num_args=None, dim=1, **kw):
+    """int8 concat with range reconciliation (reference
+    src/operator/quantization/quantized_concat.cc): inputs arrive as
+    (data..., arg0_min, arg0_max, arg1_min, arg1_max, ...); each block is
+    rescaled from its own [min,max] to the widest common range so the
+    int8 codes stay comparable after concatenation."""
+    n = pint(num_args, len(arrays) // 3)
+    datas = arrays[:n]
+    mins = tuple(arrays[n + 2 * i] for i in range(n))
+    maxs = tuple(arrays[n + 2 * i + 1] for i in range(n))
+    out_min = mins[0]
+    out_max = maxs[0]
+    for m in mins[1:]:
+        out_min = jnp.minimum(out_min, m)
+    for m in maxs[1:]:
+        out_max = jnp.maximum(out_max, m)
+    out_scale = jnp.maximum(jnp.abs(out_min), jnp.abs(out_max)) / 127.0
+    blocks = []
+    for d, mn, mx in zip(datas, mins, maxs):
+        scale = jnp.maximum(jnp.abs(mn), jnp.abs(mx)) / 127.0
+        rescaled = jnp.round(d.astype(jnp.float32) * (scale / out_scale))
+        blocks.append(jnp.clip(rescaled, -127, 127).astype(d.dtype))
+    return (jnp.concatenate(blocks, axis=pint(dim, 1)),
+            out_min.reshape(()).astype(jnp.float32),
+            out_max.reshape(()).astype(jnp.float32))
+
+
+@register("_scatter_set_nd", num_inputs=3, mutate_inputs=(0,))
+def _scatter_set_nd(lhs, indices, rhs, shape=None, **kw):
+    """Write rhs into lhs at gather_nd-style indices (reference
+    src/operator/tensor/indexing_op.cc _scatter_set_nd — the kernel
+    behind sliced assignment with fancy indices)."""
+    idx = tuple(indices[i].astype(jnp.int32)
+                for i in range(indices.shape[0]))
+    return lhs.at[idx].set(rhs)
+
+
+@register("multi_mp_sgd_update", num_inputs=-1,
+          num_outputs=lambda a: 2 * pint(a.get("num_weights"), 1),
+          mutate_inputs=tuple(x for i in range(60)   # ref caps at 60 weights
+                              for x in (3 * i, 3 * i + 2)),
+          differentiable=False)
+def _multi_mp_sgd_update(*arrays, num_weights=None, rescale_grad=1.0,
+                         clip_gradient=-1.0, **kw):
+    """Fused multi-tensor SGD with fp32 master weights (reference
+    optimizer_op.cc multi_mp_sgd_update): input triples
+    (weight, grad, weight32) per parameter."""
+    n = pint(num_weights, len(arrays) // 3)
+    lrs, wds = _multi_attrs(kw, n)
+    rs = pfloat(rescale_grad, 1.0)
+    cg = pfloat(clip_gradient, -1.0)
+    outs = []
+    for i in range(n):
+        w, g, w32 = arrays[3 * i], arrays[3 * i + 1], arrays[3 * i + 2]
+        g = g.astype(jnp.float32) * rs
+        if cg > 0:
+            g = jnp.clip(g, -cg, cg)
+        new_w32 = w32 - lrs[i] * (g + wds[i] * w32)
+        outs.extend([new_w32.astype(w.dtype), new_w32])
+    return tuple(outs)
+
+
+@register("multi_mp_sgd_mom_update", num_inputs=-1,
+          num_outputs=lambda a: 3 * pint(a.get("num_weights"), 1),
+          mutate_inputs=tuple(x for i in range(60)
+                              for x in (4 * i, 4 * i + 2, 4 * i + 3)),
+          differentiable=False)
+def _multi_mp_sgd_mom_update(*arrays, num_weights=None, momentum=0.0,
+                             rescale_grad=1.0, clip_gradient=-1.0, **kw):
+    """Momentum variant: input quadruples (weight, grad, mom, weight32)."""
+    n = pint(num_weights, len(arrays) // 4)
+    lrs, wds = _multi_attrs(kw, n)
+    mom = pfloat(momentum, 0.0)
+    rs = pfloat(rescale_grad, 1.0)
+    cg = pfloat(clip_gradient, -1.0)
+    outs = []
+    for i in range(n):
+        w, g, m, w32 = (arrays[4 * i], arrays[4 * i + 1],
+                        arrays[4 * i + 2], arrays[4 * i + 3])
+        g = g.astype(jnp.float32) * rs
+        if cg > 0:
+            g = jnp.clip(g, -cg, cg)
+        new_m = mom * m - lrs[i] * (g + wds[i] * w32)
+        new_w32 = w32 + new_m
+        outs.extend([new_w32.astype(w.dtype), new_m, new_w32])
+    return tuple(outs)
+
+
+@register("Correlation", num_inputs=2, num_outputs=1)
+def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
+                stride2=1, pad_size=0, is_multiply=True, **kw):
+    """FlowNet correlation layer (reference src/operator/correlation.cc).
+
+    For every output pixel, correlates a kernel_size² patch of data1 with
+    patches of data2 displaced on a (2R+1)² grid (R = max_displacement /
+    stride2), averaged over the patch and channels.  The displacement
+    loop is a static Python loop — XLA sees (2R+1)² fused
+    slice·multiply·reduce_window programs, all MXU/VPU friendly, instead
+    of the reference's hand-rolled CUDA kernel.
+    """
+    ks = pint(kernel_size, 1)
+    md = pint(max_displacement, 1)
+    s1 = pint(stride1, 1)
+    s2 = pint(stride2, 1)
+    pad = pint(pad_size, 0)
+    mul = pbool(is_multiply, True)
+
+    n, c, h, w = data1.shape
+    p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    kr = (ks - 1) // 2
+    border = md + kr
+    ph, pw = h + 2 * pad, w + 2 * pad
+    top_h = -(-(ph - 2 * border) // s1)  # ceil div, reference shape rule
+    top_w = -(-(pw - 2 * border) // s1)
+    grid_r = md // s2
+    grid_w = 2 * grid_r + 1
+    sumelems = ks * ks * c
+
+    ext_h = (top_h - 1) * s1 + ks
+    ext_w = (top_w - 1) * s1 + ks
+    a = p1[:, :, md:md + ext_h, md:md + ext_w]
+    outs = []
+    for pi in range(grid_w):          # vertical displacement (slow axis)
+        s2p = (pi - grid_r) * s2
+        for oi in range(grid_w):      # horizontal (fast axis)
+            s2o = (oi - grid_r) * s2
+            b = p2[:, :, md + s2p:md + s2p + ext_h,
+                   md + s2o:md + s2o + ext_w]
+            e = a * b if mul else jnp.abs(a - b)
+            e = jnp.sum(e, axis=1)    # over channels -> (N, ext_h, ext_w)
+            win = lax.reduce_window(e, 0.0, lax.add, (1, ks, ks),
+                                    (1, s1, s1), "VALID")
+            outs.append(win / sumelems)
+    return jnp.stack(outs, axis=1)
+
+
 # misc aliases: MultiProposal IS batched Proposal; SparseEmbedding's
 # forward equals Embedding (sparse grad handled at the NDArray layer);
 # SyncBatchNorm = BatchNorm (stat sync is the mesh program's psum when
@@ -699,3 +838,86 @@ try:
     alias("BatchNorm", "_contrib_SyncBatchNorm")
 except KeyError:
     pass
+
+
+@register("_sparse_retain", num_inputs=2)
+def _sparse_retain_op(data, indices, **kw):
+    """Dense-view sparse_retain (reference sparse_retain.cc): zero every
+    row not listed.  The component-level (memory ∝ nnz) path lives in
+    ndarray.sparse.retain; this registry entry serves traced graphs and
+    dense fallbacks."""
+    keep = jnp.zeros((data.shape[0],), jnp.bool_).at[
+        indices.astype(jnp.int32)].set(True)
+    return jnp.where(keep.reshape((-1,) + (1,) * (data.ndim - 1)), data, 0)
+
+
+@register("_sparse_adagrad_update", num_inputs=3, mutate_inputs=(0, 2),
+          num_outputs=2, differentiable=False)
+def _sparse_adagrad_update(weight, grad, history, lr=None, epsilon=1e-7,
+                           rescale_grad=1.0, clip_gradient=-1.0, wd=0.0,
+                           **kw):
+    """AdaGrad step (reference optimizer_op.cc _sparse_adagrad_update).
+    The reference skips absent rows of a row_sparse grad; with dense
+    grads those rows are zero, so history and weight are unchanged there
+    — numerically identical, no sparsity special-case needed."""
+    g = grad * pfloat(rescale_grad, 1.0)
+    cg = pfloat(clip_gradient, -1.0)
+    if cg > 0:
+        g = jnp.clip(g, -cg, cg)
+    wd_f = pfloat(wd, 0.0)
+    if wd_f:
+        g = g + wd_f * weight
+    new_hist = history + jnp.square(g)
+    new_w = weight - pfloat(lr) * g / (jnp.sqrt(new_hist)
+                                       + pfloat(epsilon, 1e-7))
+    return new_w, new_hist
+
+
+# Legacy spellings kept registered by the reference for old symbol-json
+# compat (CamelCase operator-overload names from ndarray.cc, *_v1 ops,
+# renamed contribs).  Each maps onto the one modern kernel; *_v1 layer
+# semantics differ only in cuDNN-era knobs that have no TPU meaning.
+_LEGACY_ALIASES = [
+    ("elemwise_add", "_Plus"), ("elemwise_sub", "_Minus"),
+    ("elemwise_mul", "_Mul"), ("elemwise_div", "_Div"),
+    ("elemwise_add", "_plus"), ("elemwise_sub", "_minus"),
+    ("_plus_scalar", "_PlusScalar"), ("_minus_scalar", "_MinusScalar"),
+    ("_rminus_scalar", "_RMinusScalar"), ("_mul_scalar", "_MulScalar"),
+    ("_div_scalar", "_DivScalar"), ("_rdiv_scalar", "_RDivScalar"),
+    ("_mod", "_Mod"), ("_mod_scalar", "_ModScalar"),
+    ("_rmod_scalar", "_RModScalar"),
+    ("_power", "_Power"), ("_power_scalar", "_PowerScalar"),
+    ("_rpower_scalar", "_RPowerScalar"),
+    ("_maximum", "_Maximum"), ("_minimum", "_Minimum"),
+    ("_maximum_scalar", "_MaximumScalar"),
+    ("_minimum_scalar", "_MinimumScalar"),
+    ("_hypot", "_Hypot"), ("_hypot_scalar", "_HypotScalar"),
+    ("_equal", "_Equal"), ("_equal_scalar", "_EqualScalar"),
+    ("_not_equal", "_Not_Equal"), ("_not_equal_scalar", "_NotEqualScalar"),
+    ("_greater", "_Greater"), ("_greater_scalar", "_GreaterScalar"),
+    ("_greater_equal", "_Greater_Equal"),
+    ("_greater_equal_scalar", "_GreaterEqualScalar"),
+    ("_lesser", "_Lesser"), ("_lesser_scalar", "_LesserScalar"),
+    ("_lesser_equal", "_Lesser_Equal"),
+    ("_lesser_equal_scalar", "_LesserEqualScalar"),
+    ("_logical_and", "_Logical_And"), ("_logical_or", "_Logical_Or"),
+    ("_logical_xor", "_Logical_Xor"),
+    ("_logical_and_scalar", "_LogicalAndScalar"),
+    ("_logical_or_scalar", "_LogicalOrScalar"),
+    ("_logical_xor_scalar", "_LogicalXorScalar"),
+    ("broadcast_add", "broadcast_plus"), ("broadcast_sub", "broadcast_minus"),
+    ("pick", "choose_element_0index"),
+    ("_slice_assign", "_crop_assign"),
+    ("_slice_assign_scalar", "_crop_assign_scalar"),
+    ("BatchNorm", "BatchNorm_v1"), ("BatchNorm", "CuDNNBatchNorm"),
+    ("Convolution", "Convolution_v1"), ("Pooling", "Pooling_v1"),
+    ("_contrib_box_nms", "_contrib_box_non_maximum_suppression"),
+    ("_ravel_multi_index", "ravel_multi_index"),
+    ("_unravel_index", "unravel_index"),
+]
+for _target, _alias_name in _LEGACY_ALIASES:
+    try:
+        alias(_target, _alias_name)
+    except KeyError:
+        pass
+del _LEGACY_ALIASES

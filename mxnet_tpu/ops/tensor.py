@@ -343,6 +343,48 @@ def _linalg_trsm(A, B, transpose=False, rightside=False, lower=True, alpha=1.0, 
     return X
 
 
+@register("_linalg_trmm", num_inputs=2, aliases=("linalg_trmm",))
+def _linalg_trmm(A, B, transpose=False, rightside=False, lower=True,
+                 alpha=1.0, **kw):
+    """BLAS3 trmm (reference la_op.cc:296): alpha*op(A)*B, or B*op(A)
+    with rightside=True; A lower (or upper) triangular."""
+    tri = jnp.tril(A) if pbool(lower, True) else jnp.triu(A)
+    if pbool(transpose):
+        tri = jnp.swapaxes(tri, -1, -2)
+    prod = jnp.matmul(B, tri) if pbool(rightside) else jnp.matmul(tri, B)
+    return pfloat(alpha, 1.0) * prod
+
+
+@register("_linalg_potri", aliases=("linalg_potri",))
+def _linalg_potri(A, lower=True, **kw):
+    """Inverse of an SPD matrix given its Cholesky factor A (reference
+    la_op.cc:238): returns inv(A·Aᵀ) for lower, inv(Aᵀ·A) for upper —
+    via two triangular solves against I (no explicit inverse chain)."""
+    lo = pbool(lower, True)
+    eye = jnp.broadcast_to(jnp.eye(A.shape[-1], dtype=A.dtype), A.shape)
+    Ainv = jax.scipy.linalg.solve_triangular(A, eye, lower=lo)
+    # inv(A·Aᵀ) = A⁻ᵀ·A⁻¹ ; inv(Aᵀ·A) = A⁻¹·A⁻ᵀ
+    if lo:
+        return jnp.matmul(jnp.swapaxes(Ainv, -1, -2), Ainv)
+    return jnp.matmul(Ainv, jnp.swapaxes(Ainv, -1, -2))
+
+
+@register("_linalg_gelqf", num_outputs=2, aliases=("linalg_gelqf",))
+def _linalg_gelqf(A, **kw):
+    """LQ factorization A = L·Q with row-orthonormal Q (reference
+    la_op.cc:521), computed as the transposed QR of Aᵀ on-device."""
+    q, r = jnp.linalg.qr(jnp.swapaxes(A, -1, -2), mode="reduced")
+    return jnp.swapaxes(q, -1, -2), jnp.swapaxes(r, -1, -2)
+
+
+@register("_linalg_syevd", num_outputs=2, aliases=("linalg_syevd",))
+def _linalg_syevd(A, **kw):
+    """Symmetric eigendecomposition (reference la_op.cc): returns (U, L)
+    with U·A = diag(L)·U, i.e. U's *rows* are eigenvectors."""
+    w, v = jnp.linalg.eigh(A)
+    return jnp.swapaxes(v, -1, -2), w
+
+
 # ---------------------------------------------------------------------------
 # matrix manipulation (reference: matrix_op.cc)
 # ---------------------------------------------------------------------------

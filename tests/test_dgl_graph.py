@@ -1,0 +1,154 @@
+"""DGL graph-sampling ops — ports of the reference
+tests/python/unittest/test_dgl_graph.py basic cases."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _demo_graph():
+    # fully-connected 5-vertex graph (minus self loops), edge ids 1..20
+    data = np.arange(1, 21, dtype=np.int64)
+    indices = np.array([1, 2, 3, 4, 0, 2, 3, 4, 0, 1, 3, 4,
+                        0, 1, 2, 4, 0, 1, 2, 3], dtype=np.int64)
+    indptr = np.array([0, 4, 8, 12, 16, 20], dtype=np.int64)
+    return nd.sparse.csr_matrix((data, indices, indptr), shape=(5, 5))
+
+
+def _check_uniform(out, num_hops, max_num_vertices):
+    sample_id, sub_csr, layer = out
+    assert sample_id.shape == (max_num_vertices + 1,)
+    num_vertices = int(sample_id.asnumpy()[-1])
+    assert 0 < num_vertices <= max_num_vertices
+    sub_csr.check_format(full_check=True)
+    indptr = sub_csr.indptr.asnumpy()
+    assert np.all(indptr[num_vertices:] == indptr[num_vertices])
+    layers = layer.asnumpy()
+    assert np.all(layers[:num_vertices] <= num_hops)
+    assert np.all(layers[:num_vertices] >= 0)
+    return num_vertices
+
+
+def _check_compact(sub_csr, sample_id, num_nodes):
+    compact = nd.contrib.dgl_graph_compact(
+        sub_csr, sample_id, graph_sizes=num_nodes, return_mapping=False)
+    assert compact.shape == (num_nodes, num_nodes)
+    assert np.array_equal(compact.indptr.asnumpy(),
+                          sub_csr.indptr.asnumpy()[:num_nodes + 1])
+    id_arr = sample_id.asnumpy()
+    sub_indices = compact.indices.asnumpy()
+    for i, local in enumerate(sub_indices):
+        assert id_arr[local] == sub_csr.indices.asnumpy()[i]
+
+
+@pytest.mark.parametrize("seeds,num_hops,num_neighbor,max_v", [
+    ([0, 1, 2, 3, 4], 1, 2, 5),
+    ([0], 1, 1, 4),
+    ([0], 2, 1, 3),
+    ([0, 2, 4], 1, 2, 5),
+])
+def test_uniform_sample(seeds, num_hops, num_neighbor, max_v):
+    g = _demo_graph()
+    seed = nd.array(np.array(seeds, dtype=np.int64))
+    out = nd.contrib.dgl_csr_neighbor_uniform_sample(
+        g, seed, num_args=2, num_hops=num_hops, num_neighbor=num_neighbor,
+        max_num_vertices=max_v)
+    assert len(out) == 3
+    nv = _check_uniform(out, num_hops, max_v)
+    _check_compact(out[1], out[0], nv)
+
+
+def test_uniform_sample_multiple_seeds():
+    g = _demo_graph()
+    s1 = nd.array(np.array([0, 1], dtype=np.int64))
+    s2 = nd.array(np.array([2, 3], dtype=np.int64))
+    out = nd.contrib.dgl_csr_neighbor_uniform_sample(
+        g, s1, s2, num_args=3, num_hops=1, num_neighbor=2,
+        max_num_vertices=5)
+    assert len(out) == 6  # grouped: ids x2, csrs x2, layers x2
+    _check_uniform([out[0], out[2], out[4]], 1, 5)
+    _check_uniform([out[1], out[3], out[5]], 1, 5)
+
+
+def test_non_uniform_sample():
+    g = _demo_graph()
+    prob = nd.array(np.array([0.9, 0.8, 0.2, 0.4, 0.1], dtype=np.float32))
+    seed = nd.array(np.array([0, 1, 2, 3, 4], dtype=np.int64))
+    out = nd.contrib.dgl_csr_neighbor_non_uniform_sample(
+        g, prob, seed, num_args=3, num_hops=1, num_neighbor=2,
+        max_num_vertices=5)
+    assert len(out) == 4
+    sample_id, sub_csr, sub_prob, layer = out
+    nv = _check_uniform([sample_id, sub_csr, layer], 1, 5)
+    assert sub_prob.shape == (5,)
+    ids = sample_id.asnumpy()[:nv]
+    assert np.allclose(sub_prob.asnumpy()[:nv], prob.asnumpy()[ids])
+
+
+def test_subgraph():
+    x = np.array([[1, 0, 0, 2],
+                  [3, 0, 4, 0],
+                  [0, 5, 0, 0],
+                  [0, 6, 7, 0]], dtype=np.int64)
+    g = nd.sparse.csr_matrix(x)
+    verts = nd.array(np.array([0, 1, 3], dtype=np.int64))
+    sub, mapping = nd.contrib.dgl_subgraph(g, verts, num_args=2,
+                                           return_mapping=True)
+    assert sub.shape == (3, 3)
+    sub.check_format(full_check=True)
+    # induced edges: 0->3 (old id 2), 1->0 (3), 3->1 (6); renumbered
+    dense = np.zeros((3, 3), np.int64)
+    old = np.zeros((3, 3), np.int64)
+    vid = [0, 1, 3]
+    sub_np, map_np = sub.asnumpy(), mapping.asnumpy()
+    for i, vi in enumerate(vid):
+        for j, vj in enumerate(vid):
+            if x[vi, vj]:
+                assert map_np[i, j] == x[vi, vj]
+            else:
+                assert map_np[i, j] == 0
+    # new edge ids are 0..nnz-1 (0 indistinguishable from "no edge" in
+    # dense view; check via components)
+    assert np.array_equal(np.sort(sub.data.asnumpy()),
+                          np.arange(len(sub.data.asnumpy())))
+
+
+def test_adjacency():
+    g = _demo_graph()
+    adj = nd.contrib.dgl_adjacency(g)
+    assert adj.dtype == np.float32
+    assert np.array_equal(adj.indices.asnumpy(), g.indices.asnumpy())
+    assert np.array_equal(adj.indptr.asnumpy(), g.indptr.asnumpy())
+    assert np.all(adj.data.asnumpy() == 1.0)
+
+
+def test_edge_id():
+    g = _demo_graph()
+    u = nd.array(np.array([0, 0, 2], dtype=np.int64))
+    v = nd.array(np.array([1, 0, 3], dtype=np.int64))
+    out = nd.contrib.edge_id(g, u, v).asnumpy()
+    assert out[0] == 1    # edge 0->1 has id 1
+    assert out[1] == -1   # no self loop
+    assert out[2] == 11   # edge 2->3 has id 11
+
+
+def test_mp_adamw_update():
+    rng = np.random.RandomState(0)
+    w32 = rng.rand(4, 3).astype(np.float32)
+
+    weight = nd.array(w32).astype(np.float16)
+    weight32 = nd.array(w32)
+    grad = nd.array(rng.rand(4, 3).astype(np.float32)).astype(np.float16)
+    mean = nd.zeros((4, 3))
+    var = nd.zeros((4, 3))
+    from mxnet_tpu.ndarray.ndarray import _invoke_nd
+    _invoke_nd("_mp_adamw_update",
+               [weight, grad, mean, var, weight32],
+               {"lr": 0.1, "wd": 0.01, "eta": 1.0})
+    # master stays fp32, low-precision weight tracks it
+    assert weight32.dtype == np.float32
+    assert weight.dtype == np.float16
+    assert np.allclose(weight.asnumpy(),
+                       weight32.asnumpy().astype(np.float16), atol=1e-3)
+    assert not np.allclose(weight32.asnumpy(), w32)  # it moved

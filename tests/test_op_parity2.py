@@ -1,0 +1,175 @@
+"""Round-2 op-parity additions: linalg gelqf/potri/syevd/trmm,
+Correlation, scatter_set_nd, multi-tensor mp-sgd, quantized concat,
+legacy alias table."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.ndarray.ndarray import _invoke_nd
+
+
+def _rand_spd(n, rng):
+    a = rng.rand(n, n).astype(np.float32)
+    return a @ a.T + n * np.eye(n, dtype=np.float32)
+
+
+def test_linalg_gelqf():
+    rng = np.random.RandomState(0)
+    a = rng.rand(2, 3).astype(np.float32)
+    q, l = _invoke_nd("_linalg_gelqf", [nd.array(a)], {})
+    qn, ln = q.asnumpy(), l.asnumpy()
+    assert qn.shape == (2, 3) and ln.shape == (2, 2)
+    assert np.allclose(ln @ qn, a, atol=1e-5)               # A = L Q
+    assert np.allclose(qn @ qn.T, np.eye(2), atol=1e-5)     # rows orthonormal
+    assert np.allclose(np.triu(ln, 1), 0, atol=1e-6)        # L lower-tri
+
+
+def test_linalg_potri():
+    rng = np.random.RandomState(1)
+    spd = _rand_spd(4, rng)
+    chol = np.linalg.cholesky(spd).astype(np.float32)
+    out = _invoke_nd("_linalg_potri", [nd.array(chol)], {}).asnumpy()
+    assert np.allclose(out, np.linalg.inv(spd), rtol=1e-3, atol=1e-4)
+
+
+def test_linalg_syevd():
+    rng = np.random.RandomState(2)
+    a = _rand_spd(5, rng)
+    u, l = _invoke_nd("_linalg_syevd", [nd.array(a)], {})
+    un, ln = u.asnumpy(), l.asnumpy()
+    # U A = diag(L) U
+    assert np.allclose(un @ a, np.diag(ln) @ un, atol=1e-3)
+    assert np.allclose(un @ un.T, np.eye(5), atol=1e-4)
+
+
+@pytest.mark.parametrize("rightside,transpose", [(False, False),
+                                                 (True, False),
+                                                 (False, True)])
+def test_linalg_trmm(rightside, transpose):
+    rng = np.random.RandomState(3)
+    a = np.tril(rng.rand(3, 3)).astype(np.float32)
+    b = rng.rand(3, 4).astype(np.float32) if not rightside \
+        else rng.rand(4, 3).astype(np.float32)
+    out = _invoke_nd("_linalg_trmm", [nd.array(a), nd.array(b)],
+                     {"rightside": rightside, "transpose": transpose,
+                      "alpha": 2.0}).asnumpy()
+    op_a = a.T if transpose else a
+    want = 2.0 * (b @ op_a if rightside else op_a @ b)
+    assert np.allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def _correlation_ref(d1, d2, ks, md, s1, s2, pad, mul):
+    """Straight port of the reference CPU loop (correlation.cc:56-80)."""
+    n, c, h, w = d1.shape
+    p1 = np.pad(d1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = np.pad(d2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    kr = (ks - 1) // 2
+    border = md + kr
+    ph, pw = h + 2 * pad, w + 2 * pad
+    top_h = int(np.ceil((ph - 2 * border) / s1))
+    top_w = int(np.ceil((pw - 2 * border) / s1))
+    gr = md // s2
+    gw = 2 * gr + 1
+    out = np.zeros((n, gw * gw, top_h, top_w), np.float32)
+    for b in range(n):
+        for i in range(top_h):
+            for j in range(top_w):
+                x1, y1 = j * s1 + md, i * s1 + md
+                for tc in range(gw * gw):
+                    s2o = (tc % gw - gr) * s2
+                    s2p = (tc // gw - gr) * s2
+                    x2, y2 = x1 + s2o, y1 + s2p
+                    patch1 = p1[b, :, y1:y1 + ks, x1:x1 + ks]
+                    patch2 = p2[b, :, y2:y2 + ks, x2:x2 + ks]
+                    v = (patch1 * patch2 if mul
+                         else np.abs(patch1 - patch2)).sum()
+                    out[b, tc, i, j] = v / (ks * ks * c)
+    return out
+
+
+@pytest.mark.parametrize("ks,md,s1,s2,pad,mul", [
+    (1, 1, 1, 1, 1, True),
+    (3, 2, 2, 1, 2, True),
+    (1, 2, 1, 2, 2, False),
+])
+def test_correlation(ks, md, s1, s2, pad, mul):
+    rng = np.random.RandomState(4)
+    d1 = rng.rand(2, 3, 7, 7).astype(np.float32)
+    d2 = rng.rand(2, 3, 7, 7).astype(np.float32)
+    out = _invoke_nd("Correlation", [nd.array(d1), nd.array(d2)],
+                     {"kernel_size": ks, "max_displacement": md,
+                      "stride1": s1, "stride2": s2, "pad_size": pad,
+                      "is_multiply": mul}).asnumpy()
+    want = _correlation_ref(d1, d2, ks, md, s1, s2, pad, mul)
+    assert out.shape == want.shape
+    assert np.allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_scatter_set_nd():
+    lhs = nd.zeros((3, 4))
+    indices = nd.array(np.array([[0, 2], [1, 3]], np.int64))
+    rhs = nd.array(np.array([5.0, 7.0], np.float32))
+    out = _invoke_nd("_scatter_set_nd", [lhs, indices, rhs],
+                     {"shape": (3, 4)})
+    want = np.zeros((3, 4), np.float32)
+    want[0, 1] = 5.0
+    want[2, 3] = 7.0
+    assert np.allclose(out.asnumpy(), want)
+
+
+def test_multi_mp_sgd_update():
+    rng = np.random.RandomState(5)
+    ws32 = [rng.rand(3).astype(np.float32) for _ in range(2)]
+    arrays = []
+    for w32 in ws32:
+        arrays += [nd.array(w32).astype(np.float16),
+                   nd.array(rng.rand(3).astype(np.float32)),
+                   nd.array(w32)]
+    _invoke_nd("multi_mp_sgd_update", arrays,
+               {"num_weights": 2, "lrs": (0.1, 0.2), "wds": (0.0, 0.0)})
+    for i in range(2):
+        w, w32 = arrays[3 * i], arrays[3 * i + 2]
+        assert w.dtype == np.float16
+        assert w32.dtype == np.float32
+        assert not np.allclose(w32.asnumpy(), ws32[i])
+        assert np.allclose(w.asnumpy(),
+                           w32.asnumpy().astype(np.float16), atol=1e-3)
+
+
+def test_quantized_concat():
+    a = np.array([[100, -100]], np.int8)
+    b = np.array([[50, 25]], np.int8)
+    # reference input order: data..., arg0_min, arg0_max, arg1_min, ...
+    out, omin, omax = _invoke_nd(
+        "_contrib_quantized_concat",
+        [nd.array(a), nd.array(b),
+         nd.array(np.float32([-1.0])), nd.array(np.float32([1.0])),
+         nd.array(np.float32([-0.5])), nd.array(np.float32([0.5]))],
+        {"num_args": 2, "dim": 1})
+    assert out.shape == (1, 4)
+    assert float(omin.asnumpy()) == -1.0 and float(omax.asnumpy()) == 1.0
+    # block a already in the common range; block b rescaled by 0.5
+    got = out.asnumpy()
+    assert np.array_equal(got[:, :2], a)
+    assert np.array_equal(got[:, 2:], np.array([[25, 12]], np.int8))
+
+
+def test_legacy_aliases():
+    from mxnet_tpu.ops.registry import get_op
+    pairs = [("_Plus", "elemwise_add"), ("_MulScalar", "_mul_scalar"),
+             ("choose_element_0index", "pick"),
+             ("Pooling_v1", "Pooling"), ("BatchNorm_v1", "BatchNorm"),
+             ("broadcast_plus", "broadcast_add"),
+             ("_contrib_box_non_maximum_suppression", "_contrib_box_nms"),
+             ("unravel_index", "_unravel_index")]
+    for legacy, modern in pairs:
+        assert get_op(legacy) is get_op(modern)
+
+
+def test_uppercase_binary_matches_lowercase():
+    rng = np.random.RandomState(6)
+    a = nd.array(rng.rand(2, 3).astype(np.float32))
+    b = nd.array(rng.rand(2, 3).astype(np.float32))
+    got = _invoke_nd("_Maximum", [a, b], {}).asnumpy()
+    assert np.allclose(got, np.maximum(a.asnumpy(), b.asnumpy()))
