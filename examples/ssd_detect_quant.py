@@ -1,0 +1,198 @@
+"""SSD detection + INT8 quantization — counterpart of the reference's
+example/ssd + example/quantization flow (BASELINE config 5).
+
+Builds a VGG16-style SSD detector symbolically (two prediction scales),
+trains its heads briefly on synthetic boxes via the in-graph
+MultiBoxTarget + SoftmaxOutput/smooth_l1 losses (the reference SSD
+training symbol shape), then runs MultiBoxDetection inference in fp32,
+INT8-quantizes the conv/fc layers with `contrib.quantization.
+quantize_model`, and compares detections and throughput.
+
+Everything is synthetic and shape-reduced so the example runs offline in
+about a minute; the graph structure (anchor generation, target encoding,
+NMS decode, int8 graph rewrite) is the real pipeline.
+"""
+import argparse
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.contrib import quantization as qmod
+
+
+def vgg_stage(data, num_filter, layers, name):
+    """VGG block: `layers` 3x3 convs + relu, then 2x2 max pool."""
+    h = data
+    for i in range(layers):
+        h = mx.sym.Convolution(h, kernel=(3, 3), pad=(1, 1),
+                               num_filter=num_filter,
+                               name="%s_conv%d" % (name, i))
+        h = mx.sym.Activation(h, act_type="relu")
+    return mx.sym.Pooling(h, kernel=(2, 2), stride=(2, 2), pool_type="max")
+
+
+def build_ssd(num_classes, sizes=((0.2, 0.35), (0.5, 0.75)),
+              ratios=(1.0, 2.0, 0.5), width=32):
+    """Two-scale SSD over a reduced VGG16 trunk.
+
+    Returns (anchors, cls_preds, loc_preds) symbols — the canonical SSD
+    triple that both the training and detection graphs are built from."""
+    data = mx.sym.var("data")
+    h = vgg_stage(data, width, 2, "stage1")       # /2
+    h = vgg_stage(h, width * 2, 2, "stage2")      # /4
+    f1 = h                                        # first prediction scale
+    f2 = vgg_stage(h, width * 4, 3, "stage3")     # /8, second scale
+
+    num_anchors = len(sizes[0]) + len(ratios) - 1
+    anchors, cls_heads, loc_heads = [], [], []
+    for i, feat in enumerate((f1, f2)):
+        anchors.append(mx.sym.Flatten(mx.sym.contrib.MultiBoxPrior(
+            feat, sizes=sizes[i], ratios=ratios)))
+        cls = mx.sym.Convolution(
+            feat, kernel=(3, 3), pad=(1, 1),
+            num_filter=num_anchors * (num_classes + 1),
+            name="cls_head%d" % i)
+        loc = mx.sym.Convolution(
+            feat, kernel=(3, 3), pad=(1, 1), num_filter=num_anchors * 4,
+            name="loc_head%d" % i)
+        # (N, A*C, H, W) -> (N, H*W*A, C) rows per anchor
+        cls_heads.append(mx.sym.Flatten(
+            mx.sym.transpose(cls, axes=(0, 2, 3, 1))))
+        loc_heads.append(mx.sym.Flatten(
+            mx.sym.transpose(loc, axes=(0, 2, 3, 1))))
+    anchors = mx.sym.Reshape(mx.sym.Concat(*anchors, dim=1),
+                             shape=(1, -1, 4))
+    cls_preds = mx.sym.transpose(
+        mx.sym.Reshape(mx.sym.Concat(*cls_heads, dim=1),
+                       shape=(0, -1, num_classes + 1)), axes=(0, 2, 1))
+    loc_preds = mx.sym.Concat(*loc_heads, dim=1)
+    return anchors, cls_preds, loc_preds
+
+
+def training_symbol(num_classes):
+    """SSD training graph: MultiBoxTarget encodes gt boxes in-graph,
+    SoftmaxOutput + smooth_l1 produce the joint objective (the reference
+    example/ssd/symbol/symbol_builder.py shape)."""
+    anchors, cls_preds, loc_preds = build_ssd(num_classes)
+    label = mx.sym.var("label")
+    loc_t, loc_mask, cls_t = mx.sym.contrib.MultiBoxTarget(
+        anchors, label, cls_preds, overlap_threshold=0.5,
+        negative_mining_ratio=3.0, negative_mining_thresh=0.5)
+    cls_loss = mx.sym.SoftmaxOutput(cls_preds, cls_t, ignore_label=-1,
+                                    use_ignore=True,
+                                    multi_output=True,
+                                    normalization="valid",
+                                    name="cls_prob")
+    loc_diff = mx.sym.smooth_l1(loc_mask * (loc_preds - loc_t), scalar=1.0)
+    loc_loss = mx.sym.MakeLoss(mx.sym.mean(loc_diff), name="loc_loss")
+    return mx.sym.Group([cls_loss, loc_loss])
+
+
+def detection_symbol(num_classes):
+    anchors, cls_preds, loc_preds = build_ssd(num_classes)
+    cls_prob = mx.sym.softmax(cls_preds, axis=1)
+    return mx.sym.contrib.MultiBoxDetection(
+        cls_prob, loc_preds, anchors, nms_threshold=0.45,
+        nms_topk=100)
+
+
+def synthetic_batch(rng, batch, num_classes, size):
+    """Images with one bright square each; the label encodes its box."""
+    x = rng.rand(batch, 3, size, size).astype(np.float32) * 0.1
+    labels = np.full((batch, 1, 5), -1, np.float32)
+    for b in range(batch):
+        cls = rng.randint(num_classes)
+        w = rng.uniform(0.2, 0.5)
+        x1, y1 = rng.uniform(0, 1 - w), rng.uniform(0, 1 - w)
+        px = slice(int(y1 * size), int((y1 + w) * size))
+        py = slice(int(x1 * size), int((x1 + w) * size))
+        x[b, cls % 3, px, py] = 1.0
+        labels[b, 0] = [cls, x1, y1, x1 + w, y1 + w]
+    return x, labels
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--num-classes", type=int, default=3)
+    p.add_argument("--image-size", type=int, default=64)
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--train-steps", type=int, default=30)
+    p.add_argument("--lr", type=float, default=0.05)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+    rng = np.random.RandomState(0)
+
+    # --- train the detector heads briefly on synthetic boxes
+    tsym = training_symbol(args.num_classes)
+    X, L = synthetic_batch(rng, args.batch_size, args.num_classes,
+                           args.image_size)
+    mod = mx.mod.Module(tsym, data_names=("data",), label_names=("label",))
+    mod.bind(data_shapes=[("data", X.shape)],
+             label_shapes=[("label", L.shape)], for_training=True)
+    mod.init_params(mx.init.Xavier(magnitude=2.0))
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": args.lr,
+                                         "momentum": 0.9})
+    from mxnet_tpu.io.io import DataBatch
+
+    for step in range(args.train_steps):
+        X, L = synthetic_batch(rng, args.batch_size, args.num_classes,
+                               args.image_size)
+        batch = DataBatch(data=[nd.array(X)], label=[nd.array(L)])
+        mod.forward(batch, is_train=True)
+        mod.backward()
+        mod.update()
+        if step % 10 == 0:
+            cls_prob = mod.get_outputs()[0].asnumpy()
+            logging.info("step %d  mean max cls prob %.3f", step,
+                         float(cls_prob.max(axis=1).mean()))
+    arg_params, aux_params = mod.get_params()
+
+    # --- fp32 detection
+    dsym = detection_symbol(args.num_classes)
+    Xv, Lv = synthetic_batch(rng, args.batch_size, args.num_classes,
+                             args.image_size)
+    dex = dsym.bind(args=dict(arg_params, data=nd.array(Xv)))
+    det_fp32_np = dex.forward()[0].asnumpy()   # compile + warm
+    t0 = time.time()
+    det_fp32_np = dex.forward()[0].asnumpy()
+    fp32_t = time.time() - t0
+    kept = det_fp32_np[0][det_fp32_np[0, :, 0] >= 0]
+    logging.info("fp32 detections (img 0, top 3): %s",
+                 np.round(kept[:3], 3).tolist())
+
+    # --- INT8: graph rewrite + weight quantization, then re-bind
+    qsym, qargs, qaux = qmod.quantize_model(
+        dsym, arg_params, aux_params, calib_mode="none")
+    n_q = sum(1 for k in qargs if k.endswith("_weight_quantized"))
+    logging.info("quantized %d conv/fc layers to int8", n_q)
+    qex = qsym.bind(args=dict(qargs, data=nd.array(Xv)))
+    det_int8_np = qex.forward()[0].asnumpy()   # compile + warm
+    t0 = time.time()
+    det_int8_np = qex.forward()[0].asnumpy()
+    int8_t = time.time() - t0
+    kept_q = det_int8_np[0][det_int8_np[0, :, 0] >= 0]
+    logging.info("int8 detections (img 0, top 3): %s",
+                 np.round(kept_q[:3], 3).tolist())
+
+    # int8 should agree with fp32 on the top detection's class and
+    # roughly on its box
+    if len(kept) and len(kept_q):
+        same_cls = kept[0][0] == kept_q[0][0]
+        box_err = float(np.abs(kept[0][2:] - kept_q[0][2:]).max())
+        logging.info("top-1 agreement: class %s, box err %.3f",
+                     bool(same_cls), box_err)
+    print("fp32 %.3fs  int8 %.3fs  (batch %d)  quantized_layers=%d"
+          % (fp32_t, int8_t, args.batch_size, n_q))
+
+
+if __name__ == "__main__":
+    main()

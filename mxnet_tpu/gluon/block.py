@@ -76,14 +76,38 @@ def _abstract_eval_forward(block, args):
         finally:
             _aux_sink.sink = prev_sink
             _trace_state.active = prev_tr
-        outs = out if isinstance(out, (list, tuple)) else [out]
-        return tuple(o._data for o in outs)
+        flat, _tmpl = _flatten_nested(out)
+        return tuple(o._data for o in flat)
 
     specs = [jax.ShapeDtypeStruct(tuple(_np.shape(r)) if not hasattr(r, "shape")
                                   else tuple(r.shape),
                                   getattr(r, "dtype", _np.float32))
              for r in raws]
     return jax.eval_shape(probe, *specs)
+
+
+def _flatten_nested(out):
+    """Flatten arbitrarily nested list/tuple output into (flat NDArray
+    list, template); the template mirrors the nesting with flat-list
+    indices at leaf positions (parity: block.py _flatten/_regroup —
+    lets hybrid_forward return e.g. (output, [state_h, state_c]))."""
+    flat = []
+
+    def rec(o):
+        if isinstance(o, (list, tuple)):
+            t = [rec(x) for x in o]
+            return t if isinstance(o, list) else tuple(t)
+        flat.append(o)
+        return len(flat) - 1
+
+    return flat, rec(out)
+
+
+def _regroup_nested(tmpl, flat):
+    if isinstance(tmpl, (list, tuple)):
+        vals = [_regroup_nested(t, flat) for t in tmpl]
+        return vals if isinstance(tmpl, list) else tuple(vals)
+    return flat[tmpl]
 
 
 class _BlockScope:
@@ -380,12 +404,12 @@ class CachedOp:
                 finally:
                     for d, old in saved:
                         d._data = old
-                multi = isinstance(out, (list, tuple))
-                outs = [o._data for o in (out if multi else [out])]
+                flat_out, tmpl = _flatten_nested(out)
+                outs = [o._data for o in flat_out]
                 aux_params = [p for (p, _v) in sink]
                 aux_vals = [v._data if isinstance(v, NDArray) else v
                             for (_p, v) in sink]
-                return tuple(outs), tuple(aux_vals), multi, aux_params
+                return tuple(outs), tuple(aux_vals), tmpl, aux_params
             finally:
                 _trace_state.active = False
                 _aux_sink.sink = None
@@ -426,8 +450,9 @@ class CachedOp:
             meta = {}
 
             def pure(rng, inputs_, params_):
-                outs, aux_vals, multi, aux_params = raw_fn(rng, inputs_, params_)
-                meta["multi"] = multi
+                outs, aux_vals, tmpl, aux_params = raw_fn(rng, inputs_,
+                                                          params_)
+                meta["tmpl"] = tmpl
                 meta["aux_params"] = aux_params
                 return outs, aux_vals
 
@@ -468,9 +493,9 @@ class CachedOp:
                           num_outputs=len(out_nds))
             autograd.record_op(info, {}, list(inputs) + param_nds, out_nds,
                                custom_backward=custom_backward)
-        if meta.get("multi"):
-            return out_nds
-        return out_nds[0]
+        # template regroup restores the nesting hybrid_forward returned;
+        # a single-output template is the bare index 0
+        return _regroup_nested(meta["tmpl"], out_nds)
 
 
 class HybridBlock(Block):

@@ -221,8 +221,10 @@ class Trainer:
                     # follow the WEIGHT's device: params living on host
                     # (e.g. Module on a CPU context) would otherwise mix
                     # platforms inside one jit call
-                    wdevs = p.list_data()[0]._data.devices()
-                    st._rebind(jax.device_put(st._data, next(iter(wdevs))))
+                    warr = p.list_data()[0]._data
+                    wdev = next(iter(warr.devices())) \
+                        if hasattr(warr, "devices") else jax.devices()[0]
+                    st._rebind(jax.device_put(st._data, wdev))
                 upd.states[i] = st
                 upd.states_synced[i] = True
             o._update_count(i)

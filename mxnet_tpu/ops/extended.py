@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .. import random as _random
+from ..base import MXNetError
 from .registry import alias, register
 from .utils import (normalize_axis, paxis, pbool, pdtype, pfloat, pint,
                     ptuple)
@@ -790,6 +791,9 @@ def correlation(data1, data2, kernel_size=1, max_displacement=1, stride1=1,
     of the reference's hand-rolled CUDA kernel.
     """
     ks = pint(kernel_size, 1)
+    if ks % 2 == 0:
+        raise MXNetError("Correlation: kernel size should be odd number "
+                         "(reference correlation-inl.h:81)")
     md = pint(max_displacement, 1)
     s1 = pint(stride1, 1)
     s2 = pint(stride2, 1)

@@ -9,13 +9,11 @@ Reference parity:
 - ``src/operator/contrib/proposal.cc`` (RPN anchor enumeration, bbox
   transform, clip, min-size filter, pre/post-NMS top-k)
 
-TPU-native placement decision: these are sequential, data-dependent
-post-/pre-processing steps (greedy matching, NMS) that run once per
-batch on small tensors — the reference itself runs them on CPU in the
-common path.  They execute as host numpy when called eagerly, and
-bridge into traced programs via ``jax.pure_callback`` (shapes are
-static functions of the input shapes, so the XLA program stays fixed).
-The dense math around them (conv towers, loss) stays on the MXU.
+TPU-native placement decision: the compute lives in ops/ssd_jax.py as
+pure static-shape jax (masked bipartite matching, fori_loop NMS), so
+target encoding and box decode/NMS fuse into the same jit program as
+the conv towers and losses — TPU backends reject host callbacks inside
+jit, so a host-numpy bridge would cut the training graph in half.
 """
 from __future__ import annotations
 
@@ -25,120 +23,6 @@ from .registry import register
 from .utils import pfloat, pint, pbool, pftuple
 
 
-def _host(fn, out_specs, args):
-    """Run ``fn`` on host numpy; bridge with pure_callback under trace."""
-    import jax
-
-    if any(isinstance(a, jax.core.Tracer) for a in args):
-        return jax.pure_callback(
-            fn, tuple(jax.ShapeDtypeStruct(s, d) for s, d in out_specs),
-            *args)
-    res = fn(*(np.asarray(a) for a in args))
-    import jax.numpy as jnp
-
-    return tuple(jnp.asarray(r) for r in res)
-
-
-def _iou_matrix(a, b):
-    """IOU of corner-format boxes a (N,4) vs b (M,4)."""
-    lt = np.maximum(a[:, None, :2], b[None, :, :2])
-    rb = np.minimum(a[:, None, 2:], b[None, :, 2:])
-    wh = np.clip(rb - lt, 0, None)
-    inter = wh[..., 0] * wh[..., 1]
-    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
-    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
-    union = area_a[:, None] + area_b[None, :] - inter
-    with np.errstate(divide="ignore", invalid="ignore"):
-        iou = np.where(union <= 0, 0.0, inter / union)
-    return iou
-
-
-def _encode_boxes(anchors, gts, variances):
-    """SSD regression targets (multibox_target.cc AssignLocTargets)."""
-    aw = anchors[:, 2] - anchors[:, 0]
-    ah = anchors[:, 3] - anchors[:, 1]
-    ax = (anchors[:, 0] + anchors[:, 2]) * 0.5
-    ay = (anchors[:, 1] + anchors[:, 3]) * 0.5
-    gw = gts[:, 2] - gts[:, 0]
-    gh = gts[:, 3] - gts[:, 1]
-    gx = (gts[:, 0] + gts[:, 2]) * 0.5
-    gy = (gts[:, 1] + gts[:, 3]) * 0.5
-    vx, vy, vw, vh = variances
-    return np.stack([(gx - ax) / aw / vx, (gy - ay) / ah / vy,
-                     np.log(gw / aw) / vw, np.log(gh / ah) / vh], axis=1)
-
-
-def _multibox_target_np(anchors, labels, cls_preds, overlap_threshold,
-                        ignore_label, negative_mining_ratio,
-                        negative_mining_thresh, minimum_negative_samples,
-                        variances):
-    anchors = anchors.reshape(-1, 4).astype(np.float32)
-    B, _, label_width = labels.shape
-    N = anchors.shape[0]
-    loc_target = np.zeros((B, N * 4), np.float32)
-    loc_mask = np.zeros((B, N * 4), np.float32)
-    cls_target = np.full((B, N), ignore_label, np.float32)
-
-    for b in range(B):
-        lab = labels[b]
-        valid = lab[:, 0] >= 0
-        gts = lab[valid]
-        flags = np.full(N, -1, np.int8)       # 1 pos / 0 neg / -1 ignore
-        match_gt = np.full(N, -1, np.int64)
-        match_iou = np.full(N, -1.0, np.float32)
-        if len(gts):
-            iou = _iou_matrix(anchors, gts[:, 1:5])
-            # greedy bipartite: best remaining (anchor, gt) pair first
-            work = iou.copy()
-            for _ in range(len(gts)):
-                j, k = np.unravel_index(np.argmax(work), work.shape)
-                if work[j, k] <= 1e-12:
-                    break
-                flags[j] = 1
-                match_gt[j], match_iou[j] = k, work[j, k]
-                work[j, :] = -1
-                work[:, k] = -1
-            # threshold matching for the rest
-            if overlap_threshold > 0:
-                rest = flags != 1
-                best = iou.argmax(axis=1)
-                best_iou = iou[np.arange(N), best]
-                take = rest & (best_iou > overlap_threshold)
-                flags[take] = 1
-                match_gt[rest] = best[rest]
-                match_iou[rest] = best_iou[rest]
-        num_pos = int((flags == 1).sum())
-
-        if negative_mining_ratio > 0:
-            num_neg = int(min(num_pos * negative_mining_ratio,
-                              N - num_pos))
-            num_neg = max(num_neg, int(minimum_negative_samples))
-            cand = (flags == -1) & (match_iou < negative_mining_thresh)
-            if num_neg > 0 and cand.any():
-                # hardest negatives: lowest background probability
-                logits = cls_preds[b]            # (num_classes, N)
-                m = logits.max(axis=0)
-                prob_bg = np.exp(logits[0] - m) / \
-                    np.exp(logits - m).sum(axis=0)
-                order = np.argsort(prob_bg[cand], kind="stable")
-                idx = np.where(cand)[0][order[:num_neg]]
-                flags[idx] = 0
-        else:
-            flags[flags != 1] = 0
-
-        pos = flags == 1
-        if pos.any():
-            gt_rows = gts[match_gt[pos]]
-            cls_target[b, pos] = gt_rows[:, 0] + 1   # 0 = background
-            enc = _encode_boxes(anchors[pos], gt_rows[:, 1:5], variances)
-            loc = loc_target[b].reshape(N, 4)
-            msk = loc_mask[b].reshape(N, 4)
-            loc[pos] = enc
-            msk[pos] = 1.0
-        cls_target[b, flags == 0] = 0.0
-    return loc_target, loc_mask, cls_target
-
-
 @register("_contrib_MultiBoxTarget", num_inputs=3, num_outputs=3,
           differentiable=False, aliases=("MultiBoxTarget",))
 def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
@@ -146,60 +30,14 @@ def _multibox_target(anchor, label, cls_pred, overlap_threshold=0.5,
                      negative_mining_thresh=0.5,
                      minimum_negative_samples=0,
                      variances=(0.1, 0.1, 0.2, 0.2), **kw):
-    B = label.shape[0]
-    N = int(np.prod(anchor.shape[:-1]))
+    from .ssd_jax import multibox_target_jax
+
     var = pftuple(variances, default=(0.1, 0.1, 0.2, 0.2))
-
-    def fn(a, l, c):
-        return _multibox_target_np(
-            a, l, c, pfloat(overlap_threshold, 0.5),
-            pfloat(ignore_label, -1.0),
-            pfloat(negative_mining_ratio, -1.0),
-            pfloat(negative_mining_thresh, 0.5),
-            pint(minimum_negative_samples, 0), var)
-
-    specs = [((B, N * 4), np.float32), ((B, N * 4), np.float32),
-             ((B, N), np.float32)]
-    return _host(fn, specs, (anchor, label, cls_pred))
-
-
-def _decode_boxes(anchors, loc, variances, clip):
-    """multibox_detection.cc TransformLocations."""
-    aw = anchors[:, 2] - anchors[:, 0]
-    ah = anchors[:, 3] - anchors[:, 1]
-    ax = (anchors[:, 0] + anchors[:, 2]) * 0.5
-    ay = (anchors[:, 1] + anchors[:, 3]) * 0.5
-    vx, vy, vw, vh = variances
-    ox = loc[:, 0] * vx * aw + ax
-    oy = loc[:, 1] * vy * ah + ay
-    ow = np.exp(loc[:, 2] * vw) * aw / 2
-    oh = np.exp(loc[:, 3] * vh) * ah / 2
-    out = np.stack([ox - ow, oy - oh, ox + ow, oy + oh], axis=1)
-    if clip:
-        out = np.clip(out, 0.0, 1.0)
-    return out
-
-
-def _nms_rows(rows, nms_threshold, force_suppress, nms_topk):
-    """In-place NMS over [id, score, x1, y1, x2, y2] rows, sorted by
-    descending score (multibox_detection.cc tail loop)."""
-    order = np.argsort(-rows[:, 1], kind="stable")
-    rows = rows[order]
-    nkeep = len(rows)
-    if 0 < nms_topk < nkeep:
-        rows[nms_topk:, 0] = -1
-        nkeep = nms_topk
-    for i in range(nkeep):
-        if rows[i, 0] < 0:
-            continue
-        for j in range(i + 1, nkeep):
-            if rows[j, 0] < 0:
-                continue
-            if force_suppress or rows[i, 0] == rows[j, 0]:
-                if _iou_matrix(rows[i:i + 1, 2:6],
-                               rows[j:j + 1, 2:6])[0, 0] > nms_threshold:
-                    rows[j, 0] = -1
-    return rows
+    return multibox_target_jax(
+        anchor, label, cls_pred, pfloat(overlap_threshold, 0.5),
+        pfloat(ignore_label, -1.0), pfloat(negative_mining_ratio, -1.0),
+        pfloat(negative_mining_thresh, 0.5),
+        pint(minimum_negative_samples, 0), var)
 
 
 @register("_contrib_MultiBoxDetection", num_inputs=3,
@@ -217,32 +55,10 @@ def _multibox_detection(cls_prob, loc_pred, anchor, clip=True,
     force = pbool(force_suppress, False)
 
     bid = pint(background_id, 0)
+    from .ssd_jax import multibox_detection_jax
 
-    def fn(probs, locs, anchors):
-        anchors = anchors.reshape(-1, 4).astype(np.float32)
-        out = np.full((B, N, 6), -1.0, np.float32)
-        for b in range(B):
-            p = probs[b].copy()                 # (C, N)
-            p[bid] = -np.inf                    # exclude background class
-            score = p.max(axis=0)
-            cid = p.argmax(axis=0)
-            cid = np.where(score < thr, bid, cid)
-            boxes = _decode_boxes(anchors, locs[b].reshape(N, 4), var,
-                                  do_clip)
-            # output ids: background -> -1, classes after it shift down
-            oid = np.where(cid == bid, -1.0,
-                           cid - (cid > bid).astype(np.int64))
-            rows = np.concatenate(
-                [oid[:, None], score[:, None], boxes],
-                axis=1).astype(np.float32)
-            rows = rows[rows[:, 0] >= 0]
-            if len(rows) and 0 < nms_thr <= 1:
-                rows = _nms_rows(rows, nms_thr, force, topk)
-            out[b, :len(rows)] = rows
-        return (out,)
-
-    return _host(fn, [((B, N, 6), np.float32)],
-                 (cls_prob, loc_pred, anchor))[0]
+    return multibox_detection_jax(cls_prob, loc_pred, anchor, do_clip,
+                                  thr, bid, nms_thr, force, var, topk)
 
 
 def _generate_anchors(stride, scales, ratios):
@@ -285,63 +101,9 @@ def _proposal(cls_prob, bbox_pred, im_info, rpn_pre_nms_top_n=6000,
     min_size = pfloat(rpn_min_size, 16)
     want_score = pbool(output_score, False)
 
-    def fn(probs, deltas, infos):
-        base = _generate_anchors(stride, scales_t, ratios_t)   # (A, 4)
-        sx, sy = np.meshgrid(np.arange(W) * stride,
-                             np.arange(H) * stride)
-        shifts = np.stack([sx.ravel(), sy.ravel(),
-                           sx.ravel(), sy.ravel()], axis=1)
-        anchors = (base[None] + shifts[:, None]).reshape(-1, 4)  # (HWA,4)
-        rois = np.zeros((B * post_n, 5), np.float32)
-        scores_out = np.zeros((B * post_n, 1), np.float32)
-        for b in range(B):
-            score = probs[b, A:].transpose(1, 2, 0).ravel()
-            d = deltas[b].reshape(A, 4, H, W).transpose(2, 3, 0, 1) \
-                .reshape(-1, 4)
-            ih, iw, iscale = infos[b][:3]
-            # bbox transform (NonLinearTransform)
-            aw = anchors[:, 2] - anchors[:, 0] + 1
-            ah = anchors[:, 3] - anchors[:, 1] + 1
-            ax = anchors[:, 0] + 0.5 * (aw - 1)
-            ay = anchors[:, 1] + 0.5 * (ah - 1)
-            px = d[:, 0] * aw + ax
-            py = d[:, 1] * ah + ay
-            pw = np.exp(np.clip(d[:, 2], None, 10)) * aw
-            ph = np.exp(np.clip(d[:, 3], None, 10)) * ah
-            boxes = np.stack([px - 0.5 * (pw - 1), py - 0.5 * (ph - 1),
-                              px + 0.5 * (pw - 1), py + 0.5 * (ph - 1)],
-                             axis=1)
-            boxes[:, 0::2] = np.clip(boxes[:, 0::2], 0, iw - 1)
-            boxes[:, 1::2] = np.clip(boxes[:, 1::2], 0, ih - 1)
-            keep = ((boxes[:, 2] - boxes[:, 0] + 1 >= min_size * iscale) &
-                    (boxes[:, 3] - boxes[:, 1] + 1 >= min_size * iscale))
-            boxes, score_k = boxes[keep], score[keep]
-            order = np.argsort(-score_k, kind="stable")[:pre_n]
-            boxes, score_k = boxes[order], score_k[order]
-            # plain greedy NMS
-            picked = []
-            alive = np.ones(len(boxes), bool)
-            for i in range(len(boxes)):
-                if not alive[i]:
-                    continue
-                picked.append(i)
-                if len(picked) >= post_n:
-                    break
-                later = np.where(alive[i + 1:])[0] + i + 1
-                if len(later):
-                    iou = _iou_matrix(boxes[i:i + 1], boxes[later])[0]
-                    alive[later[iou > nms_thr]] = False
-            if not picked:
-                picked = [0] if len(boxes) else []
-            # cyclic pad to post_n (proposal.cc keep-pad)
-            if picked:
-                idx = [picked[i % len(picked)] for i in range(post_n)]
-                rois[b * post_n:(b + 1) * post_n, 0] = b
-                rois[b * post_n:(b + 1) * post_n, 1:] = boxes[idx]
-                scores_out[b * post_n:(b + 1) * post_n, 0] = score_k[idx]
-        return (rois, scores_out)
+    from .ssd_jax import proposal_jax
 
-    rois, scores = _host(fn, [((B * post_n, 5), np.float32),
-                              ((B * post_n, 1), np.float32)],
-                         (cls_prob, bbox_pred, im_info))
+    base = _generate_anchors(stride, scales_t, ratios_t)   # (A, 4)
+    rois, scores = proposal_jax(cls_prob, bbox_pred, im_info, base,
+                                stride, pre_n, post_n, nms_thr, min_size)
     return (rois, scores) if want_score else rois

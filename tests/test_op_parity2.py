@@ -173,3 +173,12 @@ def test_uppercase_binary_matches_lowercase():
     b = nd.array(rng.rand(2, 3).astype(np.float32))
     got = _invoke_nd("_Maximum", [a, b], {}).asnumpy()
     assert np.allclose(got, np.maximum(a.asnumpy(), b.asnumpy()))
+
+
+def test_correlation_even_kernel_rejected():
+    from mxnet_tpu.base import MXNetError
+
+    with pytest.raises(MXNetError, match="odd"):
+        _invoke_nd("Correlation", [nd.zeros((1, 2, 8, 8)),
+                                   nd.zeros((1, 2, 8, 8))],
+                   {"kernel_size": 2})

@@ -128,3 +128,48 @@ def test_multibox_target_in_symbol():
         "c": nd.array(np.zeros((1, 3, 1), np.float32))})
     res = ex.forward()
     assert res[2].asnumpy()[0, 0] == 2.0
+
+
+def test_multibox_detection_nms_disabled():
+    # nms_threshold <= 0 disables suppression (reference guard
+    # `0 < nms_threshold <= 1`)
+    anchors = nd.array(np.array([[[0.1, 0.1, 0.5, 0.5],
+                                  [0.6, 0.6, 0.9, 0.9]]], np.float32))
+    cls_prob = nd.array(np.array([[[0.1, 0.2], [0.9, 0.8]]], np.float32))
+    loc = nd.zeros((1, 8))
+    out = nd.contrib.MultiBoxDetection(cls_prob, loc, anchors,
+                                       nms_threshold=-1.0).asnumpy()[0]
+    assert (out[:, 0] >= 0).sum() == 2
+
+
+def test_proposal_all_filtered_emits_zeros():
+    rng = np.random.RandomState(3)
+    cp = nd.array(rng.rand(1, 2 * 9, 4, 4).astype(np.float32))
+    bp = nd.zeros((1, 9 * 4, 4, 4))
+    im = nd.array(np.array([[40.0, 40.0, 100.0]], np.float32))
+    rois, sc = nd.contrib.Proposal(cp, bp, im, rpn_pre_nms_top_n=20,
+                                   rpn_post_nms_top_n=5,
+                                   scales=(4, 8, 16),
+                                   rpn_min_size=16, output_score=True)
+    assert np.all(sc.asnumpy() == 0)
+    assert np.all(rois.asnumpy()[:, 1:] == 0)
+
+
+def test_multibox_target_inside_jit():
+    # the kernels must run inside a traced program (TPU backends reject
+    # host callbacks under jit — this guards the SSD training graph)
+    import jax
+
+    anchors = np.random.rand(1, 8, 4).astype(np.float32)
+    label = np.array([[[0.0, 0.1, 0.1, 0.5, 0.5]]], np.float32)
+    cls_pred = np.zeros((1, 2, 8), np.float32)
+
+    @jax.jit
+    def run(a, l, c):
+        from mxnet_tpu.ops.ssd_jax import multibox_target_jax
+
+        return multibox_target_jax(a, l, c, 0.5, -1.0, -1.0, 0.5, 0,
+                                   (0.1, 0.1, 0.2, 0.2))
+
+    loc_t, loc_mask, cls_t = run(anchors, label, cls_pred)
+    assert cls_t.shape == (1, 8)
