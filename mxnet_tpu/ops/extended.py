@@ -12,8 +12,9 @@ Reference parity targets (all under /root/reference/src/operator/):
 - multi-tensor optimizers: optimizer_op.cc (multi_sgd_*, mp_adamw)
 - per-row sampling: random/sample_op.cc (_sample_*) and *_like
 
-Everything is one jnp/lax expression per op unless the reference
-semantics are inherently sequential (bipartite matching: host op).
+Everything is one jnp/lax expression per op; inherently sequential
+pieces (bipartite matching) run as fori_loops over masked matrices so
+they still compile into the device program.
 """
 from __future__ import annotations
 
@@ -373,45 +374,48 @@ def _boolean_mask(data, index, axis=0, **kw):
           differentiable=False)
 def _bipartite_matching(data, is_ascend=False, threshold=None, topk=-1,
                         **kw):
-    """Greedy bipartite matching over score matrix rows/cols
-    (reference contrib/krprod... bipartite_matching.cc). Host op."""
+    """Greedy bipartite matching over a score matrix (reference
+    src/operator/contrib/bounding_box.cc bipartite_matching): repeatedly
+    take the globally best remaining (row, col) pair while it passes the
+    threshold, optionally stopping after topk matches.
+
+    Device-side static-shape version: a fori_loop over min(N, M) rounds
+    carrying the match vectors and a +/-inf-masked work matrix, so the
+    op runs inside jit on TPU (host callbacks are unsupported there).
+    """
     thr = pfloat(threshold, 0.5)
     asc = pbool(is_ascend)
     k = pint(topk, -1)
 
-    def host(d):
-        d = np.asarray(d)
-        batch = d.reshape((-1,) + d.shape[-2:])
-        rows_out = np.full(batch.shape[:2], -1, np.float32)
-        cols_out = np.full((batch.shape[0], batch.shape[2]), -1,
-                           np.float32)
-        for b, m in enumerate(batch):
-            work = m.copy()
-            n = 0
-            while True:
-                if asc:
-                    i, j = np.unravel_index(np.argmin(work), work.shape)
-                    ok = work[i, j] <= thr
-                else:
-                    i, j = np.unravel_index(np.argmax(work), work.shape)
-                    ok = work[i, j] >= thr
-                if not ok or (0 < k <= n):
-                    break
-                rows_out[b, i] = j
-                cols_out[b, j] = i
-                work[i, :] = -np.inf if not asc else np.inf
-                work[:, j] = -np.inf if not asc else np.inf
-                n += 1
-        return (rows_out.reshape(d.shape[:-1]),
-                cols_out.reshape(d.shape[:-2] + (d.shape[-1],)))
+    batch = data.reshape((-1,) + data.shape[-2:]).astype(jnp.float32)
+    B, N, M = batch.shape
+    rounds = min(N, M) if k <= 0 else min(k, N, M)
+    bad = jnp.inf if asc else -jnp.inf
 
-    if isinstance(data, jax.core.Tracer):
-        out_shapes = (jax.ShapeDtypeStruct(data.shape[:-1], np.float32),
-                      jax.ShapeDtypeStruct(data.shape[:-2]
-                                           + (data.shape[-1],),
-                                           np.float32))
-        return jax.pure_callback(host, out_shapes, data)
-    return tuple(jnp.asarray(o) for o in host(data))
+    def one(m):
+        def round_(t, carry):
+            work, rows, cols = carry
+            flat = jnp.argmin(work) if asc else jnp.argmax(work)
+            i, j = flat // M, flat % M
+            best = work[i, j]
+            ok = (best <= thr) if asc else (best >= thr)
+            rows = jnp.where(ok, rows.at[i].set(j.astype(jnp.float32)),
+                             rows)
+            cols = jnp.where(ok, cols.at[j].set(i.astype(jnp.float32)),
+                             cols)
+            work = jnp.where(ok, work.at[i, :].set(bad).at[:, j].set(bad),
+                             work)
+            return work, rows, cols
+
+        rows0 = jnp.full((N,), -1.0, jnp.float32)
+        cols0 = jnp.full((M,), -1.0, jnp.float32)
+        _, rows, cols = lax.fori_loop(0, rounds, round_,
+                                      (m, rows0, cols0))
+        return rows, cols
+
+    rows, cols = jax.vmap(one)(batch)
+    return (rows.reshape(data.shape[:-1]),
+            cols.reshape(data.shape[:-2] + (data.shape[-1],)))
 
 
 # ---------------------------------------------------------------------------

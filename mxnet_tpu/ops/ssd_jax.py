@@ -183,9 +183,10 @@ def multibox_detection_jax(cls_prob, loc_pred, anchor, clip, threshold,
         order = jnp.argsort(sort_key, stable=True)
         oid, score, boxes = oid[order], score[order], boxes[order]
         alive = oid >= 0
-        if nms_topk > 0:
-            alive = alive & (jnp.arange(N) < nms_topk)
         run_nms = 0 < nms_threshold <= 1   # <=0 / >1 disables NMS
+        if run_nms and nms_topk > 0:
+            # reference applies topk only inside the NMS pass
+            alive = alive & (jnp.arange(N) < nms_topk)
 
         def nms_step(i, alive):
             this_alive = alive[i]
@@ -252,9 +253,19 @@ def proposal_jax(cls_prob, bbox_pred, im_info, base_anchors, stride,
                            jnp.clip(boxes[:, 1], 0, ih - 1),
                            jnp.clip(boxes[:, 2], 0, iw - 1),
                            jnp.clip(boxes[:, 3], 0, ih - 1)], axis=1)
-        keep = ((boxes[:, 2] - boxes[:, 0] + 1 >= min_size * iscale) &
-                (boxes[:, 3] - boxes[:, 1] + 1 >= min_size * iscale))
-        score = jnp.where(keep, score, -jnp.inf)
+        # reference FilterBox (proposal.cc): undersized boxes are NOT
+        # dropped — they are expanded by min_size/2 on each side and
+        # their score is set to -1, so they sort last but NMS always
+        # keeps at least one real box for the cyclic pad
+        ms = min_size * iscale
+        small = ((boxes[:, 2] - boxes[:, 0] + 1 < ms) |
+                 (boxes[:, 3] - boxes[:, 1] + 1 < ms))
+        half = ms * 0.5
+        grown = jnp.stack([boxes[:, 0] - half, boxes[:, 1] - half,
+                           boxes[:, 2] + half, boxes[:, 3] + half],
+                          axis=1)
+        boxes = jnp.where(small[:, None], grown, boxes)
+        score = jnp.where(small, -1.0, score)
         top_score, top_idx = lax.top_k(score, pre_n)
         top_boxes = boxes[top_idx]
 
@@ -264,19 +275,14 @@ def proposal_jax(cls_prob, bbox_pred, im_info, base_anchors, stride,
                 (jnp.arange(pre_n) > i)
             return alive & ~kill
 
-        alive = top_score > -jnp.inf
-        alive = lax.fori_loop(0, pre_n, nms_step, alive)
-        # compact survivors to the front, then cyclic-pad to post_n;
-        # if the min-size filter removed everything, emit zero rows (the
-        # reference leaves that batch's rois/scores zero-initialized)
+        alive = lax.fori_loop(0, pre_n, nms_step,
+                              jnp.ones((pre_n,), bool))
+        # compact survivors to the front, then cyclic-pad to post_n
         comp = jnp.argsort(jnp.where(alive, jnp.arange(pre_n), pre_n + 1),
                            stable=True)
-        any_alive = jnp.any(alive)
         n_alive = jnp.maximum(jnp.sum(alive), 1)
         sel = comp[jnp.mod(jnp.arange(post_n), n_alive)]
-        out_boxes = jnp.where(any_alive, top_boxes[sel], 0.0)
-        out_scores = jnp.where(any_alive, top_score[sel], 0.0)
-        return out_boxes, out_scores
+        return top_boxes[sel], top_score[sel]
 
     boxes, scores = jax.vmap(one)(cls_prob, bbox_pred, im_info)
     batch_ids = jnp.repeat(jnp.arange(B, dtype=jnp.float32), post_n)

@@ -32,15 +32,29 @@ def _block_attn(q, k, v, scale, causal_mask=None):
     return o, m, l
 
 
-def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None):
+def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None,
+                   use_flash=False):
     """Exact attention over a sequence sharded along `axis_name`.
 
     q, k, v: (batch, seq_local, heads, dim) per-device blocks.
     Must be called inside shard_map/pmap with `axis_name` bound.
+
+    use_flash=True computes each local block with the Pallas
+    flash-attention kernel (ops/attention_pallas.py) and merges blocks
+    by logsumexp — same math, O(blk²) scores never materialized.
+    Non-causal only: block-level causality needs a static diagonal
+    position, which the rotating ring does not give the kernel.
     """
     import jax
     import jax.numpy as jnp
     from jax import lax
+
+    if use_flash:
+        if causal:
+            raise NotImplementedError(
+                "ring_attention(use_flash=True) supports non-causal "
+                "attention only")
+        return _ring_attention_flash(q, k, v, axis_name, scale)
 
     n_dev = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
@@ -82,6 +96,42 @@ def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None):
     carry0 = (o0, neg_inf, l0, (k, v), my_idx)
     (o, m, l, _kv, _idx), _ = jax.lax.scan(body, carry0, None, length=n_dev)
     return o / jnp.moveaxis(l, -3, -2)
+
+
+def _ring_attention_flash(q, k, v, axis_name, scale):
+    """Ring body with the Pallas kernel as the per-block engine: each
+    device holds normalized (o, lse) and merges rotated blocks by
+    logsumexp weights."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from ..ops.attention_pallas import flash_attention_with_lse
+
+    n_dev = lax.psum(1, axis_name)
+    scale = scale if scale is not None else q.shape[-1] ** -0.5
+    perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+
+    def body(carry, _):
+        o_acc, lse_acc, kv = carry
+        k_blk, v_blk = kv
+        o_blk, lse_blk = flash_attention_with_lse(q, k_blk, v_blk,
+                                                  scale=scale)
+        lse_new = jnp.logaddexp(lse_acc, lse_blk)
+        w_acc = jnp.exp(lse_acc - lse_new)[..., None]
+        w_blk = jnp.exp(lse_blk - lse_new)[..., None]
+        # accumulate in f32: bf16 inputs would otherwise flip the scan
+        # carry dtype between iterations
+        o_new = o_acc * w_acc + o_blk.astype(jnp.float32) * w_blk
+        kv_next = (lax.ppermute(k_blk, axis_name, perm),
+                   lax.ppermute(v_blk, axis_name, perm))
+        return (o_new, lse_new, kv_next), None
+
+    o0 = jnp.zeros(q.shape, jnp.float32)
+    lse0 = jnp.full(q.shape[:3], -jnp.inf, jnp.float32)  # (b, t, h)
+    (o, _lse, _kv), _ = jax.lax.scan(body, (o0, lse0, (k, v)), None,
+                                     length=n_dev)
+    return o.astype(q.dtype)
 
 
 def local_attention(q, k, v, causal=False, scale=None):

@@ -1,0 +1,98 @@
+"""Pallas flash-attention kernel vs the composed-op reference.
+
+Matmul precision note: jax's DEFAULT matmul precision truncates inputs
+(bf16-like) on every backend here, so flash and the reference each sit
+~1e-3 from fp64 truth; under default_matmul_precision('float32') both
+are exact.  The tests pin the precision context accordingly.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from mxnet_tpu.ops.attention_pallas import (flash_attention,
+                                            flash_attention_with_lse)
+from mxnet_tpu.parallel.ring_attention import local_attention
+
+_R = np.random.RandomState(0)
+
+
+def _qkv(B=2, T=256, H=2, D=64):
+    return tuple(jnp.asarray(_R.randn(B, T, H, D).astype(np.float32))
+                 for _ in range(3))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_reference_exact(causal):
+    q, k, v = _qkv()
+    with jax.default_matmul_precision("float32"):
+        o = flash_attention(q, k, v, causal=causal)
+        ref = local_attention(q, k, v, causal=causal)
+    assert float(jnp.abs(o - ref).max()) < 5e-5
+
+
+def test_flash_uneven_blocks():
+    q, k, v = _qkv(T=256)
+    with jax.default_matmul_precision("float32"):
+        o = flash_attention(q, k, v, blk_q=128, blk_k=64)
+        ref = local_attention(q, k, v)
+    assert float(jnp.abs(o - ref).max()) < 5e-5
+
+
+def test_flash_gradients():
+    q, k, v = _qkv(B=1, T=128, H=1, D=64)
+
+    with jax.default_matmul_precision("float32"):
+        gf = jax.grad(lambda q: flash_attention(q, k, v,
+                                                causal=True).sum())(q)
+        gr = jax.grad(lambda q: local_attention(q, k, v,
+                                                causal=True).sum())(q)
+    assert float(jnp.abs(gf - gr).max()) < 5e-4
+
+
+def test_flash_lse_matches_logsumexp():
+    q, k, v = _qkv(B=1, T=128, H=1, D=64)
+    with jax.default_matmul_precision("float32"):
+        _, lse = flash_attention_with_lse(q, k, v)
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (64 ** -0.5)
+        ref = jnp.swapaxes(jax.nn.logsumexp(s, axis=-1), 1, 2)
+    assert float(jnp.abs(lse - ref).max()) < 5e-5
+
+
+def test_flash_bf16_io():
+    q, k, v = (a.astype(jnp.bfloat16) for a in _qkv(B=1, T=128, H=1))
+    o = flash_attention(q, k, v)
+    assert o.dtype == jnp.bfloat16
+    ref = local_attention(q.astype(jnp.float32), k.astype(jnp.float32),
+                          v.astype(jnp.float32))
+    assert float(jnp.abs(o.astype(jnp.float32) - ref).max()) < 3e-2
+
+
+def test_flash_rejects_ragged_seq():
+    q, k, v = _qkv(T=192)
+    with pytest.raises(ValueError, match="multiples"):
+        flash_attention(q, k, v, blk_q=128, blk_k=128)
+
+
+def test_ring_attention_flash_engine():
+    from jax.sharding import Mesh
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+    import functools
+
+    from mxnet_tpu.parallel.ring_attention import ring_attention
+
+    devs = jax.devices()[:4]
+    mesh = Mesh(np.array(devs), ("sp",))
+    B, T, H, D = 1, 4 * 64, 1, 64
+    q, k, v = _qkv(B=B, T=T, H=H, D=D)
+    spec = P(None, "sp", None, None)
+    fn = shard_map(functools.partial(ring_attention, axis_name="sp",
+                                     use_flash=True),
+                   mesh=mesh, in_specs=(spec, spec, spec),
+                   out_specs=spec, check_rep=False)
+    with jax.default_matmul_precision("float32"):
+        out = fn(q, k, v)
+        ref = local_attention(q, k, v)
+    assert float(jnp.abs(out - ref).max()) < 5e-5

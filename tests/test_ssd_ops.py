@@ -142,7 +142,10 @@ def test_multibox_detection_nms_disabled():
     assert (out[:, 0] >= 0).sum() == 2
 
 
-def test_proposal_all_filtered_emits_zeros():
+def test_proposal_min_size_filter_expands():
+    # reference FilterBox (proposal.cc): undersized boxes are kept but
+    # expanded by min_size/2 per side with score -1 — never dropped, so
+    # the cyclic pad always emits real coordinates
     rng = np.random.RandomState(3)
     cp = nd.array(rng.rand(1, 2 * 9, 4, 4).astype(np.float32))
     bp = nd.zeros((1, 9 * 4, 4, 4))
@@ -151,8 +154,8 @@ def test_proposal_all_filtered_emits_zeros():
                                    rpn_post_nms_top_n=5,
                                    scales=(4, 8, 16),
                                    rpn_min_size=16, output_score=True)
-    assert np.all(sc.asnumpy() == 0)
-    assert np.all(rois.asnumpy()[:, 1:] == 0)
+    assert np.all(sc.asnumpy() == -1)          # every box undersized
+    assert not np.all(rois.asnumpy()[:, 1:] == 0)
 
 
 def test_multibox_target_inside_jit():
