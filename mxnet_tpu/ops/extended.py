@@ -398,7 +398,9 @@ def _bipartite_matching(data, is_ascend=False, threshold=None, topk=-1,
             flat = jnp.argmin(work) if asc else jnp.argmax(work)
             i, j = flat // M, flat % M
             best = work[i, j]
-            ok = (best <= thr) if asc else (best >= thr)
+            # reference comparisons are strict: a score exactly at the
+            # threshold ends the matching
+            ok = (best < thr) if asc else (best > thr)
             rows = jnp.where(ok, rows.at[i].set(j.astype(jnp.float32)),
                              rows)
             cols = jnp.where(ok, cols.at[j].set(i.astype(jnp.float32)),

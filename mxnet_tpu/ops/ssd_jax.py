@@ -169,6 +169,8 @@ def multibox_detection_jax(cls_prob, loc_pred, anchor, clip, threshold,
     anchors = anchor.reshape(-1, 4)
     bid = background_id
 
+    run_nms = 0 < nms_threshold <= 1   # <=0 / >1 disables NMS
+
     def one(probs, locs):
         p = probs.at[bid].set(-jnp.inf)
         score = jnp.max(p, axis=0)
@@ -178,12 +180,17 @@ def multibox_detection_jax(cls_prob, loc_pred, anchor, clip, threshold,
                             clip)
         oid = jnp.where(cid == bid, -1.0,
                         (cid - (cid > bid)).astype(jnp.float32))
-        # order all anchors by score, invalid ones last
-        sort_key = jnp.where(oid >= 0, -score, jnp.inf)
-        order = jnp.argsort(sort_key, stable=True)
+        if run_nms:
+            # order by score for the NMS pass, invalid anchors last
+            sort_key = jnp.where(oid >= 0, -score, jnp.inf)
+            order = jnp.argsort(sort_key, stable=True)
+        else:
+            # reference skips NMS entirely and emits valid detections
+            # in anchor order
+            order = jnp.argsort(jnp.where(oid >= 0, jnp.arange(N),
+                                          N + 1), stable=True)
         oid, score, boxes = oid[order], score[order], boxes[order]
         alive = oid >= 0
-        run_nms = 0 < nms_threshold <= 1   # <=0 / >1 disables NMS
         if run_nms and nms_topk > 0:
             # reference applies topk only inside the NMS pass
             alive = alive & (jnp.arange(N) < nms_topk)
@@ -193,7 +200,8 @@ def multibox_detection_jax(cls_prob, loc_pred, anchor, clip, threshold,
             same = jnp.ones((N,), bool) if force_suppress \
                 else (oid == oid[i])
             iou_row = _iou_jnp(jnp, boxes[i][None, :], boxes)[0]
-            kill = this_alive & same & (iou_row > nms_threshold) & \
+            # reference suppresses on iou >= threshold
+            kill = this_alive & same & (iou_row >= nms_threshold) & \
                 (jnp.arange(N) > i)
             return alive & ~kill
 
@@ -270,7 +278,20 @@ def proposal_jax(cls_prob, bbox_pred, im_info, base_anchors, stride,
         top_boxes = boxes[top_idx]
 
         def nms_step(i, alive):
-            iou_row = _iou_jnp(jnp, top_boxes[i][None, :], top_boxes)[0]
+            # proposal.cc NMS uses the +1 pixel-box area convention
+            # ((x2-x1+1)*(y2-y1+1)) — corner IoU would shift decisions
+            # near the threshold
+            b_i = top_boxes[i]
+            xx1 = jnp.maximum(b_i[0], top_boxes[:, 0])
+            yy1 = jnp.maximum(b_i[1], top_boxes[:, 1])
+            xx2 = jnp.minimum(b_i[2], top_boxes[:, 2])
+            yy2 = jnp.minimum(b_i[3], top_boxes[:, 3])
+            inter = jnp.maximum(xx2 - xx1 + 1, 0) * \
+                jnp.maximum(yy2 - yy1 + 1, 0)
+            area = (top_boxes[:, 2] - top_boxes[:, 0] + 1) * \
+                (top_boxes[:, 3] - top_boxes[:, 1] + 1)
+            area_i = (b_i[2] - b_i[0] + 1) * (b_i[3] - b_i[1] + 1)
+            iou_row = inter / (area_i + area - inter)
             kill = alive[i] & (iou_row > nms_thr) & \
                 (jnp.arange(pre_n) > i)
             return alive & ~kill

@@ -33,7 +33,7 @@ def _block_attn(q, k, v, scale, causal_mask=None):
 
 
 def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None,
-                   use_flash=False):
+                   use_flash=False, blk_q=128, blk_k=128):
     """Exact attention over a sequence sharded along `axis_name`.
 
     q, k, v: (batch, seq_local, heads, dim) per-device blocks.
@@ -54,7 +54,8 @@ def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None,
             raise NotImplementedError(
                 "ring_attention(use_flash=True) supports non-causal "
                 "attention only")
-        return _ring_attention_flash(q, k, v, axis_name, scale)
+        return _ring_attention_flash(q, k, v, axis_name, scale,
+                                     blk_q, blk_k)
 
     n_dev = lax.psum(1, axis_name)
     my_idx = lax.axis_index(axis_name)
@@ -98,7 +99,7 @@ def ring_attention(q, k, v, axis_name="sp", causal=False, scale=None,
     return o / jnp.moveaxis(l, -3, -2)
 
 
-def _ring_attention_flash(q, k, v, axis_name, scale):
+def _ring_attention_flash(q, k, v, axis_name, scale, blk_q, blk_k):
     """Ring body with the Pallas kernel as the per-block engine: each
     device holds normalized (o, lse) and merges rotated blocks by
     logsumexp weights."""
@@ -116,7 +117,9 @@ def _ring_attention_flash(q, k, v, axis_name, scale):
         o_acc, lse_acc, kv = carry
         k_blk, v_blk = kv
         o_blk, lse_blk = flash_attention_with_lse(q, k_blk, v_blk,
-                                                  scale=scale)
+                                                  scale=scale,
+                                                  blk_q=blk_q,
+                                                  blk_k=blk_k)
         lse_new = jnp.logaddexp(lse_acc, lse_blk)
         w_acc = jnp.exp(lse_acc - lse_new)[..., None]
         w_blk = jnp.exp(lse_blk - lse_new)[..., None]

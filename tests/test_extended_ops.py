@@ -283,3 +283,13 @@ def test_psroi_pooling_grad_flows():
                           "pooled_size": 2, "group_size": 2})
         out.sum().backward()
     assert np.abs(data.grad.asnumpy()).sum() > 0
+
+
+def test_bipartite_matching_strict_threshold():
+    # reference bounding_box-inl.h: score must be strictly > threshold
+    # (descend) to match; an exact-threshold score ends the matching
+    score = nd.array(np.array([[0.5, 0.1], [0.2, 0.3]], np.float32))
+    rows, cols = _invoke_nd("_contrib_bipartite_matching", [score],
+                            {"threshold": 0.5})
+    assert np.all(rows.asnumpy() == -1)
+    assert np.all(cols.asnumpy() == -1)

@@ -1,0 +1,213 @@
+"""Reference test-strategy gaps: dynamic shapes, thread-local scopes,
+checkpoint format stability, large arrays.
+
+Models: tests/python/unittest/test_dynamic_shape.py,
+test_thread_local.py, model_backwards_compatibility_check/, and
+tests/nightly/test_large_array.py (smoke-scale).
+"""
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon, autograd
+from mxnet_tpu.gluon import nn
+
+
+# ---------------------------------------------------------------------------
+# dynamic shapes (reference test_dynamic_shape.py: boolean_mask e2e)
+# ---------------------------------------------------------------------------
+
+
+def test_boolean_mask_eager_dynamic_shape():
+    data = nd.array(np.arange(12, dtype=np.float32).reshape(4, 3))
+    index = nd.array(np.array([0, 1, 0, 1], np.float32))
+    out = nd.contrib.boolean_mask(data, index)
+    assert out.shape == (2, 3)
+    np.testing.assert_array_equal(out.asnumpy(), [[3, 4, 5], [9, 10, 11]])
+
+
+def test_boolean_mask_refuses_jit():
+    # data-dependent output shape cannot trace; the error must be
+    # explicit, not a wrong result
+    data = mx.sym.var("data")
+    index = mx.sym.var("index")
+    out = mx.sym.contrib.boolean_mask(data, index)
+    ex = out.bind(args={"data": nd.ones((4, 3)),
+                        "index": nd.array(np.array([0, 1, 0, 1],
+                                                   np.float32))})
+    with pytest.raises(Exception, match="eager|jit|dynamic"):
+        ex.forward()
+
+
+def test_per_shape_jit_cache_bucketing_style():
+    """Different input lengths hit different compiled programs but share
+    one parameter set — the mechanism under BucketingModule."""
+    net = nn.Dense(4)
+    net.initialize()
+    net.hybridize()
+    outs = [net(nd.ones((b, 8))) for b in (1, 2, 5)]
+    assert [o.shape for o in outs] == [(1, 4), (2, 4), (5, 4)]
+    # params shared: same underlying weight object
+    w = net.collect_params()
+    assert len(w) == 2
+
+
+# ---------------------------------------------------------------------------
+# thread-local scopes (reference test_thread_local.py)
+# ---------------------------------------------------------------------------
+
+
+def test_attr_and_name_scopes_are_thread_local():
+    from mxnet_tpu.attribute import AttrScope
+    from mxnet_tpu.name import NameManager
+
+    results = {}
+
+    def worker(tag):
+        with AttrScope(group=tag):
+            assert AttrScope.current().get(None).get("group") == tag
+            s = mx.sym.var("x_" + tag)
+            results[tag] = NameManager.current().get(None, "fc")
+
+    threads = [threading.Thread(target=worker, args=("t%d" % i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    # each thread got its own fresh counter: all names identical
+    assert set(results.values()) == {"fc0"}
+    # main thread scope unpolluted
+    assert "group" not in AttrScope.current().get(None)
+
+
+def test_eager_ops_across_threads():
+    errs = []
+
+    def worker():
+        try:
+            a = nd.array(np.ones((8, 8), np.float32))
+            out = (a * 2 + 1).asnumpy()
+            assert np.all(out == 3)
+        except Exception as e:  # pragma: no cover
+            errs.append(e)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+
+
+# ---------------------------------------------------------------------------
+# checkpoint format stability (reference model_backwards_compatibility)
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_roundtrip_gluon_to_module(tmp_path):
+    """Gluon export -> Module load: the two API families must share one
+    artifact format (symbol json + params), like the reference."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(3))
+    net.initialize()
+    net.hybridize()
+    x = nd.ones((2, 5))
+    want = net(x).asnumpy()
+    net.export(str(tmp_path / "m"), epoch=0)
+
+    sym, args, aux = mx.model.load_checkpoint(str(tmp_path / "m"), 0)
+    mod = mx.mod.Module(sym, data_names=("data",), label_names=None)
+    mod.bind(data_shapes=[("data", (2, 5))], for_training=False)
+    mod.set_params(args, aux)
+    mod.forward(mx.io.DataBatch(data=[x]), is_train=False)
+    got = mod.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_param_file_stable_across_save_load_cycles(tmp_path):
+    p1 = str(tmp_path / "a.params")
+    p2 = str(tmp_path / "b.params")
+    arrs = {"arg:w": nd.array(np.random.RandomState(0)
+                              .rand(3, 4).astype(np.float32)),
+            "aux:s": nd.array(np.ones(3, np.float32))}
+    nd.save(p1, arrs)
+    loaded = nd.load(p1)
+    nd.save(p2, loaded)
+    again = nd.load(p2)
+    assert set(again) == set(arrs)
+    for k in arrs:
+        np.testing.assert_array_equal(again[k].asnumpy(),
+                                      arrs[k].asnumpy())
+
+
+# ---------------------------------------------------------------------------
+# large arrays (nightly test_large_array.py, smoke scale)
+# ---------------------------------------------------------------------------
+
+
+def test_large_1d_reduce_and_index():
+    n = 3_000_000
+    a = nd.arange(n, dtype="float32")
+    assert float(a[-1].asnumpy()) == n - 1
+    got = float(a.sum().asnumpy())
+    want = (n - 1) * n / 2
+    assert abs(got - want) / want < 1e-5   # fp32 accumulation tolerance
+
+
+def test_large_take_gather():
+    n = 1_000_000
+    a = nd.arange(n, dtype="float32")
+    idx = nd.array(np.array([0, n // 2, n - 1], np.int64))
+    np.testing.assert_array_equal(a.take(idx).asnumpy(),
+                                  [0, n // 2, n - 1])
+
+
+# ---------------------------------------------------------------------------
+# small convergence test (reference tests/python/train/test_mlp.py)
+# ---------------------------------------------------------------------------
+
+
+def test_mlp_convergence_gluon():
+    rng = np.random.RandomState(0)
+    X = rng.randn(512, 10).astype(np.float32)
+    W = rng.randn(10, 3).astype(np.float32)
+    Y = (X @ W).argmax(1).astype(np.float32)
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu"), nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    xb, yb = nd.array(X), nd.array(Y)
+    for _ in range(60):
+        with autograd.record():
+            loss = loss_fn(net(xb), yb)
+        loss.backward()
+        trainer.step(X.shape[0])
+    acc = float((net(xb).asnumpy().argmax(1) == Y).mean())
+    assert acc > 0.9, acc
+
+
+def test_vision_transforms_pipeline():
+    from mxnet_tpu.gluon.data.vision import transforms
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+
+    imgs = nd.array(np.random.RandomState(0).randint(
+        0, 255, (8, 32, 32, 3)).astype(np.uint8))
+    labels = nd.array(np.zeros(8, np.float32))
+    tf = transforms.Compose([transforms.ToTensor(),
+                             transforms.Normalize(0.5, 0.25)])
+    ds = ArrayDataset(imgs, labels).transform_first(tf)
+    loader = DataLoader(ds, batch_size=4)
+    batches = list(loader)
+    assert len(batches) == 2
+    xb, yb = batches[0]
+    assert xb.shape == (4, 3, 32, 32)       # HWC uint8 -> CHW float
+    x = xb.asnumpy()
+    assert x.min() >= (0 - 0.5) / 0.25 - 1e-5
+    assert x.max() <= (1 - 0.5) / 0.25 + 1e-5

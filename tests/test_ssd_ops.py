@@ -176,3 +176,29 @@ def test_multibox_target_inside_jit():
 
     loc_t, loc_mask, cls_t = run(anchors, label, cls_pred)
     assert cls_t.shape == (1, 8)
+
+
+def test_multibox_detection_nms_at_exact_threshold():
+    # reference suppresses on iou >= nms_threshold: two identical boxes
+    # (iou == 1.0) with nms_threshold=1.0 -> only one survives
+    anchors = nd.array(np.array([[[0.1, 0.1, 0.5, 0.5],
+                                  [0.1, 0.1, 0.5, 0.5]]], np.float32))
+    cls_prob = nd.array(np.array([[[0.1, 0.1], [0.9, 0.8]]], np.float32))
+    loc = nd.zeros((1, 8))
+    out = nd.contrib.MultiBoxDetection(cls_prob, loc, anchors,
+                                       nms_threshold=1.0).asnumpy()[0]
+    assert (out[:, 0] >= 0).sum() == 1
+
+
+def test_multibox_detection_disabled_nms_keeps_anchor_order():
+    # with NMS disabled the reference emits valid detections in anchor
+    # order, not score order
+    anchors = nd.array(np.array([[[0.1, 0.1, 0.3, 0.3],
+                                  [0.5, 0.5, 0.9, 0.9]]], np.float32))
+    # anchor 0 scores LOWER than anchor 1
+    cls_prob = nd.array(np.array([[[0.1, 0.1], [0.3, 0.8]]], np.float32))
+    loc = nd.zeros((1, 8))
+    out = nd.contrib.MultiBoxDetection(cls_prob, loc, anchors,
+                                       nms_threshold=-1.0).asnumpy()[0]
+    assert abs(out[0, 1] - 0.3) < 1e-6   # anchor 0 first despite score
+    assert abs(out[1, 1] - 0.8) < 1e-6
