@@ -5,6 +5,28 @@ Import as `import mxnet_tpu as mx`: the namespace mirrors the reference's
 `import mxnet as mx` surface (mx.nd, mx.sym, mx.gluon, mx.autograd,
 mx.cpu()/mx.gpu()/mx.tpu(), mx.io, mx.kvstore, ...).
 """
+import os as _os
+
+if _os.environ.get("MXNET_PLATFORM"):
+    # Pin the jax backend before anything can initialize it.  Needed by
+    # multi-process launchers (tools/launch.py): an accelerator plugin
+    # overrides the JAX_PLATFORMS env var at import, so worker processes
+    # that must share a host CPU (or leave the one chip to rank 0) can
+    # only choose their platform through the config flag.
+    import jax as _jax
+
+    try:
+        _jax.config.update("jax_platforms",
+                           _os.environ["MXNET_PLATFORM"])
+    except Exception as _e:  # backend already live: keep it, but say so
+        import warnings as _warnings
+
+        _warnings.warn(
+            "MXNET_PLATFORM=%r could not pin the jax backend (%s); "
+            "this process keeps the default platform — launcher workers "
+            "may contend for one accelerator"
+            % (_os.environ["MXNET_PLATFORM"], _e), RuntimeWarning)
+
 from .base import MXNetError, MXTpuError  # noqa: F401
 from .context import (Context, cpu, gpu, tpu, cpu_pinned, current_context,  # noqa: F401
                       num_gpus, num_tpus)

@@ -29,6 +29,11 @@ FLAGS = {
         "ThreadedEnginePerDevice", str, "honored",
         "NaiveEngine forces synchronous dispatch (race-detection oracle); "
         "anything else keeps jax async dispatch (engine.py)"),
+    "MXNET_PLATFORM": (
+        "", str, "honored",
+        "pin the jax backend ('cpu'/'tpu') before init — multi-process "
+        "launcher workers use this to stay off the single accelerator "
+        "(__init__.py)"),
     "MXNET_PROFILER_AUTOSTART": (
         "0", _pbool, "honored", "start the jax trace at import"),
     "MXNET_PROFILER_MODE": (

@@ -132,12 +132,18 @@ class Trainer:
     def _allreduce_grads(self):
         if self._kvstore is None:
             return
-        for i, param in enumerate(self._params):
-            if param.grad_req != "null":
-                self._kvstore.push(i, param.list_grad(), priority=-i)
-                if not self._update_on_kvstore:
-                    self._kvstore.pull(i, param.list_grad(), priority=-i,
-                                       ignore_sparse=False)
+        # one push (and pull) call covering every parameter: the dist
+        # store turns each into a single batched message instead of a
+        # per-parameter server round trip
+        keys = [i for i, p in enumerate(self._params)
+                if p.grad_req != "null"]
+        grads = [self._params[i].list_grad() for i in keys]
+        if not keys:
+            return
+        self._kvstore.push(keys, grads, priority=0)
+        if not self._update_on_kvstore:
+            self._kvstore.pull(keys, grads, priority=0,
+                               ignore_sparse=False)
 
     def update(self, batch_size, ignore_stale_grad=False):
         if not self._kv_initialized:
@@ -153,11 +159,16 @@ class Trainer:
     def _update(self, ignore_stale_grad=False):
         if self._try_fused_update():
             return
+        if self._kvstore and self._update_on_kvstore:
+            keys = [i for i, p in enumerate(self._params)
+                    if p.grad_req != "null"]
+            if keys:
+                self._kvstore.pull(keys,
+                                   [self._params[i].list_data()
+                                    for i in keys], priority=0)
+            return
         for i, param in enumerate(self._params):
             if param.grad_req == "null":
-                continue
-            if self._kvstore and self._update_on_kvstore:
-                self._kvstore.pull(i, param.list_data(), priority=-i)
                 continue
             for upd, arr, grad in zip(self._updaters, param.list_data(),
                                       param.list_grad()):

@@ -237,14 +237,21 @@ class KVStoreDist(KVStore):
 
     def push(self, key, value, priority=0):
         keys, vals = _ctype_key_value(key, value)
-        for k, vlist in zip(keys, vals):
-            agg = self._reduce(self._maybe_compress(str(k), vlist))
-            self._client.push(str(k), agg.asnumpy(), sync=self._sync)
+        items = [(str(k), self._reduce(self._maybe_compress(
+            str(k), vlist)).asnumpy()) for k, vlist in zip(keys, vals)]
+        if len(items) == 1:
+            self._client.push(items[0][0], items[0][1], sync=self._sync)
+        else:
+            # whole step in one message (vs one RTT per parameter)
+            self._client.push_batch(items, sync=self._sync)
 
     def pull(self, key, out=None, priority=0, ignore_sparse=True):
         keys, outs = _ctype_key_value(key, out)
-        for k, olist in zip(keys, outs):
-            val = self._client.pull(str(k))
+        if len(keys) == 1:
+            vals = [self._client.pull(str(keys[0]))]
+        else:
+            vals = self._client.pull_batch([str(k) for k in keys])
+        for val, olist in zip(vals, outs):
             nd = array(val)
             for o in olist:
                 o._rebind(nd._data.astype(o._data.dtype))

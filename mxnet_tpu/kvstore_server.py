@@ -107,6 +107,43 @@ class KVServer:
                 "error": "timed out waiting for peers (no failure "
                          "detected; a worker may be stalled)"}
 
+    def _push_one(self, key, value, async_req=False):
+        """Apply/aggregate one pushed value; returns an error dict or
+        None.  Sync mode blocks until every worker's contribution for
+        this key has arrived (ApplyUpdates:346 parity)."""
+        if not self._sync or async_req:
+            # server-wide async mode, or an explicit per-push async
+            # request from the worker
+            with self._cv:
+                self._apply_update(key, value)
+            return None
+        with self._cv:
+            if self._dead:
+                return self._wait_error()   # refuse rounds w/ dead peer
+            acc, cnt, gen = self._push_buf.get(key, (0.0, 0, 0))
+            acc = value if cnt == 0 else acc + value
+            cnt += 1
+            if cnt == self._num_workers:
+                self._apply_update(key, acc)
+                self._push_buf[key] = (0.0, 0, gen + 1)
+                self._cv.notify_all()
+            else:
+                self._push_buf[key] = (acc, cnt, gen)
+                target = gen + 1
+                self._cv.wait_for(
+                    lambda: self._push_buf[key][2] >= target
+                    or self._dead, timeout=600)
+                if self._push_buf[key][2] < target:
+                    # failed round: withdraw this worker's contribution
+                    # so a retry can never double-count it, then fail
+                    a2, c2, g2 = self._push_buf[key]
+                    if g2 < target and c2 > 0:
+                        self._push_buf[key] = (
+                            (0.0, 0, g2) if c2 == 1
+                            else (a2 - value, c2 - 1, g2))
+                    return self._wait_error()
+        return None
+
     def _handle(self, conn):
         rank = None
         clean_exit = False
@@ -126,48 +163,27 @@ class KVServer:
                         self._store.setdefault(msg["key"], msg["value"])
                     _send_msg(conn, {"ok": True})
                 elif op == "push":
-                    key, value = msg["key"], msg["value"]
-                    if not self._sync or msg.get("async"):
-                        # server-wide async mode, or an explicit
-                        # per-push async request from the worker
-                        with self._cv:
-                            self._apply_update(key, value)
-                        _send_msg(conn, {"ok": True})
-                        continue
-                    with self._cv:
-                        if self._dead:
-                            # refuse new sync rounds with a dead peer
-                            _send_msg(conn, self._wait_error())
-                            continue
-                        acc, cnt, gen = self._push_buf.get(key, (0.0, 0, 0))
-                        acc = value if cnt == 0 else acc + value
-                        cnt += 1
-                        if cnt == self._num_workers:
-                            self._apply_update(key, acc)
-                            self._push_buf[key] = (0.0, 0, gen + 1)
-                            self._cv.notify_all()
-                        else:
-                            self._push_buf[key] = (acc, cnt, gen)
-                            target = gen + 1
-                            self._cv.wait_for(
-                                lambda: self._push_buf[key][2] >= target
-                                or self._dead, timeout=600)
-                            if self._push_buf[key][2] < target:
-                                # failed round: withdraw this worker's
-                                # contribution so a retry can never
-                                # double-count it, then fail fast
-                                a2, c2, g2 = self._push_buf[key]
-                                if g2 < target and c2 > 0:
-                                    self._push_buf[key] = (
-                                        (0.0, 0, g2) if c2 == 1
-                                        else (a2 - value, c2 - 1, g2))
-                                _send_msg(conn, self._wait_error())
-                                continue
-                    _send_msg(conn, {"ok": True})
+                    err = self._push_one(msg["key"], msg["value"],
+                                         msg.get("async"))
+                    _send_msg(conn, err or {"ok": True})
+                elif op == "push_batch":
+                    # one RTT for a whole step's gradients: keys are
+                    # aggregated in order, so every worker's handler
+                    # thread walks the same sequence of sync rounds
+                    err = None
+                    for key, value in msg["items"]:
+                        err = self._push_one(key, value, msg.get("async"))
+                        if err:
+                            break
+                    _send_msg(conn, err or {"ok": True})
                 elif op == "pull":
                     with self._cv:
                         val = self._store[msg["key"]]
                     _send_msg(conn, {"ok": True, "value": val})
+                elif op == "pull_batch":
+                    with self._cv:
+                        vals = [self._store[k] for k in msg["keys"]]
+                    _send_msg(conn, {"ok": True, "values": vals})
                 elif op == "set_optimizer":
                     self._optimizer = pickle.loads(msg["value"])
                     self._updater = None
@@ -266,6 +282,18 @@ class WorkerClient:
 
     def pull(self, key):
         return self._rpc(op="pull", key=key)["value"]
+
+    def push_batch(self, items, sync=True):
+        """One RTT for many (key, value) pushes — a full training step's
+        gradients travel in a single message."""
+        msg = {"op": "push_batch",
+               "items": [(k, np.asarray(v)) for k, v in items]}
+        if not sync:
+            msg["async"] = True
+        self._rpc(**msg)
+
+    def pull_batch(self, keys):
+        return self._rpc(op="pull_batch", keys=list(keys))["values"]
 
     def set_optimizer(self, pickled):
         self._rpc(op="set_optimizer", value=pickled)

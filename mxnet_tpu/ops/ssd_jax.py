@@ -19,12 +19,23 @@ from __future__ import annotations
 import numpy as np
 
 
-def _iou_jnp(jnp, a, b):
-    """IoU of corner boxes a (..., N, 4) vs b (..., M, 4) -> (..., N, M);
-    one shared implementation with the _contrib_box_iou op."""
-    from .contrib_ops import _box_iou
+def _iou_jnp(jnp, a, b, plus_one=False):
+    """IoU of corner boxes a (..., N, 4) vs b (..., M, 4) -> (..., N, M).
 
-    return _box_iou(a, b, format="corner")
+    plus_one=False shares the _contrib_box_iou implementation (unit-box
+    convention); plus_one=True uses the +1 pixel-box area convention
+    ((x2-x1+1)*(y2-y1+1)) that proposal.cc's NMS requires."""
+    if not plus_one:
+        from .contrib_ops import _box_iou
+
+        return _box_iou(a, b, format="corner")
+    lt = jnp.maximum(a[..., :, None, :2], b[..., None, :, :2])
+    rb = jnp.minimum(a[..., :, None, 2:], b[..., None, :, 2:])
+    wh = jnp.maximum(rb - lt + 1, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    area_a = (a[..., 2] - a[..., 0] + 1) * (a[..., 3] - a[..., 1] + 1)
+    area_b = (b[..., 2] - b[..., 0] + 1) * (b[..., 3] - b[..., 1] + 1)
+    return inter / (area_a[..., :, None] + area_b[..., None, :] - inter)
 
 
 def _encode_jnp(jnp, anchors, gts, variances):
@@ -159,8 +170,10 @@ def multibox_detection_jax(cls_prob, loc_pred, anchor, clip, threshold,
     """Decode + per-class NMS, fully on device.
 
     Output rows [id, score, x1, y1, x2, y2]; suppressed / background
-    rows are all -1 and sorted to the back (kept rows appear in
-    descending-score order, as the reference emits them)."""
+    rows are all -1 and sorted to the back.  Kept rows appear in
+    descending-score order when NMS runs; with NMS disabled
+    (nms_threshold outside (0, 1]) they keep anchor order, exactly as
+    the reference emits them."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -278,20 +291,10 @@ def proposal_jax(cls_prob, bbox_pred, im_info, base_anchors, stride,
         top_boxes = boxes[top_idx]
 
         def nms_step(i, alive):
-            # proposal.cc NMS uses the +1 pixel-box area convention
-            # ((x2-x1+1)*(y2-y1+1)) — corner IoU would shift decisions
-            # near the threshold
-            b_i = top_boxes[i]
-            xx1 = jnp.maximum(b_i[0], top_boxes[:, 0])
-            yy1 = jnp.maximum(b_i[1], top_boxes[:, 1])
-            xx2 = jnp.minimum(b_i[2], top_boxes[:, 2])
-            yy2 = jnp.minimum(b_i[3], top_boxes[:, 3])
-            inter = jnp.maximum(xx2 - xx1 + 1, 0) * \
-                jnp.maximum(yy2 - yy1 + 1, 0)
-            area = (top_boxes[:, 2] - top_boxes[:, 0] + 1) * \
-                (top_boxes[:, 3] - top_boxes[:, 1] + 1)
-            area_i = (b_i[2] - b_i[0] + 1) * (b_i[3] - b_i[1] + 1)
-            iou_row = inter / (area_i + area - inter)
+            # proposal.cc NMS uses the +1 pixel-box area convention —
+            # corner IoU would shift decisions near the threshold
+            iou_row = _iou_jnp(jnp, top_boxes[i][None, :], top_boxes,
+                               plus_one=True)[0]
             kill = alive[i] & (iou_row > nms_thr) & \
                 (jnp.arange(pre_n) > i)
             return alive & ~kill
