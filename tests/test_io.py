@@ -151,3 +151,31 @@ def test_prefetching_iter():
     pf = mx.io.PrefetchingIter(it)
     count = sum(1 for _ in pf)
     assert count == 4
+
+
+def test_im2rec_roundtrip(tmp_path):
+    """tools/im2rec.py --list + pack -> ImageRecordIter reads it back."""
+    import subprocess
+    import sys as _sys
+    from PIL import Image
+
+    root = tmp_path / "data"
+    for cls in ("a", "b"):
+        (root / cls).mkdir(parents=True)
+        rng = np.random.RandomState(0)
+        for i in range(3):
+            Image.fromarray(rng.randint(0, 255, (40, 50, 3),
+                                        dtype=np.uint8)).save(
+                str(root / cls / ("%d.jpg" % i)))
+    prefix = str(tmp_path / "out")
+    tool = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "im2rec.py")
+    subprocess.run([_sys.executable, tool, prefix, str(root), "--list"],
+                   check=True)
+    subprocess.run([_sys.executable, tool, prefix + ".lst", str(root),
+                    "--resize", "32"], check=True)
+    it = mx.io.ImageRecordIter(path_imgrec=prefix + ".rec",
+                               data_shape=(3, 32, 32), batch_size=2)
+    batch = next(iter(it))
+    assert batch.data[0].shape == (2, 3, 32, 32)
+    assert set(np.unique(batch.label[0].asnumpy())) <= {0.0, 1.0}
