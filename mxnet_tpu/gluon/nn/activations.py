@@ -1,45 +1,54 @@
-"""Advanced activations (reference parity: gluon/nn/activations.py)."""
+"""Advanced activations (reference parity: gluon/nn/activations.py).
+
+All of these lower onto the one LeakyReLU family op (act_type selects
+the kernel), so the blocks are generated from a small spec table
+instead of hand-written one per class.
+"""
 from __future__ import annotations
 
 from ..block import HybridBlock
 from .basic_layers import Activation
 
-__all__ = ["Activation", "LeakyReLU", "PReLU", "ELU", "SELU", "Swish", "GELU"]
+__all__ = ["Activation", "LeakyReLU", "PReLU", "ELU", "SELU", "Swish",
+           "GELU"]
 
 
-class LeakyReLU(HybridBlock):
-    def __init__(self, alpha, **kwargs):
-        assert alpha >= 0, "Slope coefficient for LeakyReLU must be >= 0."
-        super().__init__(**kwargs)
-        self._alpha = alpha
-
-    def hybrid_forward(self, F, x):
-        return F.LeakyReLU(x, act_type="leaky", slope=self._alpha)
-
-    def __repr__(self):
-        return "LeakyReLU({0})".format(self._alpha)
+_REQUIRED = object()
 
 
-class PReLU(HybridBlock):
-    def __init__(self, alpha_initializer=None, **kwargs):
-        super().__init__(**kwargs)
-        from ... import initializer
+def _slope_block(cls_name, act_type, default_slope, check=None,
+                 show_repr=False):
+    """Build a HybridBlock class whose forward is the LeakyReLU-family
+    op with a fixed act_type and a stored slope coefficient.
+    default_slope=_REQUIRED makes alpha a mandatory argument (the
+    reference's LeakyReLU signature)."""
 
-        init = alpha_initializer or initializer.Constant(0.25)
-        with self.name_scope():
-            self.alpha = self.params.get("alpha", shape=(1,), init=init)
-
-    def hybrid_forward(self, F, x, alpha):
-        return F.LeakyReLU(x, gamma=alpha, act_type="prelu")
-
-
-class ELU(HybridBlock):
-    def __init__(self, alpha=1.0, **kwargs):
-        super().__init__(**kwargs)
-        self._alpha = alpha
+    def __init__(self, alpha=default_slope, **kwargs):
+        if alpha is _REQUIRED:
+            raise TypeError("%s requires the alpha (slope) argument"
+                            % cls_name)
+        if check:
+            check(alpha)
+        HybridBlock.__init__(self, **kwargs)
+        self._slope = alpha
 
     def hybrid_forward(self, F, x):
-        return F.LeakyReLU(x, act_type="elu", slope=self._alpha)
+        return F.LeakyReLU(x, act_type=act_type, slope=self._slope)
+
+    ns = {"__init__": __init__, "hybrid_forward": hybrid_forward}
+    if show_repr:
+        ns["__repr__"] = lambda self: "%s(%s)" % (cls_name, self._slope)
+    return type(cls_name, (HybridBlock,), ns)
+
+
+def _require_nonneg(alpha):
+    if alpha < 0:
+        raise ValueError("LeakyReLU slope must be >= 0, got %s" % alpha)
+
+
+LeakyReLU = _slope_block("LeakyReLU", "leaky", _REQUIRED,
+                         check=_require_nonneg, show_repr=True)
+ELU = _slope_block("ELU", "elu", 1.0)
 
 
 class SELU(HybridBlock):
@@ -52,7 +61,25 @@ class GELU(HybridBlock):
         return F.LeakyReLU(x, act_type="gelu")
 
 
+class PReLU(HybridBlock):
+    """Leaky ReLU whose slope is a learned parameter."""
+
+    def __init__(self, alpha_initializer=None, **kwargs):
+        super().__init__(**kwargs)
+        from ... import initializer
+
+        with self.name_scope():
+            self.alpha = self.params.get(
+                "alpha", shape=(1,),
+                init=alpha_initializer or initializer.Constant(0.25))
+
+    def hybrid_forward(self, F, x, alpha):
+        return F.LeakyReLU(x, gamma=alpha, act_type="prelu")
+
+
 class Swish(HybridBlock):
+    """x * sigmoid(beta x)."""
+
     def __init__(self, beta=1.0, **kwargs):
         super().__init__(**kwargs)
         self._beta = beta

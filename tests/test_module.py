@@ -181,3 +181,36 @@ def test_python_loss_module():
     seq.forward(next(iter(it)), is_train=False)
     after = seq.get_outputs()[0].asnumpy()
     assert not np.allclose(before, after)  # the fc actually updated
+
+
+def test_bucketing_get_params_synced_after_update():
+    """get_params after update must sync device values back to the host
+    copies (the dirty flag crosses BucketingModule -> child Module)."""
+    def gen(key):
+        d = mx.sym.var("data")
+        s = mx.sym.FullyConnected(d, num_hidden=4, name="fc")
+        s = mx.sym.SoftmaxOutput(s, mx.sym.var("softmax_label"),
+                                 name="softmax")
+        return s, ("data",), ("softmax_label",)
+
+    from mxnet_tpu.io.io import DataBatch
+
+    bm = mx.mod.BucketingModule(gen, default_bucket_key=8)
+    bm.bind(data_shapes=[("data", (2, 8))],
+            label_shapes=[("softmax_label", (2,))])
+    bm.init_params(mx.init.Xavier())
+    bm.init_optimizer(optimizer="sgd",
+                      optimizer_params={"learning_rate": 1.0})
+    p0 = {k: v.asnumpy().copy() for k, v in bm.get_params()[0].items()}
+    batch = DataBatch(
+        data=[mx.nd.array(np.random.RandomState(0).rand(2, 8)
+                       .astype(np.float32))],
+        label=[mx.nd.array(np.array([0.0, 1.0], np.float32))])
+    batch.bucket_key = 8
+    batch.provide_data = [("data", (2, 8))]
+    batch.provide_label = [("softmax_label", (2,))]
+    bm.forward(batch, is_train=True)
+    bm.backward()
+    bm.update()
+    p1 = bm.get_params()[0]
+    assert any(np.abs(p1[k].asnumpy() - p0[k]).max() > 0 for k in p0)
