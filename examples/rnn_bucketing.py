@@ -1,0 +1,175 @@
+"""Bucketed RNN training — counterpart of the reference's
+example/rnn/bucketing/lstm_bucketing.py.
+
+Variable-length synthetic sequences are grouped into length buckets; a
+BucketingModule compiles one executor (one XLA program) per bucket
+while every bucket shares the same parameters.  This is the reference's
+long-sequence strategy (SURVEY §5 bucketing) expressed as per-shape jit
+caches.
+"""
+import argparse
+import logging
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import mxnet_tpu as mx
+from mxnet_tpu.io.io import DataBatch
+
+
+class BucketSeqIter(mx.io.DataIter):
+    """Synthetic Markov sequences bucketed by length (the reference's
+    BucketSentenceIter shape).
+
+    Bucket keys ARE the model sequence lengths: each batch carries
+    (data, label) of exactly `bucket_key` tokens (the underlying chain
+    is one token longer for the shifted-target pair), so the module's
+    shapes and this iterator's advertised metadata always agree.
+    """
+
+    def __init__(self, vocab, buckets, batch_size, batches_per_bucket=8,
+                 seed=7):
+        super().__init__(batch_size)
+        self.buckets = sorted(buckets)
+        self.vocab = vocab
+        self.batch_size = batch_size
+        self.default_bucket_key = max(self.buckets)
+        rng = np.random.RandomState(seed)
+        nxt = (np.arange(vocab) * 5 + 1) % vocab
+        self._batches = []
+        for blen in self.buckets:
+            for _ in range(batches_per_bucket):
+                seq = np.empty((batch_size, blen + 1), np.int64)
+                seq[:, 0] = rng.randint(vocab, size=batch_size)
+                for t in range(1, blen + 1):
+                    take = rng.rand(batch_size) < 0.85
+                    seq[:, t] = np.where(take, nxt[seq[:, t - 1]],
+                                         rng.randint(vocab,
+                                                     size=batch_size))
+                self._batches.append((blen, seq))
+        rng.shuffle(self._batches)
+        self._pos = 0
+
+    @property
+    def provide_data(self):
+        return [("data", (self.batch_size, self.default_bucket_key))]
+
+    @property
+    def provide_label(self):
+        return [("softmax_label",
+                 (self.batch_size, self.default_bucket_key))]
+
+    def reset(self):
+        self._pos = 0
+
+    def next(self):
+        if self._pos >= len(self._batches):
+            raise StopIteration
+        blen, seq = self._batches[self._pos]
+        self._pos += 1
+        batch = DataBatch(data=[mx.nd.array(seq[:, :-1])],
+                          label=[mx.nd.array(seq[:, 1:])])
+        batch.bucket_key = blen
+        batch.provide_data = [("data", (self.batch_size, blen))]
+        batch.provide_label = [("softmax_label",
+                                (self.batch_size, blen))]
+        return batch
+
+
+def make_sym_gen(vocab, num_embed, num_hidden):
+    """Per-length LSTM-LM symbol; every bucket shares one weight set
+    because the same named variables appear in every unrolled graph
+    (the reference lstm_bucketing.py pattern with explicit cells)."""
+
+    def sym_gen(seq_len):
+        data = mx.sym.var("data")
+        label = mx.sym.var("softmax_label")
+        # weights shared across time steps AND buckets by name
+        i2h_w = mx.sym.var("lstm_i2h_weight")
+        i2h_b = mx.sym.var("lstm_i2h_bias")
+        h2h_w = mx.sym.var("lstm_h2h_weight")
+        h2h_b = mx.sym.var("lstm_h2h_bias")
+
+        emb = mx.sym.Embedding(data, input_dim=vocab,
+                               output_dim=num_embed, name="embed")
+        steps = mx.sym.SliceChannel(emb, num_outputs=seq_len, axis=1,
+                                    squeeze_axis=True)
+        h = c = None
+        outs = []
+        for t in range(seq_len):
+            gates = mx.sym.FullyConnected(
+                steps[t], weight=i2h_w, bias=i2h_b,
+                num_hidden=4 * num_hidden, name="i2h_t%d" % t)
+            if h is not None:
+                gates = gates + mx.sym.FullyConnected(
+                    h, weight=h2h_w, bias=h2h_b,
+                    num_hidden=4 * num_hidden, name="h2h_t%d" % t)
+            sl = mx.sym.SliceChannel(gates, num_outputs=4, axis=1)
+            i = mx.sym.sigmoid(sl[0])
+            f = mx.sym.sigmoid(sl[1])
+            g = mx.sym.tanh(sl[2])
+            o = mx.sym.sigmoid(sl[3])
+            c = g * i if c is None else f * c + i * g
+            h = o * mx.sym.tanh(c)
+            outs.append(mx.sym.Reshape(h, shape=(0, 1, num_hidden)))
+        seq = mx.sym.Concat(*outs, dim=1)
+        flat = mx.sym.Reshape(seq, shape=(-1, num_hidden))
+        fc = mx.sym.FullyConnected(flat, num_hidden=vocab, name="fc")
+        lab = mx.sym.Reshape(label, shape=(-1,))
+        sm = mx.sym.SoftmaxOutput(fc, lab, name="softmax")
+        return sm, ("data",), ("softmax_label",)
+
+    return sym_gen
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--vocab", type=int, default=64)
+    p.add_argument("--num-embed", type=int, default=32)
+    p.add_argument("--num-hidden", type=int, default=64)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--buckets", default="8,16,24")
+    p.add_argument("--epochs", type=int, default=3)
+    p.add_argument("--lr", type=float, default=0.2)
+    args = p.parse_args()
+    logging.basicConfig(level=logging.INFO)
+
+    buckets = [int(b) for b in args.buckets.split(",")]
+    it = BucketSeqIter(args.vocab, buckets, args.batch_size)
+
+    mod = mx.mod.BucketingModule(
+        make_sym_gen(args.vocab, args.num_embed, args.num_hidden),
+        default_bucket_key=it.default_bucket_key)
+
+    mod.bind(data_shapes=it.provide_data,
+             label_shapes=it.provide_label)
+    mod.init_params(mx.init.Xavier())
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": args.lr,
+                                         "momentum": 0.9})
+    metric = mx.metric.Perplexity(ignore_label=None)
+    for epoch in range(args.epochs):
+        it.reset()
+        metric.reset()
+        tic = time.time()
+        nbatch = 0
+        for batch in it:
+            mod.forward(batch, is_train=True)
+            mod.backward()
+            mod.update()
+            mod.update_metric(metric, batch.label)
+            nbatch += 1
+        logging.info("epoch %d  %s  (%d batches, %.1fs)", epoch,
+                     metric.get(), nbatch, time.time() - tic)
+    name, ppl = metric.get()
+    print("final %s: %.2f (random = %d)" % (name, ppl, args.vocab))
+    return ppl
+
+
+if __name__ == "__main__":
+    main()

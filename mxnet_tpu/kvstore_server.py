@@ -17,10 +17,12 @@ CPU-host aggregation workloads (sparse embeddings).
 """
 from __future__ import annotations
 
+import json
 import os
 import pickle
 import socket
 import struct
+import time
 import threading
 
 import numpy as np
@@ -71,6 +73,11 @@ class KVServer:
         # failure detection (reference kvstore_dist.h:121-126 node-death
         # handling): ranks whose connection dropped without shutdown
         self._dead = set()
+        # server-side profiler (reference KVStoreServerProfilerCommand)
+        self._prof_on = False
+        self._prof_paused = False
+        self._prof_stats = {}
+        self._prof_file = "server_profile.json"
 
     def serve(self):
         threads = []
@@ -144,6 +151,64 @@ class KVServer:
                     return self._wait_error()
         return None
 
+    @staticmethod
+    def _flag(body, default=False):
+        """Accept '1'/'0' and the profiler's 'run'/'stop' strings."""
+        s = str(body or "").strip().lower()
+        if s in ("1", "run", "true", "on"):
+            return True
+        if s in ("0", "stop", "false", "off"):
+            return False
+        return default
+
+    def _handle_command(self, head, body):
+        """Worker->server control channel.  Profiler heads mirror the
+        reference KVStoreServerProfilerCommand enum (kvstore.h:49):
+        set_config / state / pause / dump operate a server-side op-stat
+        collector (per-op counts + wall time), dumped as JSON.  Errors
+        must come back as {'ok': False} — an escaping exception would
+        kill this handler thread and mark the worker's rank dead."""
+        try:
+            if head == "profiler_set_config":
+                with self._cv:
+                    self._prof_file = str(body or "server_profile.json")
+                    self._prof_stats = {}
+                return {"ok": True}
+            if head == "profiler_state":
+                with self._cv:
+                    self._prof_on = self._flag(body)
+                    self._prof_paused = False
+                return {"ok": True}
+            if head == "profiler_pause":
+                with self._cv:
+                    pause = self._flag(body, default=True)
+                    if pause:
+                        self._prof_paused = self._prof_on
+                        self._prof_on = False
+                    elif self._prof_paused:
+                        # resume restores the pre-pause state; it never
+                        # force-enables a profiler that was off
+                        self._prof_on = True
+                        self._prof_paused = False
+                return {"ok": True}
+            if head == "profiler_dump":
+                with self._cv:
+                    stats = dict(self._prof_stats)
+                    path = self._prof_file
+                with open(path, "w") as f:
+                    json.dump(stats, f)
+                return {"ok": True, "path": path}
+            return {"ok": True}   # unknown heads accepted, like the ref
+        except Exception as e:
+            return {"ok": False, "error": "server command %r failed: %s"
+                                          % (head, e)}
+
+    def _prof_record(self, op, seconds):
+        if self._prof_on:
+            with self._cv:
+                cnt, total = self._prof_stats.get(op, (0, 0.0))
+                self._prof_stats[op] = (cnt + 1, total + seconds)
+
     def _handle(self, conn):
         rank = None
         clean_exit = False
@@ -163,26 +228,36 @@ class KVServer:
                         self._store.setdefault(msg["key"], msg["value"])
                     _send_msg(conn, {"ok": True})
                 elif op == "push":
+                    t0 = time.monotonic()
                     err = self._push_one(msg["key"], msg["value"],
                                          msg.get("async"))
+                    self._prof_record("push", time.monotonic() - t0)
                     _send_msg(conn, err or {"ok": True})
                 elif op == "push_batch":
                     # one RTT for a whole step's gradients: keys are
                     # aggregated in order, so every worker's handler
                     # thread walks the same sequence of sync rounds
+                    t0 = time.monotonic()
                     err = None
                     for key, value in msg["items"]:
                         err = self._push_one(key, value, msg.get("async"))
                         if err:
                             break
+                    self._prof_record("push_batch",
+                                      time.monotonic() - t0)
                     _send_msg(conn, err or {"ok": True})
                 elif op == "pull":
+                    t0 = time.monotonic()
                     with self._cv:
                         val = self._store[msg["key"]]
+                    self._prof_record("pull", time.monotonic() - t0)
                     _send_msg(conn, {"ok": True, "value": val})
                 elif op == "pull_batch":
+                    t0 = time.monotonic()
                     with self._cv:
                         vals = [self._store[k] for k in msg["keys"]]
+                    self._prof_record("pull_batch",
+                                      time.monotonic() - t0)
                     _send_msg(conn, {"ok": True, "values": vals})
                 elif op == "set_optimizer":
                     self._optimizer = pickle.loads(msg["value"])
@@ -210,7 +285,8 @@ class KVServer:
                                 continue
                     _send_msg(conn, {"ok": True})
                 elif op == "command":
-                    _send_msg(conn, {"ok": True})
+                    _send_msg(conn, self._handle_command(
+                        msg.get("head"), msg.get("body")))
                 elif op == "shutdown":
                     _send_msg(conn, {"ok": True})
                     self._done.set()

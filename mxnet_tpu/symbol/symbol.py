@@ -688,6 +688,12 @@ def _invoke_sym(op_name, inputs, attrs, name=None):
     sym_kwargs = {k: v for k, v in attrs.items() if isinstance(v, Symbol)}
     for k in sym_kwargs:
         del attrs[k]
+    # input-position kwargs passed as None (e.g. weight=None meaning
+    # "auto-create") must not linger in attrs: the executor would pass
+    # them as keywords on top of the positional inputs
+    for k in _INPUT_NAMES.get(op_name, ()):
+        if k in attrs and attrs[k] is None:
+            del attrs[k]
     name = NameManager.current().get(name, op_name.strip("_"))
 
     entries = []
@@ -700,30 +706,37 @@ def _invoke_sym(op_name, inputs, attrs, name=None):
         else:
             raise MXNetError("symbol op %s: input must be Symbol, got %r"
                              % (op_name, type(s)))
-    # named symbol kwargs in canonical op order
-    if sym_kwargs:
-        expected = _op_input_names(op_name, len(entries) + len(sym_kwargs))
-        ordered = [k for k in expected if k in sym_kwargs]
-        ordered += [k for k in sym_kwargs if k not in ordered]
-        for k in ordered:
-            entries.append(sym_kwargs[k]._entries[0])
-
-    # auto-create missing variable inputs (e.g. conv weights) as reference
-    # symbol composition does
+    # place keyword Symbols at their canonical input positions and
+    # auto-create variables for every other missing slot (reference
+    # symbol composition); a keyword for a later slot (bias=b with
+    # weight omitted) must NOT slide into the earlier position
     expected_n = info.num_inputs
     if expected_n in (-1, None):
         expected_n = _expected_inputs(op_name, attrs)
-    if expected_n not in (-1, None) and len(entries) < expected_n:
+    if expected_n not in (-1, None) and \
+            len(entries) + len(sym_kwargs) <= expected_n:
         names = _op_input_names(op_name, expected_n)
         no_bias = pbool(attrs.get("no_bias"))
         for i in range(len(entries), expected_n):
             nm = names[i] if i < len(names) else "arg%d" % i
+            if nm in sym_kwargs:
+                entries.append(sym_kwargs.pop(nm)._entries[0])
+                continue
             if nm == "bias" and no_bias:
                 continue
             if nm == "state_cell" and attrs.get("mode", "lstm") != "lstm":
                 continue
             v = var("%s_%s" % (name, nm))
             entries.append(v._entries[0])
+    if sym_kwargs:
+        # variadic ops / names outside the canonical table: append in
+        # canonical-then-given order
+        expected = _op_input_names(op_name,
+                                   len(entries) + len(sym_kwargs))
+        ordered = [k for k in expected if k in sym_kwargs]
+        ordered += [k for k in sym_kwargs if k not in ordered]
+        for k in ordered:
+            entries.append(sym_kwargs[k]._entries[0])
 
     node = _Node(op_name, attrs, entries, name,
                  AttrScope.current().get({}))

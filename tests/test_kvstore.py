@@ -288,3 +288,59 @@ def test_dist_async_mode_applies_immediately():
     np.testing.assert_array_equal(w1.pull("k"), np.full(3, 7.0))
     w0._sock.close()
     w1._sock.close()
+
+
+def _prof_worker(rank, num_workers, port, dump_path, results):
+    os.environ["DMLC_PS_ROOT_URI"] = "127.0.0.1"
+    os.environ["DMLC_PS_ROOT_PORT"] = str(port)
+    os.environ["DMLC_WORKER_RANK"] = str(rank)
+    os.environ["DMLC_NUM_WORKER"] = str(num_workers)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import mxnet_tpu as mx2
+    from mxnet_tpu import kvstore as kvs2
+
+    kv = kvs2.create("dist_sync")
+    kv.init("w", mx2.nd.zeros((2, 2)))
+    kv.barrier()
+    if rank == 0:
+        # reference KVStoreServerProfilerCommand flow: config -> on ->
+        # (work) -> dump
+        kv.send_command_to_servers("profiler_set_config", dump_path)
+        kv.send_command_to_servers("profiler_state", "1")
+    kv.barrier()
+    kv.push("w", mx2.nd.ones((2, 2)))
+    kv.barrier()
+    if rank == 0:
+        kv.send_command_to_servers("profiler_dump", "")
+    kv.barrier()
+    results[rank] = True
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="fork-based")
+def test_server_profiler_commands(tmp_path):
+    """Worker-controlled server-side profiling (reference
+    tests/nightly/test_server_profiling.py surface)."""
+    import json
+
+    num_workers = 2
+    port = _free_port()
+    dump_path = str(tmp_path / "server_profile.json")
+    ctx = multiprocessing.get_context("spawn")
+    manager = ctx.Manager()
+    results = manager.dict()
+    sp = ctx.Process(target=_server_proc, args=(port, num_workers),
+                     daemon=True)
+    sp.start()
+    time.sleep(0.5)
+    workers = [ctx.Process(target=_prof_worker,
+                           args=(r, num_workers, port, dump_path, results),
+                           daemon=True)
+               for r in range(num_workers)]
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join(timeout=90)
+    sp.terminate()
+    assert all(results.get(r) for r in range(num_workers)), dict(results)
+    stats = json.load(open(dump_path))
+    assert "push" in stats and stats["push"][0] == num_workers, stats

@@ -115,3 +115,39 @@ def test_eval():
     a = mx.sym.var("a")
     out = (a * 3).eval(a=mx.nd.array([1.0, 2.0]))
     assert_almost_equal(out[0], [3.0, 6.0])
+
+
+def test_keyword_symbol_inputs_and_sharing():
+    """weight=/bias= Symbol kwargs become graph inputs (reference symbol
+    composition); the same var used twice shares the parameter, and
+    weight=None means auto-create."""
+    d = mx.sym.var("data")
+    w = mx.sym.var("w")
+    b = mx.sym.var("b")
+    h1 = mx.sym.FullyConnected(d, weight=w, bias=b, num_hidden=4,
+                               name="fc1")
+    h2 = mx.sym.FullyConnected(d, weight=w, bias=b, num_hidden=4,
+                               name="fc2")
+    h3 = mx.sym.FullyConnected(h1, weight=None, num_hidden=4, name="fc3")
+    out = h1 + h2 + h3
+    args = {"data": mx.nd.ones((2, 3)), "w": mx.nd.ones((4, 3)),
+            "b": mx.nd.zeros(4), "fc3_weight": mx.nd.ones((4, 4)),
+            "fc3_bias": mx.nd.zeros(4)}
+    assert set(out.list_arguments()) == set(args)
+    res = out.bind(args=args).forward()[0].asnumpy()
+    # h1 == h2 == 3; h3 == 12 -> total 18
+    np.testing.assert_allclose(res, 18.0)
+
+
+def test_keyword_symbol_skips_to_canonical_slot():
+    """bias= with weight omitted must bind to the bias position (weight
+    auto-created), not slide into the weight slot."""
+    d = mx.sym.var("data")
+    b = mx.sym.var("b")
+    out = mx.sym.FullyConnected(d, weight=None, bias=b, num_hidden=4,
+                                name="fc")
+    assert out.list_arguments() == ["data", "fc_weight", "b"]
+    ex = out.bind(args={"data": mx.nd.ones((2, 3)),
+                        "fc_weight": mx.nd.ones((4, 3)),
+                        "b": mx.nd.ones(4)})
+    np.testing.assert_allclose(ex.forward()[0].asnumpy(), 4.0)
