@@ -60,6 +60,7 @@ from . import recordio  # noqa: F401
 from . import image  # noqa: F401
 from . import executor  # noqa: F401
 from . import profiler  # noqa: F401
+from . import rnn  # noqa: F401
 from . import runtime  # noqa: F401
 from . import test_utils  # noqa: F401
 from . import visualization  # noqa: F401

@@ -1,0 +1,14 @@
+"""mx.rnn — legacy symbolic RNN cell API (reference parity:
+python/mxnet/rnn/{rnn_cell,rnn,io}.py)."""
+from .rnn_cell import (RNNParams, BaseRNNCell, RNNCell, LSTMCell, GRUCell,
+                       SequentialRNNCell, DropoutCell, ResidualCell,
+                       BidirectionalCell)
+from .rnn import (save_rnn_checkpoint, load_rnn_checkpoint,
+                  do_rnn_checkpoint)
+from .io import encode_sentences, BucketSentenceIter
+
+__all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "DropoutCell", "ResidualCell",
+           "BidirectionalCell", "save_rnn_checkpoint",
+           "load_rnn_checkpoint", "do_rnn_checkpoint",
+           "encode_sentences", "BucketSentenceIter"]

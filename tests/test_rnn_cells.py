@@ -1,0 +1,147 @@
+"""mx.rnn symbolic cell API (reference tests/python/unittest/test_rnn.py
+basic cases)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def _bind_forward(outs, states, shapes):
+    out = mx.sym.Group([outs[-1]] + list(states)) \
+        if isinstance(outs, list) else outs
+    args = {}
+    rng = np.random.RandomState(0)
+    for name in out.list_arguments():
+        shp = shapes.get(name)
+        if shp is None:
+            raise AssertionError("missing shape for %s" % name)
+        args[name] = nd.array(rng.rand(*shp).astype(np.float32) * 0.1)
+    return out.bind(args=args).forward()
+
+
+def test_rnn_cell_unroll():
+    cell = mx.rnn.RNNCell(8, prefix="rnn_")
+    outs, states = cell.unroll(3, inputs=mx.sym.var("x"), layout="NTC")
+    assert len(outs) == 3 and len(states) == 1
+    shapes = {"x": (2, 3, 4), "rnn_i2h_weight": (8, 4),
+              "rnn_i2h_bias": (8,), "rnn_h2h_weight": (8, 8),
+              "rnn_h2h_bias": (8,), "rnn_state": (2, 8)}
+    res = _bind_forward(outs, states, shapes)
+    assert res[0].shape == (2, 8)
+
+
+def test_lstm_cell_unroll_merged():
+    cell = mx.rnn.LSTMCell(8, prefix="lstm_")
+    out, states = cell.unroll(4, inputs=mx.sym.var("x"), layout="NTC",
+                              merge_outputs=True)
+    assert len(states) == 2
+    shapes = {"x": (2, 4, 5), "lstm_i2h_weight": (32, 5),
+              "lstm_i2h_bias": (32,), "lstm_h2h_weight": (32, 8),
+              "lstm_h2h_bias": (32,), "lstm_state": (2, 8),
+              "lstm_state_cell": (2, 8)}
+    res = _bind_forward(out, [], shapes)
+    assert res[0].shape == (2, 4, 8)
+
+
+def test_gru_cell_runs():
+    cell = mx.rnn.GRUCell(6, prefix="gru_")
+    outs, states = cell.unroll(2, inputs=mx.sym.var("x"), layout="NTC")
+    shapes = {"x": (3, 2, 4), "gru_i2h_weight": (18, 4),
+              "gru_i2h_bias": (18,), "gru_h2h_weight": (18, 6),
+              "gru_h2h_bias": (18,), "gru_state": (3, 6)}
+    res = _bind_forward(outs, states, shapes)
+    assert res[0].shape == (3, 6)
+
+
+def test_stacked_and_residual_cells():
+    stack = mx.rnn.SequentialRNNCell()
+    stack.add(mx.rnn.LSTMCell(8, prefix="l0_"))
+    stack.add(mx.rnn.ResidualCell(mx.rnn.LSTMCell(8, prefix="l1_")))
+    outs, states = stack.unroll(2, inputs=mx.sym.var("x"), layout="NTC")
+    assert len(states) == 4
+    shapes = {"x": (2, 2, 8)}
+    for p in ("l0_", "l1_"):
+        shapes.update({p + "i2h_weight": (32, 8), p + "i2h_bias": (32,),
+                       p + "h2h_weight": (32, 8), p + "h2h_bias": (32,),
+                       p + "state": (2, 8), p + "state_cell": (2, 8)})
+    res = _bind_forward(outs, states, shapes)
+    assert res[0].shape == (2, 8)
+
+
+def test_weight_sharing_across_unroll_lengths():
+    cell = mx.rnn.LSTMCell(4, prefix="s_")
+    o3, _ = cell.unroll(3, inputs=mx.sym.var("x3"), layout="NTC")
+    o5, _ = cell.unroll(5, inputs=mx.sym.var("x5"), layout="NTC")
+    a3 = set(mx.sym.Group(o3).list_arguments()) - {"x3", "s_state",
+                                                   "s_state_cell"}
+    a5 = set(mx.sym.Group(o5).list_arguments()) - {"x5", "s_state",
+                                                   "s_state_cell"}
+    assert a3 == a5        # same weight set at every length
+
+
+def test_encode_sentences_and_bucket_iter():
+    sents = [["a", "b", "c"], ["b", "c"], ["a", "c", "b", "a"]]
+    enc, vocab = mx.rnn.encode_sentences(sents, start_label=1)
+    assert len(vocab) >= 3 and all(isinstance(r, list) for r in enc)
+    it = mx.rnn.BucketSentenceIter(enc, batch_size=1, buckets=[3, 4],
+                                   invalid_label=0)
+    keys = set()
+    for batch in it:
+        keys.add(batch.bucket_key)
+        assert batch.data[0].shape == (1, batch.bucket_key)
+    assert keys <= {3, 4} and keys
+
+
+def test_bidirectional_cell():
+    import pytest
+
+    bi = mx.rnn.BidirectionalCell(mx.rnn.LSTMCell(4, prefix="fw_"),
+                                  mx.rnn.LSTMCell(4, prefix="bw_"))
+    with pytest.raises(ValueError, match="explicit inputs"):
+        bi.unroll(2)
+    outs, states = bi.unroll(2, inputs=mx.sym.var("x"), layout="NTC")
+    assert len(outs) == 2 and len(states) == 4
+    shapes = {"x": (2, 2, 4)}
+    for p in ("fw_", "bw_"):
+        shapes.update({p + "i2h_weight": (16, 4), p + "i2h_bias": (16,),
+                       p + "h2h_weight": (16, 4), p + "h2h_bias": (16,),
+                       p + "state": (2, 4), p + "state_cell": (2, 4)})
+    res = _bind_forward(outs, states, shapes)
+    assert res[0].shape == (2, 8)    # fwd + bwd concat
+
+
+def test_bidirectional_honors_begin_state():
+    bi = mx.rnn.BidirectionalCell(mx.rnn.RNNCell(3, prefix="f_"),
+                                  mx.rnn.RNNCell(3, prefix="b_"))
+    cs = [mx.sym.var("cs_f"), mx.sym.var("cs_b")]
+    outs, _ = bi.unroll(2, inputs=mx.sym.var("x"), begin_state=cs)
+    args = mx.sym.Group(outs).list_arguments()
+    assert "cs_f" in args and "cs_b" in args
+
+
+def test_merge_outputs_respects_layout():
+    cell = mx.rnn.RNNCell(4, prefix="tm_")
+    out, _ = cell.unroll(3, inputs=mx.sym.var("x"), layout="TNC",
+                         merge_outputs=True)
+    shapes = {"x": (3, 2, 5), "tm_i2h_weight": (4, 5),
+              "tm_i2h_bias": (4,), "tm_h2h_weight": (4, 4),
+              "tm_h2h_bias": (4,), "tm_state": (2, 4)}
+    res = _bind_forward(out, [], shapes)
+    assert res[0].shape == (3, 2, 4)    # time-major preserved
+
+
+def test_lstm_forget_bias_via_initializer():
+    """forget_bias reaches the h2h bias through its init attr (reference
+    LSTMBias), not as a per-step addition."""
+    cell = mx.rnn.LSTMCell(4, prefix="fb_", forget_bias=2.0)
+    outs, states = cell.unroll(1, inputs=mx.sym.var("x"), layout="NTC")
+    sym_all = mx.sym.Group(list(outs) + list(states))
+    mod = mx.mod.Module(sym_all, data_names=("x", "fb_state",
+                                             "fb_state_cell"),
+                        label_names=None)
+    mod.bind(data_shapes=[("x", (1, 1, 3)), ("fb_state", (1, 4)),
+                          ("fb_state_cell", (1, 4))], for_training=False)
+    mod.init_params(mx.init.Zero())
+    bias = mod.get_params()[0]["fb_h2h_bias"].asnumpy()
+    np.testing.assert_array_equal(bias[4:8], 2.0)   # forget gate slice
+    np.testing.assert_array_equal(bias[:4], 0.0)
