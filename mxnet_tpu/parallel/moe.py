@@ -1,0 +1,95 @@
+"""Expert parallelism: mixture-of-experts with all-to-all dispatch.
+
+The reference has NO expert parallelism (SURVEY §2.3) — like ring
+attention and the GPipe pipeline, this is a TPU-first capability the
+mesh design makes natural: experts live one-per-device along an 'ep'
+mesh axis, tokens are routed by a learned gate, exchanged with
+`lax.all_to_all` over ICI, processed by the local expert FFN, and
+returned by the inverse all_to_all.
+
+Static shapes throughout: each device sends exactly `capacity` tokens
+to every expert (over-capacity tokens are dropped, under-capacity slots
+are masked padding — the standard top-1 switch-routing discipline), so
+one compiled program serves every step.
+"""
+from __future__ import annotations
+
+import functools
+
+__all__ = ["moe_ffn", "moe_ffn_sharded"]
+
+
+def moe_ffn(x, gate_w, w_in, w_out, axis_name="ep", capacity_factor=1.25):
+    """Top-1 switch FFN over experts sharded along `axis_name`.
+
+    Per-device arguments (inside shard_map/pmap):
+      x: (tokens, d_model) this device's token shard
+      gate_w: (d_model, n_experts) router weights (replicated)
+      w_in: (1, d_model, d_hidden) THIS device's expert up-projection
+      w_out: (1, d_hidden, d_model) THIS device's expert down-projection
+    Returns (tokens, d_model): expert outputs scaled by the gate
+    probability (dropped tokens contribute zero, residual-style).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    n_exp = lax.psum(1, axis_name)
+    T, D = x.shape
+    capacity = max(1, int(capacity_factor * T / n_exp))
+
+    # --- route: one expert per token
+    logits = x @ gate_w                      # (T, E)
+    probs = jax.nn.softmax(logits, axis=-1)
+    expert = jnp.argmax(probs, axis=-1)      # (T,)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+
+    # --- position of each token within its expert's send buffer; tokens
+    # past capacity are dropped (mask instead of dynamic shapes)
+    onehot = jax.nn.one_hot(expert, n_exp, dtype=jnp.int32)   # (T, E)
+    pos = jnp.cumsum(onehot, axis=0) - 1                      # (T, E)
+    slot = jnp.take_along_axis(pos, expert[:, None], axis=1)[:, 0]
+    keep = slot < capacity
+
+    # --- scatter tokens into (E, capacity, D) send buffers
+    send = jnp.zeros((n_exp, capacity, D), x.dtype)
+    send = send.at[expert, jnp.clip(slot, 0, capacity - 1)].add(
+        jnp.where(keep[:, None], x, 0))
+
+    # --- exchange: device i's row e goes to device e (all_to_all over
+    # ICI); afterwards this device holds every peer's tokens for ITS
+    # expert: (E_src, capacity, D)
+    recv = lax.all_to_all(send, axis_name, split_axis=0, concat_axis=0,
+                          tiled=False)
+
+    # --- local expert FFN (one matmul pair on the MXU)
+    h = jax.nn.relu(jnp.einsum("scd,dh->sch", recv, w_in[0]))
+    y = jnp.einsum("sch,hd->scd", h, w_out[0])
+
+    # --- return trip + un-scatter back to token order
+    back = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0,
+                          tiled=False)                     # (E, cap, D)
+    out = back[expert, jnp.clip(slot, 0, capacity - 1)]
+    out = jnp.where(keep[:, None], out, 0)
+    return out * gate[:, None].astype(out.dtype)
+
+
+def moe_ffn_sharded(mesh, x, gate_w, w_in, w_out, axis_name="ep",
+                    capacity_factor=1.25):
+    """Convenience wrapper: shard tokens and experts over `mesh`.
+
+    x: (total_tokens, d_model) — token dim sharded over axis_name
+    w_in: (n_experts, d_model, d_hidden), w_out: (n_experts, d_hidden,
+    d_model) — expert dim sharded; gate_w replicated."""
+    from jax.sharding import PartitionSpec as P
+    from jax.experimental.shard_map import shard_map
+
+    fn = shard_map(
+        functools.partial(moe_ffn, axis_name=axis_name,
+                          capacity_factor=capacity_factor),
+        mesh=mesh,
+        in_specs=(P(axis_name, None), P(None, None),
+                  P(axis_name, None, None), P(axis_name, None, None)),
+        out_specs=P(axis_name, None),
+        check_rep=False)
+    return fn(x, gate_w, w_in, w_out)
