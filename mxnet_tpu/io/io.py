@@ -7,6 +7,7 @@ C++-backed record pipelines map to the python RecordIO reader plus a
 thread-pool decode stage (see image/ImageIter and gluon DataLoader)."""
 from __future__ import annotations
 
+import queue
 import threading
 from collections import namedtuple
 
@@ -158,105 +159,192 @@ class ResizeIter(DataIter):
         return self.current_batch.pad
 
 
-class PrefetchingIter(DataIter):
-    """Background-thread prefetch over one or more iterators (reference:
-    io.py:345 — dmlc::ThreadedIter equivalent)."""
+class _EpochEnd:
+    """Queue sentinel marking the end of one source epoch."""
 
-    def __init__(self, iters, rename_data=None, rename_label=None):
+
+_EPOCH_END = _EpochEnd()
+
+
+class _PrefetchWorker:
+    """Daemon thread that streams epochs from one source iterator into a
+    bounded queue.
+
+    The lifecycle is command-driven: the owner calls :meth:`begin_epoch` to
+    ask for one epoch of batches, then repeatedly :meth:`get`\\ s items until
+    the ``_EPOCH_END`` sentinel arrives. A mid-epoch reset is done with
+    :meth:`abort_epoch`, which tells the thread to stop pulling from the
+    source and lets the owner drain up to the sentinel. :meth:`close` shuts
+    the thread down and joins it.
+    """
+
+    def __init__(self, source, depth):
+        self._source = source
+        self._ready = queue.Queue(maxsize=max(1, depth))
+        self._commands = queue.Queue()
+        self._abort = threading.Event()
+        self._thread = threading.Thread(target=self._stream_epochs,
+                                        daemon=True)
+        self._thread.start()
+
+    def _stream_epochs(self):
+        while self._commands.get() == "epoch":
+            while not self._abort.is_set():
+                try:
+                    item = self._source.next()
+                except StopIteration:
+                    break
+                except Exception as exc:  # surfaced by get()
+                    item = exc
+                if not self._publish(item):
+                    break
+                if isinstance(item, Exception):
+                    break
+            self._publish(_EPOCH_END, always=True)
+
+    def _publish(self, item, always=False):
+        """Blocking put that gives up when the epoch is aborted (unless the
+        item is the sentinel, which must always be delivered)."""
+        while True:
+            try:
+                self._ready.put(item, timeout=0.05)
+                return True
+            except queue.Full:
+                if self._abort.is_set() and not always:
+                    return False
+
+    def begin_epoch(self):
+        self._abort.clear()
+        self._commands.put("epoch")
+
+    def get(self):
+        item = self._ready.get()
+        if isinstance(item, Exception):
+            raise item
+        return item
+
+    def abort_epoch(self):
+        """Cancel the in-flight epoch and drain the queue past the
+        sentinel (swallowing queued batches and source exceptions)."""
+        self._abort.set()
+        while self._ready.get() is not _EPOCH_END:
+            pass
+
+    def close(self):
+        self._abort.set()
+        self._commands.put("stop")
+        self._thread.join(timeout=5.0)
+
+
+def _rename_descs(descs, mapping):
+    out = []
+    for d in descs:
+        if not isinstance(d, DataDesc):
+            d = DataDesc(*d)
+        if mapping is not None:
+            d = DataDesc(mapping.get(d.name, d.name), d.shape, d.dtype,
+                         d.layout)
+        out.append(d)
+    return out
+
+
+class PrefetchingIter(DataIter):
+    """Background-thread prefetch over one or more iterators (reference
+    parity: python/mxnet/io/io.py:345, the dmlc::ThreadedIter equivalent —
+    re-designed here around one bounded queue per source instead of
+    event-pair handshakes; each source runs `prefetch_depth` batches ahead)."""
+
+    def __init__(self, iters, rename_data=None, rename_label=None,
+                 prefetch_depth=2):
         super().__init__()
-        if not isinstance(iters, list):
+        if not isinstance(iters, (list, tuple)):
             iters = [iters]
-        self.n_iter = len(iters)
-        assert self.n_iter > 0
-        self.iters = iters
+        assert iters, "PrefetchingIter needs at least one source iterator"
+        self.iters = list(iters)
+        self.n_iter = len(self.iters)
         self.rename_data = rename_data
         self.rename_label = rename_label
         self.batch_size = self.provide_data[0].shape[0]
-        self.data_ready = [threading.Event() for _ in range(self.n_iter)]
-        self.data_taken = [threading.Event() for _ in range(self.n_iter)]
-        for e in self.data_taken:
-            e.set()
-        self.started = True
-        self.current_batch = [None for _ in range(self.n_iter)]
-        self.next_batch = [None for _ in range(self.n_iter)]
+        self.current_batch = None
+        self._closed = False
+        self._workers = [_PrefetchWorker(it, prefetch_depth)
+                         for it in self.iters]
+        self._epoch_open = False
+        self._open_epoch()
 
-        def prefetch_func(self, i):
-            while True:
-                self.data_taken[i].wait()
-                if not self.started:
-                    break
-                try:
-                    self.next_batch[i] = self.iters[i].next()
-                except StopIteration:
-                    self.next_batch[i] = None
-                self.data_taken[i].clear()
-                self.data_ready[i].set()
-
-        self.prefetch_threads = [
-            threading.Thread(target=prefetch_func, args=[self, i], daemon=True)
-            for i in range(self.n_iter)]
-        for thread in self.prefetch_threads:
-            thread.start()
-
-    def __del__(self):
-        self.started = False
-        for e in self.data_taken:
-            e.set()
+    def _open_epoch(self):
+        for w in self._workers:
+            w.begin_epoch()
+        self._epoch_open = True
 
     @property
     def provide_data(self):
-        if self.rename_data is None:
-            return sum([i.provide_data for i in self.iters], [])
-        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
-                     if isinstance(x, DataDesc) else DataDesc(*x)
-                     for x in i.provide_data]
-                    for r, i in zip(self.rename_data, self.iters)], [])
+        maps = self.rename_data or [None] * self.n_iter
+        out = []
+        for mapping, it in zip(maps, self.iters):
+            out.extend(_rename_descs(it.provide_data, mapping))
+        return out
 
     @property
     def provide_label(self):
-        if self.rename_label is None:
-            return sum([i.provide_label for i in self.iters], [])
-        return sum([[DataDesc(r[x.name], x.shape, x.dtype)
-                     if isinstance(x, DataDesc) else DataDesc(*x)
-                     for x in i.provide_label]
-                    for r, i in zip(self.rename_label, self.iters)], [])
+        maps = self.rename_label or [None] * self.n_iter
+        out = []
+        for mapping, it in zip(maps, self.iters):
+            out.extend(_rename_descs(it.provide_label, mapping))
+        return out
 
     def reset(self):
-        for e in self.data_ready:
-            e.wait()
-        for i in self.iters:
-            i.reset()
-        for e in self.data_ready:
-            e.clear()
-        for e in self.data_taken:
-            e.set()
+        if self._closed:
+            raise MXNetError("PrefetchingIter has been closed")
+        if self._epoch_open:
+            for w in self._workers:
+                w.abort_epoch()
+        for it in self.iters:
+            it.reset()
+        self._open_epoch()
 
     def iter_next(self):
-        for e in self.data_ready:
-            e.wait()
-        if self.next_batch[0] is None:
-            for i in self.next_batch:
-                assert i is None, "Number of entry mismatches between iters"
+        if not self._epoch_open:
             return False
-        for batch in self.next_batch:
-            assert batch.pad == self.next_batch[0].pad, \
-                "Different pad between iters"
+        items = [w.get() for w in self._workers]
+        n_ended = len([x for x in items if x is _EPOCH_END])
+        if n_ended:
+            self._epoch_open = False
+            assert n_ended == self.n_iter, \
+                "Source iterators disagree on epoch length"
+            return False
+        data, label = [], []
+        for batch in items:
+            assert batch.pad == items[0].pad, "Different pad between iters"
+            data.extend(batch.data)
+            label.extend(batch.label)
         self.current_batch = DataBatch(
-            sum([batch.data for batch in self.next_batch], []),
-            sum([batch.label for batch in self.next_batch], []),
-            self.next_batch[0].pad, self.next_batch[0].index,
+            data, label, items[0].pad, items[0].index,
             provide_data=self.provide_data,
             provide_label=self.provide_label)
-        for e in self.data_ready:
-            e.clear()
-        for e in self.data_taken:
-            e.set()
         return True
 
     def next(self):
         if self.iter_next():
             return self.current_batch
         raise StopIteration
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        if self._epoch_open:
+            self._epoch_open = False
+            for w in self._workers:
+                w.abort_epoch()
+        for w in self._workers:
+            w.close()
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def getdata(self):
         return self.current_batch.data

@@ -65,6 +65,10 @@ class KVServer:
         self._cv = threading.Condition()
         self._barrier_count = 0
         self._barrier_gen = 0
+        # sync-round bookkeeping for ordering-divergence detection:
+        # key -> (count of handler threads blocked on it, their target gen)
+        self._waiting = {}
+        self._divergence = None
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -110,6 +114,8 @@ class KVServer:
             return {"ok": False,
                     "error": "worker failure detected: dead rank(s) %s"
                              % sorted(self._dead)}
+        if self._divergence:
+            return {"ok": False, "error": self._divergence}
         return {"ok": False,
                 "error": "timed out waiting for peers (no failure "
                          "detected; a worker may be stalled)"}
@@ -137,9 +143,34 @@ class KVServer:
             else:
                 self._push_buf[key] = (acc, cnt, gen)
                 target = gen + 1
+                # ordering-divergence detection: each worker's handler
+                # thread can block on at most one key, and the worker
+                # that completes a round never blocks — so if every
+                # worker is genuinely blocked (its target generation not
+                # yet reached; a satisfied waiter that hasn't been
+                # rescheduled doesn't count) across more than one
+                # distinct key, no round can ever complete.  Fail fast
+                # instead of waiting out the timeout.
+                cnt_w, _ = self._waiting.get(key, (0, target))
+                self._waiting[key] = (cnt_w + 1, target)
+                blocked = [k for k, (c, t) in self._waiting.items()
+                           if c > 0 and self._push_buf.get(
+                               k, (0.0, 0, 0))[2] < t]
+                if (sum(self._waiting[k][0] for k in blocked)
+                        >= self._num_workers
+                        and len(blocked) > 1
+                        and self._divergence is None):
+                    self._divergence = (
+                        "sync push ordering divergence: all %d workers "
+                        "blocked across keys %s — every worker must push "
+                        "the same key sequence in sync mode"
+                        % (self._num_workers, sorted(blocked)))
+                    self._cv.notify_all()
                 self._cv.wait_for(
                     lambda: self._push_buf[key][2] >= target
-                    or self._dead, timeout=600)
+                    or self._dead or self._divergence, timeout=600)
+                c2w, t2w = self._waiting[key]
+                self._waiting[key] = (c2w - 1, t2w)
                 if self._push_buf[key][2] < target:
                     # failed round: withdraw this worker's contribution
                     # so a retry can never double-count it, then fail
@@ -148,7 +179,12 @@ class KVServer:
                         self._push_buf[key] = (
                             (0.0, 0, g2) if c2 == 1
                             else (a2 - value, c2 - 1, g2))
-                    return self._wait_error()
+                    err = self._wait_error()
+                    # the divergence round is over once its last waiter
+                    # has withdrawn; later rounds start clean
+                    if not any(c for c, _ in self._waiting.values()):
+                        self._divergence = None
+                    return err
         return None
 
     @staticmethod

@@ -95,11 +95,14 @@ def multibox_target_one(anchors, lab, cls_pred, overlap_threshold,
     # --- threshold matching for the rest
     best = jnp.argmax(iou, axis=1).astype(jnp.int32)
     best_iou = jnp.take_along_axis(iou, best[:, None], axis=1)[:, 0]
+    # every non-bipartite anchor carries its best IoU regardless of
+    # overlap_threshold: the reference computes it inside the mining
+    # block too (multibox_target.cc:199-216), so high-IoU anchors are
+    # excluded from the negative pool even when threshold matching is off
+    match_gt = jnp.where(~pos, best, match_gt)
+    match_iou = jnp.where(~pos, best_iou, match_iou)
     if overlap_threshold > 0:
-        take = (~pos) & (best_iou > overlap_threshold)
-        match_gt = jnp.where(~pos, best, match_gt)
-        match_iou = jnp.where(~pos, best_iou, match_iou)
-        pos = pos | take
+        pos = pos | ((~pos) & (best_iou > overlap_threshold))
 
     num_pos = jnp.sum(pos)
 
@@ -169,11 +172,13 @@ def multibox_detection_jax(cls_prob, loc_pred, anchor, clip, threshold,
                            variances, nms_topk):
     """Decode + per-class NMS, fully on device.
 
-    Output rows [id, score, x1, y1, x2, y2]; suppressed / background
-    rows are all -1 and sorted to the back.  Kept rows appear in
-    descending-score order when NMS runs; with NMS disabled
-    (nms_threshold outside (0, 1]) they keep anchor order, exactly as
-    the reference emits them."""
+    Output rows [id, score, x1, y1, x2, y2].  Layout matches the
+    reference (multibox_detection.cc:170-193): valid detections occupy
+    the leading rows — score-sorted when NMS runs, anchor-ordered when
+    it is disabled (nms_threshold outside (0, 1]) — and NMS-suppressed
+    rows STAY IN their sorted slots with only the id column set to -1
+    (score/box intact).  Background / below-threshold rows at the back
+    are all -1."""
     import jax
     import jax.numpy as jnp
     from jax import lax
@@ -221,13 +226,12 @@ def multibox_detection_jax(cls_prob, loc_pred, anchor, clip, threshold,
         if run_nms:
             limit = nms_topk if 0 < nms_topk < N else N
             alive = lax.fori_loop(0, limit, nms_step, alive)
-        rows = jnp.concatenate([oid[:, None], score[:, None], boxes],
-                               axis=1)
-        rows = jnp.where(alive[:, None], rows, -1.0)
-        # compact: surviving rows first (stable), -1 rows to the back
-        comp = jnp.argsort(jnp.where(alive, jnp.arange(N), N + 1),
-                           stable=True)
-        return rows[comp]
+        # suppression only clears the id column; the row keeps its
+        # sorted slot with score/box intact (reference layout parity)
+        rows = jnp.concatenate([jnp.where(alive, oid, -1.0)[:, None],
+                                score[:, None], boxes], axis=1)
+        valid = oid >= 0
+        return jnp.where(valid[:, None], rows, -1.0)
 
     return jax.vmap(one)(cls_prob, loc_pred)
 
@@ -277,7 +281,11 @@ def proposal_jax(cls_prob, bbox_pred, im_info, base_anchors, stride,
         # reference FilterBox (proposal.cc): undersized boxes are NOT
         # dropped — they are expanded by min_size/2 on each side and
         # their score is set to -1, so they sort last but NMS always
-        # keeps at least one real box for the cyclic pad
+        # keeps at least one real box for the cyclic pad.
+        # Intentional deviation from proposal.cc:374 (which scales by
+        # im_info[0][2] for EVERY image): each sample uses its own
+        # im_info scale, so batches with per-image scales filter
+        # correctly; identical results whenever scales agree.
         ms = min_size * iscale
         small = ((boxes[:, 2] - boxes[:, 0] + 1 < ms) |
                  (boxes[:, 3] - boxes[:, 1] + 1 < ms))

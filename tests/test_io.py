@@ -151,6 +151,43 @@ def test_prefetching_iter():
     pf = mx.io.PrefetchingIter(it)
     count = sum(1 for _ in pf)
     assert count == 4
+    pf.close()
+
+
+def test_prefetching_iter_reset_and_epochs():
+    it = mx.io.NDArrayIter(np.arange(60).reshape(30, 2).astype(np.float32),
+                           np.zeros(30), batch_size=10)
+    pf = mx.io.PrefetchingIter(it)
+    # mid-epoch reset: consume one batch, reset, then a full epoch streams
+    first = pf.next()
+    assert first.data[0].shape == (10, 2)
+    pf.reset()
+    assert sum(1 for _ in pf) == 3
+    # back-to-back epochs after exhaustion
+    pf.reset()
+    assert sum(1 for _ in pf) == 3
+    pf.close()
+    # close joins the workers
+    assert all(not w._thread.is_alive() for w in pf._workers)
+    pf.close()  # idempotent
+
+
+def test_prefetching_iter_multi_source_rename():
+    a = mx.io.NDArrayIter(np.random.rand(20, 3), np.zeros(20), batch_size=5,
+                          data_name="da", label_name="la")
+    b = mx.io.NDArrayIter(np.random.rand(20, 4), np.ones(20), batch_size=5,
+                          data_name="db", label_name="lb")
+    pf = mx.io.PrefetchingIter(
+        [a, b],
+        rename_data=[{"da": "x0"}, {"db": "x1"}],
+        rename_label=[{"la": "y0"}, {"lb": "y1"}])
+    assert [d.name for d in pf.provide_data] == ["x0", "x1"]
+    assert [d.name for d in pf.provide_label] == ["y0", "y1"]
+    batches = list(pf)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (5, 3)
+    assert batches[0].data[1].shape == (5, 4)
+    pf.close()
 
 
 def test_im2rec_roundtrip(tmp_path):

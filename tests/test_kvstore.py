@@ -344,3 +344,65 @@ def test_server_profiler_commands(tmp_path):
     assert all(results.get(r) for r in range(num_workers)), dict(results)
     stats = json.load(open(dump_path))
     assert "push" in stats and stats["push"][0] == num_workers, stats
+
+
+def test_dist_sync_push_order_divergence_fails_fast():
+    """Workers pushing different key sequences in sync mode get an error
+    quickly instead of deadlocking until the 600s timeout."""
+    import socket
+    import threading
+    import time
+
+    import numpy as np
+
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.kvstore_server import KVServer, WorkerClient
+
+    srv_sock = socket.socket()
+    srv_sock.bind(("127.0.0.1", 0))
+    port = srv_sock.getsockname()[1]
+    srv_sock.close()
+    server = KVServer("127.0.0.1", port, num_workers=2)
+    threading.Thread(target=server.serve, daemon=True).start()
+    time.sleep(0.1)
+    w0 = WorkerClient("127.0.0.1", port, rank=0, num_workers=2)
+    w1 = WorkerClient("127.0.0.1", port, rank=1, num_workers=2)
+    w0.init("a", np.zeros(2, np.float32))
+    w0.init("b", np.zeros(2, np.float32))
+
+    errs = {}
+
+    def push_seq(name, client, keys):
+        try:
+            client.push_batch([(k, np.ones(2, np.float32)) for k in keys])
+            errs[name] = None
+        except MXNetError as e:
+            errs[name] = str(e)
+
+    t0 = time.monotonic()
+    # divergent orders: w0 pushes a then b, w1 pushes b then a
+    ts = [threading.Thread(target=push_seq, args=("w0", w0, ["a", "b"])),
+          threading.Thread(target=push_seq, args=("w1", w1, ["b", "a"]))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    elapsed = time.monotonic() - t0
+    assert all(not t.is_alive() for t in ts), "push_batch deadlocked"
+    assert elapsed < 20, "divergence not detected fast (%.1fs)" % elapsed
+    assert errs["w0"] and "divergence" in errs["w0"], errs
+    assert errs["w1"] and "divergence" in errs["w1"], errs
+    # no partial application: both stores untouched
+    np.testing.assert_array_equal(w0.pull("a"), np.zeros(2, np.float32))
+    np.testing.assert_array_equal(w0.pull("b"), np.zeros(2, np.float32))
+    # a consistent retry afterwards succeeds (round state was cleaned)
+    ts = [threading.Thread(target=push_seq, args=("w0", w0, ["a", "b"])),
+          threading.Thread(target=push_seq, args=("w1", w1, ["a", "b"]))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=30)
+    assert errs["w0"] is None and errs["w1"] is None, errs
+    np.testing.assert_array_equal(w0.pull("a"), np.full(2, 2.0))
+    w0._sock.close()
+    w1._sock.close()

@@ -202,3 +202,40 @@ def test_multibox_detection_disabled_nms_keeps_anchor_order():
                                        nms_threshold=-1.0).asnumpy()[0]
     assert abs(out[0, 1] - 0.3) < 1e-6   # anchor 0 first despite score
     assert abs(out[1, 1] - 0.8) < 1e-6
+
+
+def test_multibox_detection_suppressed_rows_stay_in_slot():
+    # reference layout parity (multibox_detection.cc:170-193): an
+    # NMS-suppressed detection keeps its score-sorted slot with score
+    # and box intact; only the id column flips to -1
+    anchors = nd.array(np.array([[[0.1, 0.1, 0.5, 0.5],
+                                  [0.12, 0.12, 0.52, 0.52]]], np.float32))
+    cls_prob = nd.array(np.array([[[0.1, 0.2], [0.9, 0.8]]], np.float32))
+    loc_pred = nd.array(np.zeros((1, 8), np.float32))
+    out = nd.contrib.MultiBoxDetection(cls_prob, loc_pred, anchors,
+                                       nms_threshold=0.5).asnumpy()[0]
+    # row 0: the winner; row 1: suppressed but score/box preserved
+    assert out[0, 0] == 0 and abs(out[0, 1] - 0.9) < 1e-6
+    assert out[1, 0] == -1
+    assert abs(out[1, 1] - 0.8) < 1e-6
+    np.testing.assert_allclose(out[1, 2:], [0.12, 0.12, 0.52, 0.52],
+                               atol=1e-5)
+
+
+def test_multibox_target_mining_excludes_high_iou_when_threshold_off():
+    # with overlap_threshold<=0 threshold-matching is skipped, but the
+    # negative pool must still exclude anchors whose best IoU exceeds
+    # negative_mining_thresh (reference multibox_target.cc:199-216)
+    anchors = nd.array(np.array([[[0.1, 0.1, 0.5, 0.5],      # IoU 1.0
+                                  [0.11, 0.11, 0.51, 0.51],  # IoU ~0.8
+                                  [0.6, 0.6, 0.9, 0.9]]],    # IoU ~0
+                                np.float32))
+    label = nd.array(np.array([[[0.0, 0.1, 0.1, 0.5, 0.5]]], np.float32))
+    cls_pred = nd.array(np.zeros((1, 2, 3), np.float32))
+    _, _, ct = nd.contrib.MultiBoxTarget(
+        anchors, label, cls_pred, overlap_threshold=0.0,
+        negative_mining_ratio=3.0, negative_mining_thresh=0.5)
+    ct = ct.asnumpy()[0]
+    assert ct[0] == 1.0   # bipartite positive
+    assert ct[1] == -1.0  # high-IoU anchor: NOT a negative candidate
+    assert ct[2] == 0.0   # low-IoU anchor: mined negative
