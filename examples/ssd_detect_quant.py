@@ -119,6 +119,41 @@ def synthetic_batch(rng, batch, num_classes, size):
     return x, labels
 
 
+def make_synthetic_rec(prefix, n, size, num_classes, rng):
+    """Write a synthetic-JPEG detection RecordIO (the real-data on-disk
+    format im2rec produces for SSD: packed JPEG + flat det label)."""
+    from mxnet_tpu import recordio
+
+    writer = recordio.MXIndexedRecordIO(prefix + ".idx", prefix + ".rec",
+                                        "w")
+    for i in range(n):
+        img = (rng.rand(size, size, 3) * 25).astype(np.uint8)
+        cls = rng.randint(num_classes)
+        w = rng.uniform(0.2, 0.5)
+        x1, y1 = rng.uniform(0, 1 - w), rng.uniform(0, 1 - w)
+        ys = slice(int(y1 * size), int((y1 + w) * size))
+        xs = slice(int(x1 * size), int((x1 + w) * size))
+        img[ys, xs, cls % 3] = 255
+        label = np.array([2, 5, cls, x1, y1, x1 + w, y1 + w], np.float32)
+        hdr = recordio.IRHeader(0, label, i, 0)
+        writer.write_idx(i, recordio.pack_img(hdr, img, quality=95,
+                                              img_fmt=".jpg"))
+    writer.close()
+    return prefix + ".rec"
+
+
+def det_iter_batches(it):
+    """Endless (data, label) stream from an ImageDetIter: decoded JPEG
+    pixels scaled to [0,1] NCHW, labels (B, max_obj, 5)."""
+    while True:
+        try:
+            b = next(it)
+        except StopIteration:
+            it.reset()
+            b = next(it)
+        yield b.data[0].asnumpy() / 255.0, b.label[0].asnumpy()
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--num-classes", type=int, default=3)
@@ -126,14 +161,41 @@ def main():
     p.add_argument("--batch-size", type=int, default=8)
     p.add_argument("--train-steps", type=int, default=30)
     p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--data-rec", default="",
+                   help="detection .rec (im2rec det layout); a synthetic-"
+                        "JPEG one is generated when empty")
+    p.add_argument("--no-rec", action="store_true",
+                   help="skip the RecordIO path and train from in-memory "
+                        "synthetic tensors")
     args = p.parse_args()
     logging.basicConfig(level=logging.INFO)
     rng = np.random.RandomState(0)
 
-    # --- train the detector heads briefly on synthetic boxes
+    # --- real-data path: decoded JPEGs + bbox-aware augmenters through
+    # ImageDetIter (reference example/ssd train flow)
+    batches = None
+    if not args.no_rec:
+        import tempfile
+
+        rec = args.data_rec
+        if not rec:
+            rec = make_synthetic_rec(
+                os.path.join(tempfile.mkdtemp(prefix="ssdrec"), "train"),
+                4 * args.batch_size, args.image_size, args.num_classes,
+                rng)
+            logging.info("generated synthetic-JPEG rec: %s", rec)
+        det_it = mx.image.ImageDetIter(
+            batch_size=args.batch_size,
+            data_shape=(3, args.image_size, args.image_size),
+            path_imgrec=rec, shuffle=True, rand_mirror=True)
+        batches = det_iter_batches(det_it)
+        X, L = next(batches)
+    else:
+        X, L = synthetic_batch(rng, args.batch_size, args.num_classes,
+                               args.image_size)
+
+    # --- train the detector heads briefly
     tsym = training_symbol(args.num_classes)
-    X, L = synthetic_batch(rng, args.batch_size, args.num_classes,
-                           args.image_size)
     mod = mx.mod.Module(tsym, data_names=("data",), label_names=("label",))
     mod.bind(data_shapes=[("data", X.shape)],
              label_shapes=[("label", L.shape)], for_training=True)
@@ -144,8 +206,11 @@ def main():
     from mxnet_tpu.io.io import DataBatch
 
     for step in range(args.train_steps):
-        X, L = synthetic_batch(rng, args.batch_size, args.num_classes,
-                               args.image_size)
+        if batches is not None:
+            X, L = next(batches)
+        else:
+            X, L = synthetic_batch(rng, args.batch_size, args.num_classes,
+                                   args.image_size)
         batch = DataBatch(data=[nd.array(X)], label=[nd.array(L)])
         mod.forward(batch, is_train=True)
         mod.backward()
@@ -158,8 +223,11 @@ def main():
 
     # --- fp32 detection
     dsym = detection_symbol(args.num_classes)
-    Xv, Lv = synthetic_batch(rng, args.batch_size, args.num_classes,
-                             args.image_size)
+    if batches is not None:
+        Xv, Lv = next(batches)
+    else:
+        Xv, Lv = synthetic_batch(rng, args.batch_size, args.num_classes,
+                                 args.image_size)
     dex = dsym.bind(args=dict(arg_params, data=nd.array(Xv)))
     det_fp32_np = dex.forward()[0].asnumpy()   # compile + warm
     t0 = time.time()

@@ -36,6 +36,11 @@ FLAGS = {
         "(__init__.py)"),
     "MXNET_PROFILER_AUTOSTART": (
         "0", _pbool, "honored", "start the jax trace at import"),
+    "MXNET_TEST_PLATFORM": (
+        "cpu", str, "honored",
+        "test-suite backend selector: 'tpu' runs the op/gluon suites on "
+        "the real chip with the cpu<->tpu consistency sweep "
+        "(tests/conftest.py)"),
     "MXNET_PROFILER_MODE": (
         "0", _pint, "declared", "recognized; facade config is set via "
         "profiler.set_config"),

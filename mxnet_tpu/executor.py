@@ -125,8 +125,12 @@ class Executor:
             self._pending = (values, rng)
             self._outputs = None
         else:
+            from . import profiler as _profiler
+
             try:
-                outs, aux = self._jit_fwd_infer(values, rng)
+                outs, aux = _profiler.timed_call(
+                    "Executor::forward", self._jit_fwd_infer,
+                    (values, rng))
             except MXNetError:
                 raise
             except Exception as e:

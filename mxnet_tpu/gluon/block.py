@@ -27,6 +27,7 @@ from ..context import Context, cpu, current_context
 from ..ndarray.ndarray import NDArray, array, _invoke_nd
 from ..ops.registry import OpInfo
 from .. import autograd
+from .. import profiler as _profiler
 from .. import random as _random
 from ..symbol import symbol as _symbol
 from ..name import NameManager
@@ -459,7 +460,23 @@ class CachedOp:
             self._jits[key] = (jax.jit(pure), meta)
         jit_fn, meta = self._jits[key]
         rng = _random.next_key()
-        outs, aux_vals = jit_fn(rng, in_arrays, param_arrays)
+        mode = "[train]" if is_train else "[eval]"
+        outs, aux_vals = _profiler.timed_call(
+            "CachedOp:%s%s" % (self._block.name, mode), jit_fn,
+            (rng, in_arrays, param_arrays))
+        if _profiler.aggregate_enabled() and "xla_cost" not in meta:
+            meta["xla_cost"] = True
+            try:
+                # Lowered.cost_analysis reads the HLO without paying a
+                # second backend compile
+                cost = jit_fn.lower(rng, in_arrays,
+                                    param_arrays).cost_analysis()
+                if isinstance(cost, (list, tuple)):
+                    cost = cost[0] if cost else {}
+                _profiler.record_xla_cost(
+                    "CachedOp:%s%s" % (self._block.name, mode), cost)
+            except Exception:
+                pass
         # apply moving-stat updates
         for p, v in zip(meta.get("aux_params", []), aux_vals):
             p.data()._rebind(v)

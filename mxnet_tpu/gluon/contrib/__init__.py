@@ -1,55 +1,15 @@
 """Gluon contrib (reference parity: python/mxnet/gluon/contrib/ —
-Concurrent/HybridConcurrent/Identity, SyncBatchNorm wrapper)."""
-from ..block import HybridBlock
-from .. import nn as _nn
+nn, rnn and data submodules).
 
-__all__ = ["HybridConcurrent", "Concurrent", "Identity", "SyncBatchNorm"]
+The commonly used nn layers are also re-exported flat for backward
+compatibility with earlier revisions of this package."""
+from . import nn
+from . import rnn
+from . import data
+from .nn import (Concurrent, HybridConcurrent, Identity, SparseEmbedding,
+                 SyncBatchNorm, PixelShuffle1D, PixelShuffle2D,
+                 PixelShuffle3D)
 
-
-class HybridConcurrent(HybridBlock):
-    """Run child blocks on the same input and concat the outputs
-    (reference: gluon/contrib/nn/basic_layers.py HybridConcurrent)."""
-
-    def __init__(self, axis=-1, prefix=None, params=None):
-        super().__init__(prefix=prefix, params=params)
-        self.axis = axis
-        self._order = []
-
-    def add(self, *blocks):
-        for block in blocks:
-            self.register_child(block)
-            self._order.append(block)
-
-    def hybrid_forward(self, F, x):
-        outs = [block(x) for block in self._order]
-        return F.concat(*outs, dim=self.axis)
-
-
-class Concurrent(HybridConcurrent):
-    pass
-
-
-class Identity(HybridBlock):
-    def hybrid_forward(self, F, x):
-        return x
-
-
-class SyncBatchNorm(_nn.BatchNorm):
-    """Cross-device BatchNorm (reference: src/operator/contrib/
-    sync_batch_norm.cc).  On a TPU mesh the sharded train step computes
-    batch stats with a psum over the data axis (mxnet_tpu/parallel), so a
-    single-process SyncBatchNorm reduces to BatchNorm here."""
-
-    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
-                 epsilon=1e-5, center=True, scale=True, use_global_stats=False,
-                 beta_initializer="zeros", gamma_initializer="ones",
-                 running_mean_initializer="zeros",
-                 running_variance_initializer="ones", **kwargs):
-        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
-                         center=center, scale=scale,
-                         use_global_stats=use_global_stats,
-                         beta_initializer=beta_initializer,
-                         gamma_initializer=gamma_initializer,
-                         running_mean_initializer=running_mean_initializer,
-                         running_variance_initializer=running_variance_initializer,
-                         in_channels=in_channels, **kwargs)
+__all__ = ["nn", "rnn", "data", "Concurrent", "HybridConcurrent",
+           "Identity", "SparseEmbedding", "SyncBatchNorm",
+           "PixelShuffle1D", "PixelShuffle2D", "PixelShuffle3D"]

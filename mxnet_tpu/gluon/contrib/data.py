@@ -1,0 +1,139 @@
+"""Gluon contrib data: IntervalSampler and the WikiText language-model
+datasets (reference parity: python/mxnet/gluon/contrib/data/sampler.py,
+text.py).
+
+This environment has no network egress, so the WikiText classes read an
+already-downloaded ``wiki.<segment>.tokens`` file from ``root`` (the
+same file the reference's downloader unzips there) and raise a clear
+error when it is absent instead of attempting a download."""
+from __future__ import annotations
+
+import io
+import os
+
+import numpy as np
+
+from ..data import dataset, sampler
+from ... import ndarray as nd
+from ...base import MXNetError
+
+__all__ = ["IntervalSampler", "WikiText2", "WikiText103"]
+
+EOS_TOKEN = "<eos>"
+
+
+class IntervalSampler(sampler.Sampler):
+    """Visit [0, length) with stride `interval`, starting a new pass at
+    each successive offset when `rollover` (reference: sampler.py:25)."""
+
+    def __init__(self, length, interval, rollover=True):
+        assert interval <= length, \
+            "interval %d must be <= length %d" % (interval, length)
+        self._length = length
+        self._interval = interval
+        self._rollover = rollover
+
+    def __iter__(self):
+        offsets = range(self._interval) if self._rollover else range(1)
+        for off in offsets:
+            yield from range(off, self._length, self._interval)
+
+    def __len__(self):
+        if self._rollover:
+            return self._length
+        return len(range(0, self._length, self._interval))
+
+
+class _WikiText(dataset.Dataset):
+    """Word-level LM dataset over a local wikitext token file: the token
+    stream (with <eos> closing each line) becomes (data, label) sample
+    pairs of `seq_len`, label shifted one token ahead (reference:
+    text.py:58)."""
+
+    _namespace = None        # e.g. "wikitext-2"
+    _token_files = {}        # segment -> filename
+
+    def __init__(self, root, segment="train", vocab=None, seq_len=35):
+        self._root = os.path.expanduser(root)
+        self._segment = segment
+        self._seq_len = seq_len
+        self._vocab = vocab
+        self._counter = None
+        self._load()
+
+    @property
+    def vocabulary(self):
+        return self._vocab
+
+    @property
+    def frequencies(self):
+        return self._counter
+
+    def _load(self):
+        fname = self._token_files[self._segment]
+        path = os.path.join(self._root, fname)
+        if not os.path.exists(path):
+            raise MXNetError(
+                "%s: token file %s not found.  Network access is "
+                "unavailable; place the extracted %s archive's %s in %s"
+                % (type(self).__name__, path, self._namespace, fname,
+                   self._root))
+        with io.open(path, "r", encoding="utf8") as f:
+            content = f.read()
+        tokens = []
+        for line in content.splitlines():
+            words = line.strip().split()
+            if words:
+                tokens.extend(words)
+                tokens.append(EOS_TOKEN)
+        if self._counter is None:
+            from ...contrib.text.utils import count_tokens_from_str
+
+            self._counter = count_tokens_from_str(content)
+        if self._vocab is None:
+            from ...contrib.text.vocab import Vocabulary
+
+            self._vocab = Vocabulary(counter=self._counter,
+                                     reserved_tokens=[EOS_TOKEN])
+        ids = np.asarray(self._vocab.to_indices(tokens), dtype=np.int32)
+        n = (len(ids) - 1) // self._seq_len
+        self._data = nd.array(
+            ids[:n * self._seq_len].reshape(n, self._seq_len))
+        self._label = nd.array(
+            ids[1:n * self._seq_len + 1].reshape(n, self._seq_len))
+
+    def __getitem__(self, idx):
+        return self._data[idx], self._label[idx]
+
+    def __len__(self):
+        return len(self._label)
+
+
+class WikiText2(_WikiText):
+    """WikiText-2 (reference: text.py:105)."""
+
+    _namespace = "wikitext-2"
+    _token_files = {"train": "wiki.train.tokens",
+                    "validation": "wiki.valid.tokens",
+                    "test": "wiki.test.tokens"}
+
+    def __init__(self, root=None, segment="train", vocab=None, seq_len=35):
+        if root is None:
+            root = os.path.join(os.environ.get("MXNET_HOME", "~/.mxnet"),
+                                "datasets", "wikitext-2")
+        super().__init__(root, segment, vocab, seq_len)
+
+
+class WikiText103(_WikiText):
+    """WikiText-103 (reference: text.py:143)."""
+
+    _namespace = "wikitext-103"
+    _token_files = {"train": "wiki.train.tokens",
+                    "validation": "wiki.valid.tokens",
+                    "test": "wiki.test.tokens"}
+
+    def __init__(self, root=None, segment="train", vocab=None, seq_len=35):
+        if root is None:
+            root = os.path.join(os.environ.get("MXNET_HOME", "~/.mxnet"),
+                                "datasets", "wikitext-103")
+        super().__init__(root, segment, vocab, seq_len)

@@ -1,2 +1,7 @@
 """mx.image namespace (reference parity: python/mxnet/image/)."""
 from .image import *  # noqa: F401,F403
+from .detection import (  # noqa: F401
+    DetAugmenter, DetBorrowAug, DetRandomSelectAug, DetHorizontalFlipAug,
+    DetRandomCropAug, DetRandomPadAug, CreateMultiRandCropAugmenter,
+    CreateDetAugmenter, ImageDetIter)
+from . import detection as det  # noqa: F401

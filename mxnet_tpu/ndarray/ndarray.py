@@ -118,6 +118,13 @@ class NDArray:
         _engine.get().wait_for_var(self._data)
         return np.asarray(self._data)
 
+    def __array__(self, dtype=None):
+        # one device fetch for np.asarray(nd_arr) — without this numpy
+        # falls back to the sequence protocol (one eager __getitem__
+        # dispatch per row: thousands of device round-trips)
+        out = self.asnumpy()
+        return out.astype(dtype) if dtype is not None else out
+
     def asscalar(self):
         return self.asnumpy().item()
 
@@ -840,13 +847,35 @@ def waitall():
 
 # ---------------------------------------------------------------------------
 # serialization (parity: mx.nd.save/load, src/ndarray/ndarray.cc ser/de).
-# Format: npz with a manifest — portable, versioned via key prefix.
+# Two on-disk formats, distinguished by content sniffing on load:
+#   - "binary": the reference's magic-numbered record format — upstream
+#     *.params files load directly and saves load in upstream
+#     (ndarray/legacy_io.py)
+#   - "npz" (default): npz with a manifest — portable, versioned via key
+#     prefix
 # ---------------------------------------------------------------------------
 
 _SAVE_PREFIX = "mxtpu:v1:"
 
 
-def save(fname, data):
+def save(fname, data, format="npz"):
+    if format == "binary":
+        from . import legacy_io
+
+        if isinstance(data, NDArray):
+            legacy_io.save_binary(fname, [data.asnumpy()])
+        elif isinstance(data, (list, tuple)):
+            legacy_io.save_binary(fname, [a.asnumpy() for a in data])
+        elif isinstance(data, dict):
+            keys = list(data.keys())
+            legacy_io.save_binary(fname,
+                                  [data[k].asnumpy() for k in keys], keys)
+        else:
+            raise MXNetError("save expects NDArray, list or dict")
+        return
+    if format != "npz":
+        raise MXNetError("unknown save format %r (use 'npz' or 'binary')"
+                         % (format,))
     arrays = {}
     if isinstance(data, NDArray):
         arrays["%s0" % _SAVE_PREFIX] = data.asnumpy()
@@ -867,6 +896,13 @@ def save(fname, data):
 
 
 def load(fname):
+    from . import legacy_io
+
+    if legacy_io.is_binary_format(fname):
+        arrays, names = legacy_io.load_binary(fname)
+        if names:
+            return {k: array(a) for k, a in zip(names, arrays)}
+        return [array(a) for a in arrays]
     with np.load(fname, allow_pickle=False) as f:
         keys = list(f.keys())
         if any(k.startswith(_SAVE_PREFIX + "dict:") for k in keys):

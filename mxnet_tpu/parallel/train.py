@@ -317,8 +317,12 @@ class ShardedTrainer:
         if self._step_fn is None:
             self._build(len(raw_in))
         rng = _random.next_key()
-        self.param_arrays, self.opt_state, loss = self._step_fn(
-            self.param_arrays, self.opt_state, tuple(raw_in), raw_label, rng)
+        from .. import profiler as _profiler
+
+        self.param_arrays, self.opt_state, loss = _profiler.timed_call(
+            "ShardedTrainer.step", self._step_fn,
+            (self.param_arrays, self.opt_state, tuple(raw_in), raw_label,
+             rng))
         return loss
 
     def sync_to_net(self):

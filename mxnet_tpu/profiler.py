@@ -23,6 +23,13 @@ _config = {"profile_all": False, "filename": "profile.json",
 _state = {"running": False, "dir": None}
 _records = []
 _op_stats = {}  # name -> [total_s, count, min_s, max_s]
+# bounded timeline log feeding the chrome-trace dump(); entries are
+# (name, start_s, dur_s) in perf_counter time
+_events = []
+_EVENT_CAP = 65536
+# per-compiled-program XLA cost analysis (flops / bytes accessed),
+# attributed once per compile by the jit-path hooks
+_xla_costs = {}
 
 
 def set_config(**kwargs):
@@ -76,8 +83,26 @@ def resume(profile_process="worker"):
 
 
 def dump(finished=True, profile_process="worker"):
+    """Write the chrome://tracing JSON to the configured ``filename``
+    (reference Profiler::DumpProfile, src/profiler/profiler.h:256) and
+    stop any running jax trace."""
+    import json
+
     if _state["running"] and finished:
         stop()
+    path = _config.get("filename", "profile.json")
+    trace_events = []
+    for name, t0, dur in _events:
+        trace_events.append({"name": name, "ph": "X", "cat": "op",
+                             "ts": t0 * 1e6, "dur": dur * 1e6,
+                             "pid": 0, "tid": 0})
+    payload = {"traceEvents": trace_events,
+               "displayTimeUnit": "ms",
+               "otherData": {"xla_costs": _xla_costs,
+                             "device_memory": device_memory_stats()}}
+    with open(path, "w") as f:
+        json.dump(payload, f)
+    return path
 
 
 def aggregate_enabled():
@@ -85,10 +110,17 @@ def aggregate_enabled():
     return bool(_config.get("aggregate_stats"))
 
 
-def record_op_time(name, dur_s):
-    """Called by the NDArray dispatch layer per op when aggregation is
+def sync_enabled():
+    """True when jit-path hooks should block_until_ready so timings
+    cover device execution instead of async dispatch
+    (set_config(profile_sync=True))."""
+    return bool(_config.get("profile_sync"))
+
+
+def record_op_time(name, dur_s, start_s=None):
+    """Called by the dispatch layers per op/program when aggregation is
     enabled.  O(#op-names) running counters, like the reference's
-    aggregate_stats.cc — not an unbounded event log."""
+    aggregate_stats.cc, plus a bounded timeline log for dump()."""
     st = _op_stats.get(name)
     if st is None:
         _op_stats[name] = [dur_s, 1, dur_s, dur_s]
@@ -99,11 +131,64 @@ def record_op_time(name, dur_s):
             st[2] = dur_s
         if dur_s > st[3]:
             st[3] = dur_s
+    if len(_events) < _EVENT_CAP:
+        if start_s is None:
+            start_s = time.perf_counter() - dur_s
+        _events.append((name, start_s, dur_s))
+
+
+def timed_call(name, fn, args):
+    """Run ``fn(*args)`` and, when aggregation is on, record its wall
+    time under ``name`` — blocking on the result first when
+    profile_sync is set so the timing covers device execution rather
+    than async dispatch.  The single helper keeps every jit-path hook
+    (CachedOp, ShardedTrainer, Executor) behaviorally identical."""
+    if not aggregate_enabled():
+        return fn(*args)
+    import jax
+
+    t0 = time.perf_counter()
+    out = fn(*args)
+    if sync_enabled():
+        jax.block_until_ready(out)
+    record_op_time(name, time.perf_counter() - t0, t0)
+    return out
+
+
+def record_xla_cost(name, analysis):
+    """Attribute a compiled program's XLA cost analysis (flops, bytes
+    accessed) — the jit-path analogue of the reference's per-op FLOP
+    counters (storage_profiler.h role for the compiled path)."""
+    if not isinstance(analysis, dict):
+        return
+    _xla_costs[name] = {
+        "flops": float(analysis.get("flops", 0.0)),
+        "bytes_accessed": float(analysis.get("bytes accessed",
+                                             analysis.get("bytes_accessed",
+                                                          0.0)))}
+
+
+def device_memory_stats():
+    """Per-device HBM counters from the XLA allocator (reference
+    storage_profiler.h GpuDeviceStorageProfiler role)."""
+    import jax
+
+    out = {}
+    for d in jax.local_devices():
+        try:
+            ms = d.memory_stats()
+        except Exception:
+            ms = None
+        if ms:
+            out[str(d)] = {k: int(v) for k, v in ms.items()
+                           if isinstance(v, (int, float))}
+    return out
 
 
 def dumps(reset=False):
     """Aggregate per-op statistics (reference aggregate_stats.cc table:
-    name, count, total/min/max/avg ms)."""
+    name, count, total/min/max/avg ms), the XLA cost table for compiled
+    programs, and device-memory counters."""
     agg = dict(_op_stats)
     for name, dur in _records:   # scope timers (Task/Event/Frame)
         tot, cnt, mn, mx = agg.get(name, (0.0, 0, float("inf"), 0.0))
@@ -115,9 +200,26 @@ def dumps(reset=False):
     for name, (tot, cnt, mn, mx) in sorted(agg.items()):
         out.append("%-32s %10d %12.4f %12.4f %12.4f %12.4f" % (
             name, cnt, tot * 1e3, mn * 1e3, mx * 1e3, tot / cnt * 1e3))
+    if _xla_costs:
+        out.append("")
+        out.append("XLA cost analysis (per compiled program):")
+        out.append("%-40s %14s %16s" % ("Program", "GFLOPs", "MB accessed"))
+        for name, c in sorted(_xla_costs.items()):
+            out.append("%-40s %14.3f %16.3f" % (
+                name, c["flops"] / 1e9, c["bytes_accessed"] / 1e6))
+    mem = device_memory_stats()
+    if mem:
+        out.append("")
+        out.append("Device memory:")
+        for dev, st in mem.items():
+            used = st.get("bytes_in_use", 0)
+            peak = st.get("peak_bytes_in_use", 0)
+            out.append("%-32s in_use %12d  peak %12d" % (dev, used, peak))
     if reset:
         _records.clear()
         _op_stats.clear()
+        _events.clear()
+        _xla_costs.clear()
     return "\n".join(out)
 
 

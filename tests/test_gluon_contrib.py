@@ -1,0 +1,262 @@
+"""gluon.contrib tests (modeled on the reference's
+tests/python/unittest/test_gluon_contrib.py: conv-RNN cell shape/unroll
+checks, VariationalDropoutCell mask reuse, LSTMPCell, PixelShuffle
+value-layout checks, contrib data samplers/datasets)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+from mxnet_tpu.gluon import contrib
+from mxnet_tpu.test_utils import assert_almost_equal
+
+
+# --- convolutional recurrent cells -----------------------------------
+
+@pytest.mark.parametrize("cls,dims,gates", [
+    (contrib.rnn.Conv1DRNNCell, 1, 1),
+    (contrib.rnn.Conv2DRNNCell, 2, 1),
+    (contrib.rnn.Conv3DRNNCell, 3, 1),
+    (contrib.rnn.Conv1DLSTMCell, 1, 4),
+    (contrib.rnn.Conv2DLSTMCell, 2, 4),
+    (contrib.rnn.Conv3DLSTMCell, 3, 4),
+    (contrib.rnn.Conv1DGRUCell, 1, 3),
+    (contrib.rnn.Conv2DGRUCell, 2, 3),
+    (contrib.rnn.Conv3DGRUCell, 3, 3),
+])
+def test_conv_cells_step_and_shapes(cls, dims, gates):
+    spatial = (8, 7, 6)[:dims]
+    in_c, hid = 3, 5
+    cell = cls(input_shape=(in_c,) + spatial, hidden_channels=hid,
+               i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    x = nd.uniform(shape=(2, in_c) + spatial)
+    states = cell.begin_state(batch_size=2)
+    out, new_states = cell(x, states)
+    assert out.shape == (2, hid) + spatial
+    for s in new_states:
+        assert s.shape == (2, hid) + spatial
+    assert cell.i2h_weight.shape[0] == hid * gates
+    # a second step consumes the produced state
+    out2, _ = cell(x, new_states)
+    assert out2.shape == out.shape
+
+
+def test_conv_lstm_unroll_grad():
+    cell = contrib.rnn.Conv2DLSTMCell(input_shape=(2, 6, 6),
+                                      hidden_channels=4,
+                                      i2h_kernel=3, h2h_kernel=3, i2h_pad=1)
+    cell.initialize()
+    x = nd.uniform(shape=(3, 5, 2, 6, 6))  # NTC...: (N, T, C, H, W)
+    with autograd.record():
+        outputs, states = cell.unroll(5, x, layout="NTC",
+                                      merge_outputs=True)
+        loss = outputs.sum()
+    loss.backward()
+    assert outputs.shape == (3, 5, 4, 6, 6)
+    g = cell.i2h_weight.grad()
+    assert np.isfinite(g.asnumpy()).all()
+    assert float(nd.abs(g).sum().asnumpy()) > 0
+
+
+def test_conv_cell_i2h_shrinks_without_pad():
+    # no i2h pad: state spatial dims shrink by k-1 relative to input
+    cell = contrib.rnn.Conv1DRNNCell(input_shape=(2, 10), hidden_channels=3,
+                                     i2h_kernel=3, h2h_kernel=3)
+    cell.initialize()
+    x = nd.uniform(shape=(2, 2, 10))
+    out, _ = cell(x, cell.begin_state(batch_size=2))
+    assert out.shape == (2, 3, 8)
+
+
+def test_conv_cell_rejects_even_h2h_and_channel_last():
+    with pytest.raises(AssertionError):
+        contrib.rnn.Conv1DRNNCell(input_shape=(2, 8), hidden_channels=3,
+                                  i2h_kernel=3, h2h_kernel=2)
+    with pytest.raises(NotImplementedError):
+        contrib.rnn.Conv1DRNNCell(input_shape=(8, 2), hidden_channels=3,
+                                  i2h_kernel=3, h2h_kernel=3,
+                                  conv_layout="NWC")
+
+
+# --- VariationalDropoutCell / LSTMPCell ------------------------------
+
+def test_variational_dropout_mask_locked_across_steps():
+    base = mx.gluon.rnn.RNNCell(16)
+    cell = contrib.rnn.VariationalDropoutCell(base, drop_inputs=0.5)
+    cell.initialize()
+    x = nd.ones((4, 16))
+    states = cell.begin_state(batch_size=4)
+    with autograd.record():   # dropout active in train mode
+        out1, states = cell(x, states)
+        out2, states = cell(x, states)
+    # same input mask both steps -> zeroed input columns coincide;
+    # verify by re-applying: a fresh reset() resamples
+    m1 = cell._masks["inputs"].asnumpy()
+    cell.reset()
+    with autograd.record():
+        cell(x, cell.begin_state(batch_size=4))
+    m2 = cell._masks["inputs"].asnumpy()
+    assert m1.shape == (4, 16)
+    assert not np.array_equal(m1, m2)
+
+
+def test_variational_dropout_unroll_masks_per_sequence():
+    base = mx.gluon.rnn.LSTMCell(8)
+    cell = contrib.rnn.VariationalDropoutCell(base, drop_outputs=0.3,
+                                              drop_states=0.3)
+    cell.initialize()
+    x = nd.uniform(shape=(2, 6, 8))
+    with autograd.record():
+        out, states = cell.unroll(6, x, layout="NTC", merge_outputs=True)
+    assert out.shape == (2, 6, 8)
+    assert len(states) == 2
+
+
+def test_lstmp_cell_projection():
+    cell = contrib.rnn.LSTMPCell(hidden_size=12, projection_size=5)
+    cell.initialize()
+    x = nd.uniform(shape=(4, 7))
+    states = cell.begin_state(batch_size=4)
+    assert states[0].shape == (4, 5) and states[1].shape == (4, 12)
+    out, new_states = cell(x, states)
+    assert out.shape == (4, 5)            # projected
+    assert new_states[0].shape == (4, 5)
+    assert new_states[1].shape == (4, 12)  # cell state unprojected
+    # unroll + grad through the projection
+    seq = nd.uniform(shape=(4, 3, 7))
+    with autograd.record():
+        outs, _ = cell.unroll(3, seq, layout="NTC", merge_outputs=True)
+        outs.sum().backward()
+    assert float(nd.abs(cell.h2r_weight.grad()).sum().asnumpy()) > 0
+
+
+def test_dynamic_unroll_matches_cell_unroll():
+    cell = mx.gluon.rnn.GRUCell(9)
+    cell.initialize()
+    x = nd.uniform(shape=(5, 2, 9))   # TNC
+    begin = cell.begin_state(batch_size=2)
+    out1, st1 = contrib.rnn.dynamic_unroll(cell, x, begin, layout="TNC")
+    out2, st2 = cell.unroll(5, x, begin_state=begin, layout="TNC",
+                            merge_outputs=True)
+    assert_almost_equal(out1, out2, rtol=1e-5, atol=1e-5)
+    assert_almost_equal(st1[0], st2[0], rtol=1e-5, atol=1e-5)
+
+
+def test_dynamic_unroll_valid_length():
+    cell = mx.gluon.rnn.RNNCell(4)
+    cell.initialize()
+    x = nd.uniform(shape=(6, 3, 4))
+    begin = cell.begin_state(batch_size=3)
+    vl = nd.array([2, 4, 6])
+    out, states = contrib.rnn.dynamic_unroll(cell, x, begin, layout="TNC",
+                                             valid_length=vl)
+    o = out.asnumpy()
+    assert (o[2:, 0] == 0).all() and (o[4:, 1] == 0).all()
+    # state of sample 0 is its step-2 state, not the padded step-6 one
+    ref, st = contrib.rnn.dynamic_unroll(cell, x[:2], begin, layout="TNC")
+    assert_almost_equal(states[0].asnumpy()[0], st[0].asnumpy()[0],
+                        rtol=1e-5, atol=1e-5)
+
+
+# --- contrib nn ------------------------------------------------------
+
+def test_pixelshuffle_shapes_and_values():
+    px = contrib.PixelShuffle1D(2)
+    assert px(nd.zeros((1, 8, 3))).shape == (1, 4, 6)
+    px2 = contrib.PixelShuffle2D((2, 3))
+    assert px2(nd.zeros((1, 12, 3, 5))).shape == (1, 2, 6, 15)
+    px3 = contrib.PixelShuffle3D((2, 3, 4))
+    assert px3(nd.zeros((1, 48, 3, 5, 7))).shape == (1, 2, 6, 15, 28)
+    # value layout: channel c*f + i lands at spatial position w*f + i
+    x = nd.array(np.arange(2 * 4 * 3).reshape(1, 4 * 2 // 2 * 2, 3)
+                 .astype(np.float32))  # (1, 4, 3), factor 2 -> (1, 2, 6)
+    y = contrib.PixelShuffle1D(2)(x).asnumpy()
+    xin = x.asnumpy()
+    for c in range(2):
+        for w in range(3):
+            for i in range(2):
+                assert y[0, c, w * 2 + i] == xin[0, c * 2 + i, w]
+
+
+def test_pixelshuffle_hybridized():
+    net = mx.gluon.nn.HybridSequential()
+    net.add(contrib.PixelShuffle2D(2))
+    net.hybridize()
+    out = net(nd.uniform(shape=(2, 8, 4, 4)))
+    assert out.shape == (2, 2, 8, 8)
+
+
+def test_sparse_embedding_trains():
+    emb = contrib.SparseEmbedding(50, 8)
+    emb.initialize()
+    idx = nd.array([1, 3, 3, 7])
+    with autograd.record():
+        out = emb(idx)
+        out.sum().backward()
+    assert out.shape == (4, 8)
+    g = emb.weight.grad().asnumpy()
+    assert g.shape == (50, 8)
+    # only the looked-up rows receive gradient
+    assert np.abs(g[[1, 3, 7]]).sum() > 0
+    assert np.abs(g[[0, 2, 4]]).sum() == 0
+
+
+def test_concurrent_layers():
+    net = contrib.HybridConcurrent(axis=1)
+    net.add(mx.gluon.nn.Dense(4), mx.gluon.nn.Dense(6),
+            contrib.Identity())
+    net.initialize()
+    out = net(nd.uniform(shape=(2, 3)))
+    assert out.shape == (2, 4 + 6 + 3)
+
+
+# --- contrib data ----------------------------------------------------
+
+def test_interval_sampler():
+    assert list(contrib.data.IntervalSampler(13, interval=3)) == \
+        [0, 3, 6, 9, 12, 1, 4, 7, 10, 2, 5, 8, 11]
+    assert list(contrib.data.IntervalSampler(13, interval=3,
+                                             rollover=False)) == \
+        [0, 3, 6, 9, 12]
+    assert len(contrib.data.IntervalSampler(13, interval=3)) == 13
+
+
+def test_wikitext_local_file(tmp_path):
+    text = "hello world\nfoo bar baz\nhello foo\n"
+    (tmp_path / "wiki.train.tokens").write_text(text)
+    ds = contrib.data.WikiText2(root=str(tmp_path), segment="train",
+                                seq_len=4)
+    # 8 tokens + 3 <eos> = 11 -> 2 samples of 4
+    assert len(ds) == 2
+    d, l = ds[0]
+    assert d.shape == (4,) and l.shape == (4,)
+    # label is data shifted one token ahead
+    flat_d = np.concatenate([ds[i][0].asnumpy() for i in range(len(ds))])
+    flat_l = np.concatenate([ds[i][1].asnumpy() for i in range(len(ds))])
+    np.testing.assert_array_equal(flat_d[1:], flat_l[:-1])
+    assert ds.vocabulary is not None
+    eos_id = ds.vocabulary.to_indices("<eos>")
+    assert eos_id in flat_d
+
+
+def test_wikitext_missing_file_error(tmp_path):
+    from mxnet_tpu.base import MXNetError
+
+    with pytest.raises(MXNetError, match="token file"):
+        contrib.data.WikiText2(root=str(tmp_path / "nope"))
+
+
+def test_variational_dropout_identity_at_inference():
+    # outside autograd.record() the wrapper must be exactly the base
+    # cell: deterministic, no masking
+    base = mx.gluon.rnn.RNNCell(16)
+    cell = contrib.rnn.VariationalDropoutCell(base, drop_inputs=0.5,
+                                              drop_outputs=0.5)
+    cell.initialize()
+    x = nd.ones((4, 16))
+    s = cell.begin_state(batch_size=4)
+    o1, _ = cell(x, s)
+    cell.reset()
+    o2, _ = cell(x, cell.begin_state(batch_size=4))
+    np.testing.assert_array_equal(o1.asnumpy(), o2.asnumpy())

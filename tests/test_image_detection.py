@@ -1,0 +1,187 @@
+"""ImageDetIter + detection augmenter tests (reference semantics:
+python/mxnet/image/detection.py; test coverage modeled on the
+reference's tests/python/unittest/test_image.py TestImageDetIter).
+
+The bbox-transform tests place a uniquely-colored patch exactly under
+each box so geometric consistency between pixels and labels can be
+asserted after crop/flip/pad."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, recordio
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.image import detection as det
+
+
+def _det_label(objs, extras=()):
+    """im2rec detection layout: [header_w, obj_w, extras..., objs...]"""
+    flat = [2 + len(extras), 5] + list(extras)
+    for o in objs:
+        flat.extend(o)
+    return np.array(flat, np.float32)
+
+
+@pytest.fixture(scope="module")
+def det_rec(tmp_path_factory):
+    """Synthetic detection .rec: gray images with a red and a blue patch,
+    labels marking the patches in normalized corner coords."""
+    root = tmp_path_factory.mktemp("detrec")
+    rec = str(root / "det.rec")
+    idx = str(root / "det.idx")
+    w = recordio.MXIndexedRecordIO(idx, rec, "w")
+    rng = np.random.RandomState(5)
+    for i in range(12):
+        img = np.full((64, 64, 3), 90, np.uint8)
+        # red patch (class 0)
+        x1, y1 = rng.randint(2, 20, 2)
+        img[y1:y1 + 16, x1:x1 + 16] = (255, 0, 0)
+        objs = [[0, x1 / 64, y1 / 64, (x1 + 16) / 64, (y1 + 16) / 64]]
+        if i % 2 == 0:   # some images have a second (blue, class 1) box
+            img[40:56, 40:56] = (0, 0, 255)
+            objs.append([1, 40 / 64, 40 / 64, 56 / 64, 56 / 64])
+        hdr = recordio.IRHeader(0, _det_label(objs), i, 0)
+        w.write_idx(i, recordio.pack_img(hdr, img, quality=100,
+                                         img_fmt=".png"))
+    w.close()
+    return rec
+
+
+def test_parse_label_layout():
+    lab = _det_label([[2, 0.1, 0.2, 0.5, 0.6], [7, 0.0, 0.0, 1.0, 1.0]],
+                     extras=(640, 480))
+    parsed = det.ImageDetIter._parse_label(lab)
+    assert parsed.shape == (2, 5)
+    assert parsed[0, 0] == 2 and parsed[1, 0] == 7
+    # degenerate boxes are dropped; all-degenerate raises
+    lab2 = _det_label([[0, 0.5, 0.5, 0.5, 0.5], [1, 0.1, 0.1, 0.9, 0.9]])
+    assert det.ImageDetIter._parse_label(lab2).shape == (1, 5)
+    with pytest.raises(MXNetError):
+        det.ImageDetIter._parse_label(
+            _det_label([[0, 0.5, 0.5, 0.5, 0.5]]))
+
+
+def test_det_iter_batches(det_rec):
+    it = det.ImageDetIter(batch_size=4, data_shape=(3, 64, 64),
+                          path_imgrec=det_rec)
+    assert it.label_shape == (2, 5)
+    assert it.provide_label[0].shape == (4, 2, 5)
+    batches = list(it)
+    assert len(batches) == 3
+    b = batches[0]
+    assert b.data[0].shape == (4, 3, 64, 64)
+    assert b.label[0].shape == (4, 2, 5)
+    lab = b.label[0].asnumpy()
+    # single-object images pad the second row with -1
+    assert (lab[:, 0, 0] >= 0).all()
+    assert set(np.unique(lab[:, 1, 0])) <= {-1.0, 1.0}
+    # epoch restart works
+    it.reset()
+    assert len(list(it)) == 3
+
+
+def test_det_iter_boxes_match_pixels(det_rec):
+    """With no augmentation, every labeled red box sits on red pixels."""
+    it = det.ImageDetIter(batch_size=12, data_shape=(3, 64, 64),
+                          path_imgrec=det_rec)
+    b = next(iter(it))
+    data = b.data[0].asnumpy()
+    labels = b.label[0].asnumpy()
+    for img, lab in zip(data, labels):
+        row = lab[0]
+        x1, y1, x2, y2 = (row[1:5] * 64).astype(int)
+        patch = img[:, y1 + 2:y2 - 2, x1 + 2:x2 - 2]
+        assert patch[0].mean() > 200 and patch[2].mean() < 50  # red
+
+
+def test_flip_moves_boxes_with_pixels():
+    imgn = np.zeros((32, 32, 3), np.float32)
+    imgn[4:12, 2:10, 0] = 255.0
+    img = nd.array(imgn)
+    label = np.array([[0, 2 / 32, 4 / 32, 10 / 32, 12 / 32]], np.float32)
+    aug = det.DetHorizontalFlipAug(p=1.0)
+    out, out_label = aug(img, label)
+    o = out.asnumpy()
+    x1, y1, x2, y2 = (out_label[0, 1:5] * 32).astype(int)
+    assert o[y1 + 1:y2 - 1, x1 + 1:x2 - 1, 0].min() == 255.0
+    assert abs(out_label[0, 1] - (1 - 10 / 32)) < 1e-6
+    assert abs(out_label[0, 3] - (1 - 2 / 32)) < 1e-6
+
+
+def test_random_crop_keeps_box_on_pixels():
+    rng = np.random.RandomState(0)
+    img = np.zeros((48, 48, 3), np.float32)
+    img[20:30, 16:28, 1] = 255.0    # green object
+    label = np.array([[0, 16 / 48, 20 / 48, 28 / 48, 30 / 48]], np.float32)
+    aug = det.DetRandomCropAug(min_object_covered=0.8,
+                               area_range=(0.3, 1.0), max_attempts=100)
+    hits = 0
+    for _ in range(10):
+        out, out_label = aug(nd.array(img), label.copy())
+        o = out.asnumpy()
+        for row in out_label:
+            h, w = o.shape[0], o.shape[1]
+            x1, y1, x2, y2 = row[1:5]
+            assert 0 <= x1 < x2 <= 1 and 0 <= y1 < y2 <= 1
+            cx = int((x1 + x2) / 2 * w)
+            cy = int((y1 + y2) / 2 * h)
+            if o[cy, cx, 1] == 255.0:
+                hits += 1
+    assert hits >= 8   # box centers track the object through crops
+
+
+def test_random_pad_scales_boxes():
+    img = np.zeros((20, 20, 3), np.float32)
+    img[5:15, 5:15, 2] = 200.0
+    label = np.array([[0, 0.25, 0.25, 0.75, 0.75]], np.float32)
+    aug = det.DetRandomPadAug(area_range=(1.5, 3.0), max_attempts=100)
+    out, out_label = aug(nd.array(img), label.copy())
+    o = out.asnumpy()
+    assert o.shape[0] > 20 or o.shape[1] > 20   # canvas grew
+    x1, y1, x2, y2 = out_label[0, 1:5]
+    h, w = o.shape[0], o.shape[1]
+    # the box still frames the blue patch on the padded canvas
+    sub = o[int(y1 * h) + 1:int(y2 * h) - 1,
+            int(x1 * w) + 1:int(x2 * w) - 1, 2]
+    assert sub.min() == 200.0
+    # padding filled with pad_val
+    assert o[0, 0, 0] == 128
+
+
+def test_create_det_augmenter_pipeline(det_rec):
+    it = det.ImageDetIter(batch_size=4, data_shape=(3, 32, 32),
+                          path_imgrec=det_rec, rand_crop=0.5,
+                          rand_pad=0.5, rand_mirror=True, mean=True,
+                          std=True)
+    b = next(iter(it))
+    assert b.data[0].shape == (4, 3, 32, 32)
+    lab = b.label[0].asnumpy()
+    live = lab[lab[:, :, 0] >= 0]
+    assert live.size > 0
+    assert (live[:, 1:5] >= -1e-6).all() and (live[:, 1:5] <= 1 + 1e-6).all()
+
+
+def test_reshape_and_sync_label_shape(det_rec):
+    a = det.ImageDetIter(batch_size=2, data_shape=(3, 64, 64),
+                         path_imgrec=det_rec)
+    b = det.ImageDetIter(batch_size=2, data_shape=(3, 64, 64),
+                         path_imgrec=det_rec)
+    b.reshape(label_shape=(6, 5))
+    a.sync_label_shape(b)
+    assert a.label_shape == (6, 5) and b.label_shape == (6, 5)
+    with pytest.raises(MXNetError):
+        a.reshape(label_shape=(1, 5))     # cannot shrink
+    batch = next(iter(a))
+    assert batch.label[0].shape == (2, 6, 5)
+
+
+def test_draw_next(det_rec):
+    it = det.ImageDetIter(batch_size=2, data_shape=(3, 64, 64),
+                          path_imgrec=det_rec)
+    imgs = []
+    for img in it.draw_next(color=(255, 255, 0)):
+        imgs.append(img)
+        if len(imgs) == 3:
+            break
+    assert len(imgs) == 3
+    assert imgs[0].shape == (64, 64, 3) and imgs[0].dtype == np.uint8

@@ -214,3 +214,55 @@ def test_bucketing_get_params_synced_after_update():
     bm.update()
     p1 = bm.get_params()[0]
     assert any(np.abs(p1[k].asnumpy() - p0[k]).max() > 0 for k in p0)
+
+
+def _ff_iter(n=60, batch=10, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 8).astype(np.float32)
+    w = rng.rand(8, 1)
+    y = (X @ w > np.median(X @ w)).astype(np.float32).ravel()
+    return mx.io.NDArrayIter(X, y, batch_size=batch)
+
+
+def _ff_symbol():
+    d = mx.sym.var("data")
+    h = mx.sym.FullyConnected(d, num_hidden=8, name="fc1")
+    h = mx.sym.relu(h)
+    out = mx.sym.FullyConnected(h, num_hidden=2, name="fc2")
+    return mx.sym.SoftmaxOutput(out, mx.sym.var("softmax_label"),
+                                name="softmax")
+
+
+def test_feedforward_fit_predict():
+    """Legacy FeedForward adapter trains and predicts (reference
+    python/mxnet/model.py FeedForward)."""
+    from mxnet_tpu.model import FeedForward
+
+    train = _ff_iter()
+    ff = FeedForward(_ff_symbol(), num_epoch=10, learning_rate=0.5)
+    ff.fit(train)
+    assert ff.arg_params and "fc1_weight" in ff.arg_params
+    preds = ff.predict(_ff_iter())
+    p = preds.asnumpy() if hasattr(preds, "asnumpy") else preds
+    assert p.shape == (60, 2)
+    # trained accuracy beats chance on the separable toy task
+    labels = np.concatenate(
+        [b.label[0].asnumpy() for b in _ff_iter()])
+    acc = (p.argmax(axis=1) == labels).mean()
+    assert acc > 0.6, acc
+
+
+def test_feedforward_save_load_round_trip(tmp_path):
+    from mxnet_tpu.model import FeedForward
+
+    train = _ff_iter()
+    ff = FeedForward(_ff_symbol(), num_epoch=2, learning_rate=0.5)
+    ff.fit(train)
+    prefix = str(tmp_path / "ffmodel")
+    ff.save(prefix)                      # writes prefix-0002.params
+    ff2 = FeedForward.load(prefix, 2)
+    preds1 = ff.predict(_ff_iter())
+    preds2 = ff2.predict(_ff_iter())
+    a1 = preds1.asnumpy() if hasattr(preds1, "asnumpy") else preds1
+    a2 = preds2.asnumpy() if hasattr(preds2, "asnumpy") else preds2
+    np.testing.assert_allclose(a1, a2, rtol=1e-5, atol=1e-6)
