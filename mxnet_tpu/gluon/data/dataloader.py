@@ -7,7 +7,14 @@ transfers).  num_workers>0 uses a thread pool with double-buffered
 prefetch — the XLA client releases the GIL during uploads/compute, so
 decode/augment overlaps the TPU step the way the reference's
 ThreadedIter pipeline did; process isolation (POSIX-shm NDArrays) is not
-needed because there is no per-process GPU context to protect."""
+needed because there is no per-process GPU context to protect.
+
+Known limitation vs the reference: transforms written as pure Python
+(no numpy/PIL/native calls releasing the GIL) serialize across the
+thread pool, where the reference's multiprocessing workers would scale.
+The supported fix is to keep transforms vectorized (numpy / nd ops /
+the native decoder) — those scale linearly with num_workers here; see
+docs/perf_notes.md "Input pipeline"."""
 from __future__ import annotations
 
 import sys

@@ -1,0 +1,136 @@
+"""Small-batch inference serving harness.
+
+Reference context: docs/faq/perf.md:181-199 benchmarks small-batch
+inference throughput; on this platform a single unchained jit dispatch
+costs ~6 ms through the device tunnel, which caps bs32 ResNet-50 at
+~1/6 of the chip's capability (docs/perf_notes.md).
+
+TPU-native fix: amortize dispatch by running K microbatches per XLA
+program — a `lax.scan` over a stacked (K, B, ...) input — and keep the
+next chunk's dispatch in flight while the previous chunk's outputs are
+fetched.  One Python/tunnel round-trip then serves K batches, so the
+effective per-batch dispatch cost is ~6/K ms.  Fetches overlap compute
+via jax async dispatch (double buffering in program order).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["Predictor"]
+
+
+class Predictor:
+    """Chained-dispatch predictor over a jittable forward.
+
+    forward(x, params) -> out, with x one batch.  `chain` microbatches
+    are fused into one compiled program; `predict` streams outputs in
+    submission order.
+    """
+
+    def __init__(self, forward, params, chain=8):
+        import jax
+        from jax import lax
+
+        assert chain >= 1
+        self._chain = int(chain)
+        # commit every param to the device ONCE: host-resident params
+        # would re-upload per call, paying the tunnel's per-transfer
+        # latency for each tensor on every dispatch
+        dev = jax.devices()[0]
+        self._params = jax.tree_util.tree_map(
+            lambda a: jax.device_put(a, dev), params)
+        jax.block_until_ready(self._params)
+        self._jit_one = jax.jit(forward)
+
+        def chained(xs, params_):
+            def step(carry, x):
+                return carry, forward(x, params_)
+
+            _, outs = lax.scan(step, 0, xs)
+            return outs
+
+        self._jit_chain = jax.jit(chained)
+
+    @classmethod
+    def from_block(cls, net, example_input, chain=8):
+        """Build from a gluon HybridBlock: traces the block's forward the
+        same way CachedOp does (moving stats frozen — inference)."""
+        import jax.numpy as jnp
+
+        from . import autograd
+        from .gluon import block as block_mod
+        from .ndarray.ndarray import NDArray, array
+
+        x_nd = example_input if isinstance(example_input, NDArray) \
+            else array(np.asarray(example_input))
+        with autograd.pause():
+            block_mod._abstract_eval_forward(net, [x_nd[:1]])
+        params = list(net.collect_params().values())
+        param_arrays = tuple(p.data()._data for p in params)
+
+        def forward(x, param_arrays_):
+            saved = []
+            prev = autograd.set_training(False)
+            block_mod._trace_state.active = True
+            try:
+                for p, arr in zip(params, param_arrays_):
+                    d = p.data()
+                    saved.append((d, d._data))
+                    d._data = arr
+                out = net.hybrid_forward_dispatch(NDArray(x))
+                return out._data
+            finally:
+                block_mod._trace_state.active = False
+                autograd.set_training(prev)
+                for d, old in saved:
+                    d._data = old
+
+        return cls(forward, param_arrays, chain=chain), jnp.asarray(
+            x_nd._data)
+
+    def predict(self, batches):
+        """Yield one output (numpy) per input batch, in order.
+
+        Chunks of `chain` batches run as single dispatches; while chunk
+        i's outputs are being fetched to the host, chunk i+1 is already
+        executing (async dispatch)."""
+        import jax.numpy as jnp
+
+        chunk, order = [], []
+        pending = None   # (stacked device outputs, n_valid)
+
+        def dispatch(items):
+            n = len(items)
+            if n == 1 and self._chain == 1:
+                out = self._jit_one(jnp.asarray(items[0]), self._params)
+                return jnp.expand_dims(out, 0), 1
+            if n < self._chain:
+                # pad the tail chunk to the compiled chain length so no
+                # second program is compiled
+                items = items + [items[-1]] * (self._chain - n)
+            xs = jnp.stack([jnp.asarray(b) for b in items])
+            return self._jit_chain(xs, self._params), n
+
+        def drain(p):
+            out, n = p
+            # ONE bulk device->host fetch per chunk: row-by-row
+            # indexing would pay a tunnel round-trip per batch
+            host = np.asarray(out)
+            for i in range(n):
+                yield host[i]
+
+        for b in batches:
+            chunk.append(b)
+            if len(chunk) == self._chain:
+                out_n = dispatch(chunk)
+                chunk = []
+                if pending is not None:
+                    yield from drain(pending)
+                pending = out_n
+        if chunk:
+            out_n = dispatch(chunk)
+            if pending is not None:
+                yield from drain(pending)
+            pending = out_n
+        if pending is not None:
+            yield from drain(pending)

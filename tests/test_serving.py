@@ -1,0 +1,50 @@
+"""Serving harness tests (chained-dispatch small-batch inference,
+docs/perf_notes.md dispatch-latency mitigation)."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.serving import Predictor
+
+
+def _net():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    return net
+
+
+def test_predictor_matches_eager_in_order():
+    net = _net()
+    pred, _ = Predictor.from_block(net, nd.array(
+        np.random.rand(8, 12).astype(np.float32)), chain=4)
+    batches = [np.random.rand(8, 12).astype(np.float32)
+               for _ in range(11)]       # non-multiple of chain
+    outs = list(pred.predict(batches))
+    assert len(outs) == 11
+    for i in (0, 3, 6, 10):
+        ref = net(nd.array(batches[i])).asnumpy()
+        np.testing.assert_allclose(outs[i], ref, rtol=1e-5, atol=1e-5)
+
+
+def test_predictor_chain_one_and_empty():
+    net = _net()
+    pred, _ = Predictor.from_block(net, nd.array(
+        np.random.rand(4, 12).astype(np.float32)), chain=1)
+    batches = [np.random.rand(4, 12).astype(np.float32) for _ in range(3)]
+    outs = list(pred.predict(batches))
+    assert len(outs) == 3
+    assert list(pred.predict([])) == []
+
+
+def test_predictor_single_compile_for_tail():
+    """The padded tail chunk reuses the chained program — no second
+    compile (jit cache size stays 1 for the chained fn)."""
+    net = _net()
+    pred, _ = Predictor.from_block(net, nd.array(
+        np.random.rand(2, 12).astype(np.float32)), chain=4)
+    outs = list(pred.predict(
+        [np.random.rand(2, 12).astype(np.float32) for _ in range(6)]))
+    assert len(outs) == 6
+    assert pred._jit_chain._cache_size() == 1

@@ -1,0 +1,62 @@
+"""Small-batch serving throughput (VERDICT round-2 weak #3 / task #8).
+
+Measures bs32 ResNet-50 inference through mxnet_tpu.serving.Predictor at
+several chain depths.  Timing follows docs/perf_notes.md methodology:
+the clock stops only after every output batch has been fetched to the
+host, which cannot complete before the device work has."""
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu.gluon.model_zoo import vision  # noqa: E402
+from mxnet_tpu.serving import Predictor  # noqa: E402
+
+
+def run(batch=32, n_batches=64, chains=(1, 4, 8, 16), dtype="bfloat16"):
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize(mx.init.Xavier())
+    if dtype == "bfloat16":
+        net.cast("bfloat16")
+    x = np.random.rand(batch, 3, 224, 224).astype(np.float32)
+    if dtype == "bfloat16":
+        import jax.numpy as jnp
+
+        x = x.astype(jnp.bfloat16)
+    results = {}
+    for chain in chains:
+        pred, ex = Predictor.from_block(net, mx.nd.array(
+            np.asarray(x, np.float32)).astype(dtype) if dtype == "bfloat16"
+            else mx.nd.array(x), chain=chain)
+        batches = [np.asarray(ex)] * n_batches
+        # warm (compile)
+        list(pred.predict(batches[:chain]))
+        t0 = time.time()
+        outs = list(pred.predict(batches))
+        dt = time.time() - t0
+        assert len(outs) == n_batches and outs[0].shape[0] == batch
+        ips = batch * n_batches / dt
+        results[chain] = ips
+        print("chain=%-3d  %8.1f img/s  (%.3fs for %d batches of %d)"
+              % (chain, ips, dt, n_batches, batch))
+    return results
+
+
+if __name__ == "__main__":
+    import argparse
+
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch", type=int, default=32)
+    p.add_argument("--n-batches", type=int, default=64)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--chains", default="1,4,8,16",
+                   help="comma-separated chain depths")
+    a = p.parse_args()
+    run(a.batch, a.n_batches,
+        chains=tuple(int(c) for c in a.chains.split(",")),
+        dtype=a.dtype)
