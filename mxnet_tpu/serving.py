@@ -1,60 +1,124 @@
 """Small-batch inference serving harness.
 
-Reference context: docs/faq/perf.md:181-199 benchmarks small-batch
-inference throughput; on this platform a single unchained jit dispatch
-costs ~6 ms through the device tunnel, which caps bs32 ResNet-50 at
-~1/6 of the chip's capability (docs/perf_notes.md).
+Reference context: ``docs/faq/perf.md:181-199`` benchmarks small-batch
+(bs32) inference throughput.  On this stack two costs dominate, and the
+design attacks both:
 
-TPU-native fix: amortize dispatch by running K microbatches per XLA
-program — a `lax.scan` over a stacked (K, B, ...) input — and keep the
-next chunk's dispatch in flight while the previous chunk's outputs are
-fetched.  One Python/tunnel round-trip then serves K batches, so the
-effective per-batch dispatch cost is ~6/K ms.  Fetches overlap compute
-via jax async dispatch (double buffering in program order).
+1. **Dispatch latency** (~6 ms/call through the device tunnel): ``chain``
+   microbatches are fused into one XLA program (a ``lax.scan`` over
+   microbatches), so one Python/tunnel round-trip serves K batches.
+2. **Host->device input bytes**: the host never stacks, casts, or
+   normalizes.  Each incoming batch is ``device_put`` as-is — ideally
+   raw ``uint8`` NCHW, 4x fewer bytes than fp32, 2x fewer than bf16 —
+   the moment it arrives (``device_put`` is async, so the upload of
+   batch i+1 streams while the chain containing batch i computes), and
+   all arithmetic (cast / scale / normalize via ``preprocess``) happens
+   on device inside the compiled program, fused into the first conv.
+
+Measured on the tunneled dev chip (docs/perf_notes.md): the compiled
+chain program sustains ~5.7k img/s with device-resident input; host-fed
+throughput is capped by the tunnel link (~5-30 MB/s), which this
+pipeline saturates.  On a real TPU host (PCIe, >10 GB/s) the same
+pipeline is compute-bound.
 """
 from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["Predictor"]
+__all__ = ["Predictor", "uint8_normalizer"]
+
+
+def uint8_normalizer(mean=(123.68, 116.779, 103.939), std=(58.393, 57.12, 57.375),
+                     dtype="bfloat16"):
+    """Build a device-side preprocess fn: uint8 NCHW -> normalized dtype.
+
+    The returned fn runs inside the Predictor's compiled program, so the
+    cast/scale fuses into the model's first convolution — the host ships
+    raw bytes only.
+    """
+    import jax.numpy as jnp
+
+    def prep(x):
+        c = x.shape[1]
+        m = jnp.asarray(mean[:c], jnp.float32).reshape(1, c, 1, 1)
+        s = jnp.asarray(std[:c], jnp.float32).reshape(1, c, 1, 1)
+        return ((x.astype(jnp.float32) - m) / s).astype(dtype)
+
+    return prep
 
 
 class Predictor:
-    """Chained-dispatch predictor over a jittable forward.
+    """Chained-dispatch, streaming-upload predictor over a jittable forward.
 
-    forward(x, params) -> out, with x one batch.  `chain` microbatches
-    are fused into one compiled program; `predict` streams outputs in
-    submission order.
+    forward(x, params) -> out, with x one batch.  ``chain`` microbatches
+    are fused into one compiled program; ``predict`` streams outputs in
+    submission order.  ``preprocess`` (optional, jittable) runs on device
+    on each batch before ``forward`` — pass :func:`uint8_normalizer` and
+    feed raw uint8 batches to minimize host->device bytes.
     """
 
-    def __init__(self, forward, params, chain=8):
+    def __init__(self, forward, params, chain=8, preprocess=None,
+                 postprocess=None, batch_shape=None, batch_dtype=None):
         import jax
         from jax import lax
 
         assert chain >= 1
         self._chain = int(chain)
+        self._preprocess = preprocess
+        self._postprocess = postprocess
         # commit every param to the device ONCE: host-resident params
         # would re-upload per call, paying the tunnel's per-transfer
         # latency for each tensor on every dispatch
-        dev = jax.devices()[0]
+        self._dev = jax.devices()[0]
         self._params = jax.tree_util.tree_map(
-            lambda a: jax.device_put(a, dev), params)
+            lambda a: jax.device_put(a, self._dev), params)
         jax.block_until_ready(self._params)
-        self._jit_one = jax.jit(forward)
 
-        def chained(xs, params_):
+        def one(x, params_):
+            if preprocess is not None:
+                x = preprocess(x)
+            out = forward(x, params_)
+            if postprocess is not None:
+                # device-side output reduction (e.g. top-k for a
+                # classify API): shrinks the device->host fetch from
+                # full logits to a few values per row.  Must return a
+                # single array with leading batch dim.
+                out = postprocess(out)
+            return out
+
+        self._jit_one = jax.jit(one)
+
+        def chained(xs_tuple, params_):
+            # stack happens ON DEVICE (a free layout op under XLA); the
+            # host-side jnp.stack of the old design serialized a full
+            # chunk-sized host copy + upload per dispatch
+            import jax.numpy as jnp
+
+            xs = jnp.stack(xs_tuple)
+
             def step(carry, x):
-                return carry, forward(x, params_)
+                return carry, one(x, params_)
 
             _, outs = lax.scan(step, 0, xs)
             return outs
 
         self._jit_chain = jax.jit(chained)
+        # serving batch contract.  Pass batch_shape (or build via
+        # from_block, which seeds it from the example input) so a
+        # ragged FIRST request pads up to the intended size; with
+        # neither, the first batch seen defines the contract.
+        self._batch_shape = tuple(batch_shape) if batch_shape else None
+        self._batch_dtype = np.dtype(batch_dtype) if batch_dtype else None
 
     @classmethod
-    def from_block(cls, net, example_input, chain=8):
+    def from_block(cls, net, example_input, chain=8, preprocess=None,
+                   postprocess=None):
         """Build from a gluon HybridBlock: traces the block's forward the
-        same way CachedOp does (moving stats frozen — inference)."""
+        same way CachedOp does (moving stats frozen — inference).
+
+        If ``preprocess`` is given, ``example_input`` should be the RAW
+        (pre-preprocess) input, e.g. a uint8 batch.
+        """
         import jax.numpy as jnp
 
         from . import autograd
@@ -63,8 +127,11 @@ class Predictor:
 
         x_nd = example_input if isinstance(example_input, NDArray) \
             else array(np.asarray(example_input))
+        probe = x_nd[:1]
+        if preprocess is not None:
+            probe = NDArray(preprocess(probe._data))
         with autograd.pause():
-            block_mod._abstract_eval_forward(net, [x_nd[:1]])
+            block_mod._abstract_eval_forward(net, [probe])
         params = list(net.collect_params().values())
         param_arrays = tuple(p.data()._data for p in params)
 
@@ -85,42 +152,80 @@ class Predictor:
                 for d, old in saved:
                     d._data = old
 
-        return cls(forward, param_arrays, chain=chain), jnp.asarray(
-            x_nd._data)
+        pred = cls(forward, param_arrays, chain=chain,
+                   preprocess=preprocess, postprocess=postprocess,
+                   batch_shape=tuple(x_nd.shape),
+                   batch_dtype=np.dtype(x_nd.dtype))
+        return pred, jnp.asarray(x_nd._data)
+
+    def _upload(self, b):
+        """Async host->device transfer of one raw batch.
+
+        Pads a ragged final batch up to the compiled batch size on the
+        host (cheap: raw bytes, no arithmetic) so no second XLA program
+        is ever compiled; returns (device_array, valid_rows)."""
+        import jax
+
+        if not isinstance(b, (np.ndarray, jax.Array)):
+            # NDArray / lists / anything else: coerce via __array__
+            # (device jax arrays must NOT round-trip through the host)
+            b = np.asarray(b)
+        if self._batch_shape is None:
+            self._batch_shape = tuple(b.shape)
+            self._batch_dtype = np.dtype(b.dtype)
+        if np.dtype(b.dtype) != self._batch_dtype:
+            # a silent dtype flip would recompile a second XLA program
+            # and (with a uint8 preprocess) normalize garbage
+            raise TypeError(
+                "batch dtype %s != compiled dtype %s"
+                % (np.dtype(b.dtype), self._batch_dtype))
+        n_valid = b.shape[0]
+        if tuple(b.shape) != self._batch_shape:
+            if tuple(b.shape[1:]) != self._batch_shape[1:] or \
+                    n_valid > self._batch_shape[0]:
+                raise ValueError(
+                    "batch shape %s incompatible with compiled shape %s: "
+                    "only the leading (batch) dim may shrink"
+                    % (tuple(b.shape), self._batch_shape))
+            b = np.asarray(b)  # single fetch if device-resident
+            pad = np.zeros((self._batch_shape[0] - n_valid,)
+                           + tuple(b.shape[1:]), b.dtype)
+            b = np.concatenate([b, pad], axis=0)
+        return jax.device_put(b, self._dev), n_valid
 
     def predict(self, batches):
         """Yield one output (numpy) per input batch, in order.
 
-        Chunks of `chain` batches run as single dispatches; while chunk
-        i's outputs are being fetched to the host, chunk i+1 is already
-        executing (async dispatch)."""
-        import jax.numpy as jnp
-
-        chunk, order = [], []
-        pending = None   # (stacked device outputs, n_valid)
+        Uploads stream ahead of compute: each batch is ``device_put``
+        (async) as soon as it is pulled from ``batches``; chunks of
+        ``chain`` device-resident batches run as single dispatches; while
+        chunk i's outputs are fetched, chunk i+1 is already executing."""
+        chunk = []            # [(device_array, n_valid)]
+        pending = None        # (stacked device outputs, [n_valid...])
 
         def dispatch(items):
-            n = len(items)
-            if n == 1 and self._chain == 1:
-                out = self._jit_one(jnp.asarray(items[0]), self._params)
-                return jnp.expand_dims(out, 0), 1
-            if n < self._chain:
-                # pad the tail chunk to the compiled chain length so no
-                # second program is compiled
-                items = items + [items[-1]] * (self._chain - n)
-            xs = jnp.stack([jnp.asarray(b) for b in items])
-            return self._jit_chain(xs, self._params), n
+            arrs = [a for a, _ in items]
+            valid = [n for _, n in items]
+            if len(arrs) == 1 and self._chain == 1:
+                out = self._jit_one(arrs[0], self._params)
+                return out[None], valid
+            if len(arrs) < self._chain:
+                # pad the tail chunk with repeats of an already-uploaded
+                # device array: zero extra host->device traffic
+                arrs = arrs + [arrs[-1]] * (self._chain - len(arrs))
+            return self._jit_chain(tuple(arrs), self._params), valid
 
         def drain(p):
-            out, n = p
-            # ONE bulk device->host fetch per chunk: row-by-row
-            # indexing would pay a tunnel round-trip per batch
+            out, valid = p
+            # ONE bulk device->host fetch per chunk: row-by-row indexing
+            # would pay a tunnel round-trip per batch
             host = np.asarray(out)
-            for i in range(n):
-                yield host[i]
+            bs = self._batch_shape[0]
+            for i, n in enumerate(valid):
+                yield host[i] if n == bs else host[i, :n]
 
         for b in batches:
-            chunk.append(b)
+            chunk.append(self._upload(b))
             if len(chunk) == self._chain:
                 out_n = dispatch(chunk)
                 chunk = []

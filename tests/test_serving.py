@@ -38,6 +38,86 @@ def test_predictor_chain_one_and_empty():
     assert list(pred.predict([])) == []
 
 
+def test_predictor_ragged_final_batch():
+    """A smaller final batch (common in serving) is padded to the
+    compiled batch size and its output sliced — no error, no recompile
+    (ADVICE r3: jnp.stack used to raise mid-stream)."""
+    net = _net()
+    pred, _ = Predictor.from_block(net, nd.array(
+        np.random.rand(8, 12).astype(np.float32)), chain=2)
+    batches = [np.random.rand(8, 12).astype(np.float32) for _ in range(3)]
+    tail = np.random.rand(3, 12).astype(np.float32)
+    outs = list(pred.predict(batches + [tail]))
+    assert len(outs) == 4
+    assert outs[3].shape == (3, 4)
+    ref = net(nd.array(tail)).asnumpy()
+    np.testing.assert_allclose(outs[3], ref, rtol=1e-5, atol=1e-5)
+    assert pred._jit_chain._cache_size() == 1
+    # a LARGER batch or different trailing shape must raise clearly
+    import pytest
+
+    with pytest.raises(ValueError):
+        list(pred.predict([np.random.rand(9, 12).astype(np.float32)]))
+
+
+def test_predictor_ragged_first_batch_and_dtype_guard():
+    """from_block seeds the compiled batch shape from the example, so a
+    ragged FIRST request pads up instead of latching a small shape; a
+    dtype flip raises instead of silently recompiling + mis-normalizing."""
+    import pytest
+
+    net = _net()
+    pred, _ = Predictor.from_block(net, nd.array(
+        np.random.rand(8, 12).astype(np.float32)), chain=2)
+    small = np.random.rand(3, 12).astype(np.float32)
+    full = np.random.rand(8, 12).astype(np.float32)
+    outs = list(pred.predict([small, full]))
+    assert outs[0].shape == (3, 4) and outs[1].shape == (8, 4)
+    ref = net(nd.array(full)).asnumpy()
+    np.testing.assert_allclose(outs[1], ref, rtol=1e-5, atol=1e-5)
+    with pytest.raises(TypeError):
+        list(pred.predict([full.astype(np.float64)]))
+
+
+def test_predictor_uint8_preprocess_on_device():
+    """Raw uint8 batches + device-side normalize match normalizing on
+    the host first: the host ships 1/4 the bytes of fp32."""
+    from mxnet_tpu.serving import uint8_normalizer
+
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(4, 3, padding=1), nn.GlobalAvgPool2D(),
+            nn.Dense(3))
+    net.initialize()
+    prep = uint8_normalizer(mean=(10.0, 20.0, 30.0), std=(2.0, 3.0, 4.0),
+                            dtype="float32")
+    raw = np.random.randint(0, 255, (4, 3, 8, 8), np.uint8)
+    pred, _ = Predictor.from_block(net, raw, chain=2, preprocess=prep)
+    outs = list(pred.predict([raw, raw, raw]))
+    host_norm = (raw.astype(np.float32)
+                 - np.array([10., 20., 30.]).reshape(1, 3, 1, 1)) \
+        / np.array([2., 3., 4.]).reshape(1, 3, 1, 1)
+    ref = net(nd.array(host_norm)).asnumpy()
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(outs[2], ref, rtol=1e-4, atol=1e-4)
+
+
+def test_predictor_device_resident_input():
+    """Already-device-resident batches pass through _upload unchanged
+    (device_put is a no-op), so repeated serving of cached inputs pays
+    zero host->device traffic."""
+    import jax
+
+    net = _net()
+    pred, _ = Predictor.from_block(net, nd.array(
+        np.random.rand(4, 12).astype(np.float32)), chain=2)
+    host = np.random.rand(4, 12).astype(np.float32)
+    dev_b = jax.device_put(host, jax.devices()[0])
+    outs = list(pred.predict([dev_b, dev_b]))
+    assert len(outs) == 2
+    ref = net(nd.array(host)).asnumpy()
+    np.testing.assert_allclose(outs[1], ref, rtol=1e-5, atol=1e-5)
+
+
 def test_predictor_single_compile_for_tail():
     """The padded tail chunk reuses the chained program — no second
     compile (jit cache size stays 1 for the chained fn)."""
@@ -48,3 +128,16 @@ def test_predictor_single_compile_for_tail():
         [np.random.rand(2, 12).astype(np.float32) for _ in range(6)]))
     assert len(outs) == 6
     assert pred._jit_chain._cache_size() == 1
+
+
+def test_predictor_accepts_ndarray_batches():
+    """mx.nd.NDArray batches coerce through __array__ (regression:
+    the streaming-upload rewrite briefly passed NDArray straight to
+    device_put, which rejects non-JAX types)."""
+    net = _net()
+    pred, _ = Predictor.from_block(net, nd.array(
+        np.random.rand(4, 12).astype(np.float32)), chain=2)
+    b = np.random.rand(4, 12).astype(np.float32)
+    outs = list(pred.predict([nd.array(b), nd.array(b)]))
+    ref = net(nd.array(b)).asnumpy()
+    np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-5)

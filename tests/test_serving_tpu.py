@@ -1,0 +1,68 @@
+"""On-chip serving throughput guard (VERDICT r3 next-round #1).
+
+Round 3 shipped a serving path that measured 20-33 img/s on the chip
+without ever being benchmarked there.  This test runs ONLY against the
+real accelerator (MXNET_TEST_PLATFORM=tpu) and fails if either serving
+regime collapses by ~10x from the recorded numbers
+(docs/serving_bench.json):
+
+- device-resident + top-5: recorded 4.7-6.7k img/s -> floor 600 img/s
+- host-fed uint8: must achieve >=35% of the *measured-now* link
+  ceiling (recorded 85-90%), so the guard tracks tunnel bandwidth
+  variance instead of a stale absolute number.
+"""
+import os
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("MXNET_TEST_PLATFORM") != "tpu"
+    or mx.context.num_tpus() == 0,
+    reason="serving throughput guard needs MXNET_TEST_PLATFORM=tpu")
+
+
+def _bench(batch=32, n_batches=16, chain=8):
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "tools"))
+    import bench_serving
+
+    return bench_serving.run(batch=batch, n_batches=n_batches,
+                             chain=chain)
+
+
+def test_serving_throughput_floor():
+    r = _bench()
+    # device-side program: 10x-collapse guard vs the ~6k img/s record
+    assert r["device_top5_img_s"] >= 600, r
+    # full-logit fetch should still clear half the V100 bs32 anchor
+    assert r["device_resident_img_s"] >= 1000, r
+    # host-fed path must saturate a healthy fraction of whatever the
+    # tunnel gives right now (recorded 85-90%; guard at 35%)
+    assert r["link_efficiency"] >= 0.35, r
+
+
+def test_predictor_correct_on_chip():
+    """Numeric spot-check of the uint8+preprocess serving path on the
+    accelerator (not just throughput)."""
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import nn
+    from mxnet_tpu.serving import Predictor, uint8_normalizer
+
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1), nn.GlobalAvgPool2D(),
+            nn.Dense(5))
+    net.initialize()
+    prep = uint8_normalizer(mean=(0., 0., 0.), std=(255., 255., 255.),
+                            dtype="float32")
+    raw = np.random.randint(0, 255, (4, 3, 16, 16), np.uint8)
+    pred, _ = Predictor.from_block(net, raw, chain=2, preprocess=prep)
+    outs = list(pred.predict([raw] * 3))
+    ref = net(nd.array(raw.astype(np.float32) / 255.0)).asnumpy()
+    np.testing.assert_allclose(outs[0], ref, rtol=2e-2, atol=2e-2)
+    np.testing.assert_allclose(outs[2], ref, rtol=2e-2, atol=2e-2)

@@ -1,9 +1,28 @@
-"""Small-batch serving throughput (VERDICT round-2 weak #3 / task #8).
+"""Small-batch serving throughput (VERDICT r3 weak #1 / next-round #1).
 
-Measures bs32 ResNet-50 inference through mxnet_tpu.serving.Predictor at
-several chain depths.  Timing follows docs/perf_notes.md methodology:
-the clock stops only after every output batch has been fetched to the
-host, which cannot complete before the device work has."""
+Measures bs32 ResNet-50 inference through mxnet_tpu.serving.Predictor in
+the modes that matter:
+
+- ``host-uint8``: raw uint8 NCHW batches fed from the host, normalized
+  on device (the fixed serving path — minimum possible bytes/image over
+  the host->device link, uploads overlapped with compute).
+- ``device``: input already device-resident (a cache-serving scenario) —
+  isolates the compiled chain program's own throughput.
+- ``link``: measured upload bandwidth for exactly one batch's bytes,
+  giving the physics ceiling  bw / bytes_per_image  that ``host-uint8``
+  should saturate.  On this dev environment the chip sits behind a
+  network tunnel (~5-30 MB/s, ~100 ms RTT — docs/perf_notes.md upload
+  table); on a real TPU host the same pipeline rides PCIe (>10 GB/s)
+  and becomes compute-bound at the ``device`` number.
+
+Timing follows docs/perf_notes.md methodology: the clock stops only
+after every output batch has been fetched to the host, which cannot
+complete before the device work has.
+
+Usage: python tools/bench_serving.py [--json docs/serving_bench.json]
+"""
+import argparse
+import json
 import os
 import sys
 import time
@@ -15,48 +34,119 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 import mxnet_tpu as mx  # noqa: E402
 from mxnet_tpu.gluon.model_zoo import vision  # noqa: E402
-from mxnet_tpu.serving import Predictor  # noqa: E402
+from mxnet_tpu.serving import Predictor, uint8_normalizer  # noqa: E402
 
 
-def run(batch=32, n_batches=64, chains=(1, 4, 8, 16), dtype="bfloat16"):
+def measure_link_bw(shape, chain=8, reps=2):
+    """Upload bandwidth in serving's own regime: a stream of ``chain``
+    per-batch async device_puts, forced together by one host fetch."""
+    import jax
+    import jax.numpy as jnp
+
+    dev = jax.devices()[0]
+    force = jax.jit(
+        lambda *a: sum(jnp.reshape(t, (-1,))[0].astype(jnp.float32)
+                       for t in a))
+    xs = [np.random.randint(0, 255, shape, np.uint8)
+          for _ in range(chain)]
+    ys = [jax.device_put(x, dev) for x in xs]
+    float(force(*ys))
+    best = float("inf")
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        ys = [jax.device_put(x, dev) for x in xs]
+        float(force(*ys))
+        best = min(best, time.perf_counter() - t0)
+    return sum(x.nbytes for x in xs) / best
+
+
+def run(batch=32, n_batches=32, chain=8, dtype="bfloat16", json_path=None):
+    import jax
+
     net = vision.resnet50_v1(classes=1000)
     net.initialize(mx.init.Xavier())
     if dtype == "bfloat16":
         net.cast("bfloat16")
-    x = np.random.rand(batch, 3, 224, 224).astype(np.float32)
-    if dtype == "bfloat16":
-        import jax.numpy as jnp
+    prep = uint8_normalizer(dtype=dtype)
+    raw = np.random.randint(0, 255, (batch, 3, 224, 224), np.uint8)
+    pred, _ = Predictor.from_block(net, raw, chain=chain, preprocess=prep)
 
-        x = x.astype(jnp.bfloat16)
-    results = {}
-    for chain in chains:
-        pred, ex = Predictor.from_block(net, mx.nd.array(
-            np.asarray(x, np.float32)).astype(dtype) if dtype == "bfloat16"
-            else mx.nd.array(x), chain=chain)
-        batches = [np.asarray(ex)] * n_batches
-        # warm (compile)
-        list(pred.predict(batches[:chain]))
-        t0 = time.time()
-        outs = list(pred.predict(batches))
-        dt = time.time() - t0
-        assert len(outs) == n_batches and outs[0].shape[0] == batch
-        ips = batch * n_batches / dt
-        results[chain] = ips
-        print("chain=%-3d  %8.1f img/s  (%.3fs for %d batches of %d)"
-              % (chain, ips, dt, n_batches, batch))
+    results = {"batch": batch, "n_batches": n_batches, "chain": chain,
+               "dtype": dtype}
+
+    bw = measure_link_bw(raw.shape, chain=chain)
+    ceiling = bw / (raw.nbytes / batch)
+    results["link_MBps"] = round(bw / 1e6, 2)
+    results["link_ceiling_img_s"] = round(ceiling, 1)
+    print("host->device link: %.1f MB/s -> physics ceiling %.0f img/s "
+          "at %.3f MB/img uint8"
+          % (bw / 1e6, ceiling, raw.nbytes / batch / 1e6), flush=True)
+
+    # --- host-uint8 streaming (the real serving path) ---
+    batches = [np.random.randint(0, 255, raw.shape, np.uint8)
+               for _ in range(n_batches)]
+    list(pred.predict(batches[:chain]))          # warm/compile
+    t0 = time.time()
+    outs = list(pred.predict(batches))
+    dt = time.time() - t0
+    assert len(outs) == n_batches and outs[0].shape[0] == batch
+    ips = batch * n_batches / dt
+    results["host_uint8_img_s"] = round(ips, 1)
+    results["link_efficiency"] = round(ips / ceiling, 3) if ceiling else None
+    print("host-uint8 : %8.1f img/s  (%.2fs, %d x bs%d)  = %.0f%% of link "
+          "ceiling" % (ips, dt, n_batches, batch, 100 * ips / ceiling),
+          flush=True)
+
+    # --- device-resident (compiled program throughput) ---
+    dev = jax.devices()[0]
+    dev_batches = [jax.device_put(b, dev) for b in batches]
+    jax.block_until_ready(dev_batches)
+    list(pred.predict(dev_batches[:chain]))
+    t0 = time.time()
+    outs = list(pred.predict(dev_batches))
+    dt = time.time() - t0
+    ips_dev = batch * n_batches / dt
+    results["device_resident_img_s"] = round(ips_dev, 1)
+    print("device     : %8.1f img/s  (%.2fs)" % (ips_dev, dt), flush=True)
+
+    # --- device-resident + device-side top-5 (classify-API shape:
+    # fetch 5 int32/row instead of 1000 logits — the realistic serving
+    # response, and it keeps the tunnel out of the output path too) ---
+    import jax.numpy as jnp
+
+    top5 = Predictor.from_block(
+        net, raw, chain=chain, preprocess=prep,
+        postprocess=lambda o: jax.lax.top_k(o.astype(jnp.float32), 5)[1])[0]
+    list(top5.predict(dev_batches[:chain]))
+    t0 = time.time()
+    outs5 = list(top5.predict(dev_batches))
+    dt = time.time() - t0
+    assert outs5[0].shape == (batch, 5)
+    ips5 = batch * n_batches / dt
+    results["device_top5_img_s"] = round(ips5, 1)
+    print("device+top5: %8.1f img/s  (%.2fs)" % (ips5, dt), flush=True)
+
+    anchor = 2086.0  # V100 fp16 bs32, reference docs/faq/perf.md:181-199
+    results["anchor_v100_img_s"] = anchor
+    results["device_vs_anchor"] = round(ips_dev / anchor, 3)
+    print("vs V100 fp16 anchor (%.0f): device %.2fx, host-fed %.2fx "
+          "(tunnel-capped)" % (anchor, ips_dev / anchor, ips / anchor),
+          flush=True)
+
+    if json_path:
+        with open(json_path, "w") as f:
+            json.dump(results, f, indent=1)
+        print("wrote", json_path)
     return results
 
 
 if __name__ == "__main__":
-    import argparse
-
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=32)
-    p.add_argument("--n-batches", type=int, default=64)
+    p.add_argument("--n-batches", type=int, default=32)
+    p.add_argument("--chain", type=int, default=8)
     p.add_argument("--dtype", default="bfloat16")
-    p.add_argument("--chains", default="1,4,8,16",
-                   help="comma-separated chain depths")
+    p.add_argument("--json", default=None)
     a = p.parse_args()
-    run(a.batch, a.n_batches,
-        chains=tuple(int(c) for c in a.chains.split(",")),
-        dtype=a.dtype)
+    run(a.batch, a.n_batches, chain=a.chain, dtype=a.dtype,
+        json_path=a.json)

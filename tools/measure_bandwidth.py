@@ -106,17 +106,27 @@ def measure_psum(shapes, num_batches):
 
 
 def measure_transfer(shapes, num_batches):
+    """Host<->device goodput, FORCED by a host-side fetch.
+
+    Round-3 postmortem: `jax.block_until_ready` returns before tunnel
+    transfers land on this platform, so the old version of this
+    function reported a fictitious 2.09 GB/s upload (docs/perf_notes.md
+    upload table has the measured truth: ~5-30 MB/s through the
+    tunnel).  A jitted 1-element reduction whose result is fetched to
+    the host cannot complete before every upload has."""
     import jax
     import jax.numpy as jnp
 
     hosts = [np.random.rand(*s).astype(np.float32) for s in shapes]
     total_bytes = sum(h.nbytes for h in hosts)
+    force = jax.jit(
+        lambda ts: sum(jnp.reshape(t, (-1,))[0] for t in ts))
     devs = [jnp.asarray(h) for h in hosts]
-    jax.block_until_ready(devs)
+    float(force(devs))
     t0 = time.time()
     for _ in range(num_batches):
         devs = [jnp.asarray(h) for h in hosts]
-        jax.block_until_ready(devs)
+        float(force(devs))
     up = total_bytes * num_batches / (time.time() - t0) / 1e9
     t0 = time.time()
     for _ in range(num_batches):
