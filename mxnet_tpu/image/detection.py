@@ -23,7 +23,8 @@ import numpy as np
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray, array
 from .image import (Augmenter, CastAug, ForceResizeAug, ImageIter,
-                    ResizeAug, _ColorNormalizeAug, fixed_crop)
+                    ResizeAug, _ColorNormalizeAug, color_jitter_auglist,
+                    fixed_crop)
 
 __all__ = ["DetAugmenter", "DetBorrowAug", "DetRandomSelectAug",
            "DetHorizontalFlipAug", "DetRandomCropAug", "DetRandomPadAug",
@@ -351,6 +352,13 @@ def CreateDetAugmenter(data_shape, resize=0, rand_crop=0, rand_pad=0,
     auglist.append(DetBorrowAug(
         ForceResizeAug((data_shape[2], data_shape[1]), inter_method)))
     auglist.append(DetBorrowAug(CastAug()))
+    # pixel-value jitters are bbox-independent: borrow the shared
+    # classification color stages (reference appends ColorJitterAug/
+    # HueJitterAug/LightingAug/RandomGrayAug here — detection.py:482;
+    # until r4 these params were silently dropped, ADVICE r3 medium)
+    for aug in color_jitter_auglist(brightness, contrast, saturation,
+                                    hue, pca_noise, rand_gray):
+        auglist.append(DetBorrowAug(aug))
     if mean is True:
         mean = np.array([123.68, 116.28, 103.53])
     if std is True:

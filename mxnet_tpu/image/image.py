@@ -19,7 +19,10 @@ __all__ = ["imdecode", "imdecode_np", "imencode", "imread", "imresize",
            "resize_short", "fixed_crop", "center_crop", "random_crop",
            "random_size_crop", "color_normalize", "CreateAugmenter",
            "Augmenter", "ResizeAug", "ForceResizeAug", "RandomCropAug",
-           "CenterCropAug", "HorizontalFlipAug", "CastAug", "ImageIter",
+           "CenterCropAug", "HorizontalFlipAug", "CastAug",
+           "RandomOrderAug", "BrightnessJitterAug", "ContrastJitterAug",
+           "SaturationJitterAug", "HueJitterAug", "ColorJitterAug",
+           "LightingAug", "RandomGrayAug", "ImageIter",
            "ImageRecordIterPy"]
 
 try:
@@ -225,6 +228,163 @@ class CastAug(Augmenter):
         return src.astype(self.typ)
 
 
+class RandomOrderAug(Augmenter):
+    """Apply a list of augmenters in random order (reference image.py
+    RandomOrderAug — used by ColorJitterAug)."""
+
+    def __init__(self, ts):
+        super().__init__()
+        self.ts = ts
+
+    def __call__(self, src):
+        for i in np.random.permutation(len(self.ts)):
+            src = self.ts[i](src)
+        return src
+
+
+# ITU-R BT.601 luma weights: the channel mix every grayscale/contrast/
+# saturation jitter below is built on
+_LUMA = np.array([0.299, 0.587, 0.114], np.float32)
+
+
+class BrightnessJitterAug(Augmenter):
+    """src *= 1 + U(-brightness, brightness)."""
+
+    def __init__(self, brightness):
+        super().__init__(brightness=brightness)
+        self.brightness = brightness
+
+    def __call__(self, src):
+        alpha = 1.0 + np.random.uniform(-self.brightness, self.brightness)
+        return array(src.asnumpy().astype(np.float32) * alpha)
+
+
+class ContrastJitterAug(Augmenter):
+    """Blend with the image's mean luma: flattens or exaggerates the
+    dynamic range by 1±contrast."""
+
+    def __init__(self, contrast):
+        super().__init__(contrast=contrast)
+        self.contrast = contrast
+
+    def __call__(self, src):
+        alpha = 1.0 + np.random.uniform(-self.contrast, self.contrast)
+        img = src.asnumpy().astype(np.float32)
+        gray_mean = (img[..., :3] * _LUMA).sum() * 3.0 / img.size
+        return array(img * alpha + gray_mean * (1.0 - alpha))
+
+
+class SaturationJitterAug(Augmenter):
+    """Blend each pixel with its own luma (per-pixel gray) by 1±saturation."""
+
+    def __init__(self, saturation):
+        super().__init__(saturation=saturation)
+        self.saturation = saturation
+
+    def __call__(self, src):
+        alpha = 1.0 + np.random.uniform(-self.saturation, self.saturation)
+        img = src.asnumpy().astype(np.float32)
+        gray = (img[..., :3] * _LUMA).sum(axis=-1, keepdims=True)
+        return array(img * alpha + gray * (1.0 - alpha))
+
+
+class HueJitterAug(Augmenter):
+    """Rotate chroma in YIQ space by U(-hue, hue) * pi (the classic
+    RGB->YIQ->rotate->RGB hue shift, reference image.py HueJitterAug)."""
+
+    _TYIQ = np.array([[0.299, 0.587, 0.114],
+                      [0.596, -0.274, -0.321],
+                      [0.211, -0.523, 0.311]], np.float32)
+    _ITYIQ = np.array([[1.0, 0.956, 0.621],
+                       [1.0, -0.272, -0.647],
+                       [1.0, -1.107, 1.705]], np.float32)
+
+    def __init__(self, hue):
+        super().__init__(hue=hue)
+        self.hue = hue
+
+    def __call__(self, src):
+        alpha = np.random.uniform(-self.hue, self.hue)
+        u, w = np.cos(alpha * np.pi), np.sin(alpha * np.pi)
+        rot = np.array([[1.0, 0.0, 0.0],
+                        [0.0, u, -w],
+                        [0.0, w, u]], np.float32)
+        t = (self._ITYIQ @ rot @ self._TYIQ).T
+        img = src.asnumpy().astype(np.float32)
+        return array(img @ t)
+
+
+class ColorJitterAug(RandomOrderAug):
+    """brightness/contrast/saturation jitters in random order."""
+
+    def __init__(self, brightness, contrast, saturation):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    """AlexNet-style PCA lighting noise: add eigvec @ (N(0,alphastd)*eigval)
+    per image (reference image.py LightingAug)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        super().__init__(alphastd=alphastd)
+        self.alphastd = alphastd
+        self.eigval = np.asarray(eigval, np.float32)
+        self.eigvec = np.asarray(eigvec, np.float32)
+
+    def __call__(self, src):
+        alpha = np.random.normal(0, self.alphastd, size=(3,))
+        rgb = (self.eigvec * alpha * self.eigval).sum(axis=1)
+        return array(src.asnumpy().astype(np.float32)
+                     + rgb.astype(np.float32))
+
+
+class RandomGrayAug(Augmenter):
+    """With probability p, collapse RGB to luma replicated over channels."""
+
+    def __init__(self, p):
+        super().__init__(p=p)
+        self.p = p
+
+    def __call__(self, src):
+        if np.random.rand() < self.p:
+            img = src.asnumpy().astype(np.float32)
+            gray = (img[..., :3] * _LUMA).sum(axis=-1, keepdims=True)
+            return array(np.broadcast_to(
+                gray, gray.shape[:-1] + (3,)).copy())
+        return src
+
+
+# ImageNet RGB covariance eigen-decomposition used by the reference's
+# pca_noise path (image.py CreateAugmenter)
+_PCA_EIGVAL = np.array([55.46, 4.794, 1.148], np.float32)
+_PCA_EIGVEC = np.array([[-0.5675, 0.7192, 0.4009],
+                        [-0.5808, -0.0045, -0.8140],
+                        [-0.5836, -0.6948, 0.4203]], np.float32)
+
+
+def color_jitter_auglist(brightness=0, contrast=0, saturation=0, hue=0,
+                         pca_noise=0, rand_gray=0):
+    """The pixel-value augmenter sub-list shared by CreateAugmenter and
+    CreateDetAugmenter (color stages are bbox-independent)."""
+    auglist = []
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        auglist.append(LightingAug(pca_noise, _PCA_EIGVAL, _PCA_EIGVEC))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
+    return auglist
+
+
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None, brightness=0,
                     contrast=0, saturation=0, hue=0, pca_noise=0,
@@ -244,6 +404,8 @@ def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
     if rand_mirror:
         auglist.append(HorizontalFlipAug(0.5))
     auglist.append(CastAug())
+    auglist.extend(color_jitter_auglist(brightness, contrast, saturation,
+                                        hue, pca_noise, rand_gray))
     if mean is True:
         mean = np.array([123.68, 116.28, 103.53])
     if std is True:

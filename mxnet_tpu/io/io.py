@@ -309,9 +309,21 @@ class PrefetchingIter(DataIter):
         items = [w.get() for w in self._workers]
         n_ended = len([x for x in items if x is _EPOCH_END])
         if n_ended:
+            if n_ended != self.n_iter:
+                # abort the still-mid-epoch workers (draining their
+                # sentinels) BEFORE closing the epoch: once _epoch_open
+                # is False, reset()/close() skip abort_epoch and a
+                # worker with a full queue would spin in _publish
+                # forever (ADVICE r3). Workers that already returned
+                # _EPOCH_END must NOT be aborted — their sentinel is
+                # consumed and abort_epoch would block on the next one.
+                for w, x in zip(self._workers, items):
+                    if x is not _EPOCH_END:
+                        w.abort_epoch()
+                self._epoch_open = False
+                raise MXNetError(
+                    "Source iterators disagree on epoch length")
             self._epoch_open = False
-            assert n_ended == self.n_iter, \
-                "Source iterators disagree on epoch length"
             return False
         data, label = [], []
         for batch in items:

@@ -132,6 +132,10 @@ def load_binary(fname):
 
 
 def _write_record(out, arr):
+    # capture the shape BEFORE ascontiguousarray: it promotes 0-d to
+    # (1,) (its ndmin=1), which would silently change a scalar's shape
+    # on round-trip (ADVICE r3)
+    shape = np.asarray(arr).shape
     arr = np.ascontiguousarray(arr)
     flag = _DTYPE_TO_FLAG.get(arr.dtype)
     if flag is None:
@@ -139,8 +143,8 @@ def _write_record(out, arr):
                          "cast before saving" % arr.dtype)
     out.append(struct.pack("<I", V2_MAGIC))
     out.append(struct.pack("<i", 0))                      # dense stype
-    out.append(struct.pack("<i", arr.ndim))
-    out.append(struct.pack("<%dq" % arr.ndim, *arr.shape))
+    out.append(struct.pack("<i", len(shape)))
+    out.append(struct.pack("<%dq" % len(shape), *shape))
     out.append(struct.pack("<ii", 1, 0))                  # cpu(0)
     out.append(struct.pack("<i", flag))
     out.append(arr.tobytes())

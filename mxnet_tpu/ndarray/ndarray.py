@@ -118,12 +118,19 @@ class NDArray:
         _engine.get().wait_for_var(self._data)
         return np.asarray(self._data)
 
-    def __array__(self, dtype=None):
+    def __array__(self, dtype=None, copy=None):
         # one device fetch for np.asarray(nd_arr) — without this numpy
         # falls back to the sequence protocol (one eager __getitem__
-        # dispatch per row: thousands of device round-trips)
+        # dispatch per row: thousands of device round-trips).  The
+        # numpy>=2.0 `copy` keyword: the fetch always materializes a
+        # fresh host buffer, so copy=False is satisfiable and
+        # copy=True just copies once more.
         out = self.asnumpy()
-        return out.astype(dtype) if dtype is not None else out
+        if dtype is not None:
+            out = out.astype(dtype, copy=False)
+        if copy:
+            out = out.copy()
+        return out
 
     def asscalar(self):
         return self.asnumpy().item()

@@ -185,3 +185,26 @@ def test_draw_next(det_rec):
             break
     assert len(imgs) == 3
     assert imgs[0].shape == (64, 64, 3) and imgs[0].dtype == np.uint8
+
+
+def test_det_augmenter_color_jitter_wired(det_rec):
+    """brightness/contrast/saturation/hue/pca_noise/rand_gray must
+    actually mutate pixels (ADVICE r3: they were silently dropped)."""
+    from mxnet_tpu import image as img_mod
+
+    augs = det.CreateDetAugmenter((3, 32, 32), brightness=0.5,
+                                  contrast=0.5, saturation=0.5, hue=0.3,
+                                  pca_noise=0.1, rand_gray=1.0)
+    kinds = {type(a.augmenter).__name__ for a in augs
+             if isinstance(a, det.DetBorrowAug)}
+    assert {"ColorJitterAug", "HueJitterAug", "LightingAug",
+            "RandomGrayAug"} <= kinds
+
+    it = det.ImageDetIter(batch_size=4, data_shape=(3, 32, 32),
+                          path_imgrec=det_rec, brightness=0.4,
+                          rand_gray=1.0)
+    b = next(iter(it))
+    d = b.data[0].asnumpy()
+    # rand_gray=1.0 -> all three channels equal everywhere
+    np.testing.assert_allclose(d[:, 0], d[:, 1], rtol=1e-4, atol=1e-3)
+    np.testing.assert_allclose(d[:, 1], d[:, 2], rtol=1e-4, atol=1e-3)

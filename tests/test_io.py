@@ -216,3 +216,51 @@ def test_im2rec_roundtrip(tmp_path):
     batch = next(iter(it))
     assert batch.data[0].shape == (2, 3, 32, 32)
     assert set(np.unique(batch.label[0].asnumpy())) <= {0.0, 1.0}
+
+
+def test_color_jitter_augmenters_math():
+    """Numeric semantics of the r4 color augmenter family (reference
+    image.py BrightnessJitterAug etc.)."""
+    from mxnet_tpu.image import image as im
+    from mxnet_tpu import nd
+
+    src = nd.array(np.random.uniform(0, 255, (8, 8, 3)).astype(np.float32))
+    s = src.asnumpy()
+
+    np.random.seed(3)
+    out = im.BrightnessJitterAug(0.5)(src).asnumpy()
+    np.random.seed(3)
+    alpha = 1.0 + np.random.uniform(-0.5, 0.5)
+    np.testing.assert_allclose(out, s * alpha, rtol=1e-5)
+
+    np.random.seed(4)
+    out = im.SaturationJitterAug(0.5)(src).asnumpy()
+    np.random.seed(4)
+    alpha = 1.0 + np.random.uniform(-0.5, 0.5)
+    gray = (s * [0.299, 0.587, 0.114]).sum(-1, keepdims=True)
+    np.testing.assert_allclose(out, s * alpha + gray * (1 - alpha),
+                               rtol=1e-4)
+
+    # hue rotation preserves luma (Y of YIQ) exactly
+    out = im.HueJitterAug(0.5)(src).asnumpy()
+    luma_in = (s * [0.299, 0.587, 0.114]).sum(-1)
+    luma_out = (out * [0.299, 0.587, 0.114]).sum(-1)
+    np.testing.assert_allclose(luma_in, luma_out, rtol=1e-3, atol=1e-2)
+    assert not np.allclose(out, s)  # chroma actually rotated
+
+    # lighting noise shifts each pixel by one per-image rgb offset
+    out = im.LightingAug(0.5, im._PCA_EIGVAL, im._PCA_EIGVEC)(src).asnumpy()
+    shift = out - s
+    np.testing.assert_allclose(
+        shift, np.broadcast_to(shift[0, 0], shift.shape), rtol=1e-4,
+        atol=1e-4)
+
+    out = im.RandomGrayAug(1.0)(src).asnumpy()
+    np.testing.assert_allclose(out[..., 0], out[..., 1], rtol=1e-5)
+
+    # CreateAugmenter wires them (they were silently dropped pre-r4)
+    augs = im.CreateAugmenter((3, 8, 8), brightness=0.1, hue=0.1,
+                              pca_noise=0.05, rand_gray=0.2)
+    names = {type(a).__name__ for a in augs}
+    assert {"ColorJitterAug", "HueJitterAug", "LightingAug",
+            "RandomGrayAug"} <= names
