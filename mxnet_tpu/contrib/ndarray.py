@@ -29,3 +29,30 @@ from ..ops.control_flow import foreach, while_loop, cond  # noqa: F401,E402
 from ..ops.dgl_graph import (  # noqa: F401,E402
     dgl_csr_neighbor_uniform_sample, dgl_csr_neighbor_non_uniform_sample,
     dgl_subgraph, dgl_graph_compact, dgl_adjacency, edge_id)
+
+
+def rand_zipfian(true_classes, num_sampled, range_max, ctx=None):
+    """Draw with-replacement samples from the approximately log-uniform
+    (Zipfian) distribution P(k) = (log(k+2)-log(k+1))/log(range_max+1),
+    and the expected counts of the true and sampled classes (reference:
+    python/mxnet/ndarray/contrib.py:36 rand_zipfian — used for sampled
+    softmax)."""
+    import math
+
+    import numpy as np
+
+    from ..ndarray.ndarray import array, _as_nd
+
+    log_range = math.log(range_max + 1)
+    u = np.random.random_sample(num_sampled) * log_range
+    sampled = (np.exp(u).astype(np.int64) - 1) % range_max
+
+    true_np = _as_nd(true_classes).asnumpy().astype(np.float64)
+    exp_true = np.log((true_np + 2.0) / (true_np + 1.0)) \
+        / log_range * num_sampled
+    s64 = sampled.astype(np.float64)
+    exp_sampled = np.log((s64 + 2.0) / (s64 + 1.0)) \
+        / log_range * num_sampled
+    return (array(sampled.astype(np.int32)),
+            array(exp_true.astype(np.float32)),
+            array(exp_sampled.astype(np.float32)))

@@ -16,6 +16,7 @@ from ..base import MXNetError
 from ..ndarray.ndarray import NDArray, array
 
 __all__ = ["imdecode", "imdecode_np", "imencode", "imread", "imresize",
+           "copyMakeBorder",
            "resize_short", "fixed_crop", "center_crop", "random_crop",
            "random_size_crop", "color_normalize", "CreateAugmenter",
            "Augmenter", "ResizeAug", "ForceResizeAug", "RandomCropAug",
@@ -91,6 +92,25 @@ def imresize(src, w, h, interp=1):
                                  _PILImage.NEAREST))
     if out.ndim == 2:
         out = out[..., None]
+    return array(out)
+
+
+def copyMakeBorder(src, top, bot, left, right, border_type=0, value=0.0):
+    """Pad an HWC image with a border (parity: the reference's
+    ``_cvcopyMakeBorder`` op, src/io/image_io.cc).  border_type follows
+    the OpenCV enum: 0=constant(value), 1=replicate, 2=reflect,
+    3=wrap, 4=reflect-101."""
+    img = src.asnumpy() if isinstance(src, NDArray) else np.asarray(src)
+    pad = ((top, bot), (left, right)) + ((0, 0),) * (img.ndim - 2)
+    modes = {0: "constant", 1: "edge", 2: "symmetric", 3: "wrap",
+             4: "reflect"}
+    if border_type not in modes:
+        raise MXNetError("copyMakeBorder: unknown border_type %r"
+                         % (border_type,))
+    if border_type == 0:
+        out = np.pad(img, pad, mode="constant", constant_values=value)
+    else:
+        out = np.pad(img, pad, mode=modes[border_type])
     return array(out)
 
 

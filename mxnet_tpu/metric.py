@@ -362,6 +362,60 @@ class PearsonCorrelation(EvalMetric):
             self.num_inst += 1
 
 
+@register("pcc")
+class PCC(EvalMetric):
+    """Multiclass Matthews/Pearson correlation from a growing KxK
+    confusion matrix (reference: python/mxnet/metric.py:1480 PCC).
+
+    For K=2 this equals MCC; for K>2 the minimum is distribution-
+    dependent in (-1, 0] while the maximum stays +1."""
+
+    def __init__(self, name="pcc", output_names=None, label_names=None):
+        self.k = 2
+        super().__init__(name, output_names, label_names)
+
+    def reset(self):
+        self.num_inst = 0
+        self.sum_metric = 0.0
+        self.lcm = numpy.zeros((self.k, self.k))
+
+    def _grow(self, inc):
+        self.lcm = numpy.pad(self.lcm, ((0, inc), (0, inc)), "constant")
+        self.k += inc
+
+    @staticmethod
+    def _calc_mcc(cmat):
+        n = cmat.sum()
+        x = cmat.sum(axis=1)
+        y = cmat.sum(axis=0)
+        cov_xx = numpy.sum(x * (n - x))
+        cov_yy = numpy.sum(y * (n - y))
+        if cov_xx == 0 or cov_yy == 0:
+            return float("nan")
+        i = cmat.diagonal()
+        cov_xy = numpy.sum(i * n - x * y)
+        return cov_xy / (cov_xx * cov_yy) ** 0.5
+
+    def update(self, labels, preds):
+        labels, preds = check_label_shapes(labels, preds, True)
+        for label, pred in zip(labels, preds):
+            pred_np = _as_np(pred)
+            if pred_np.ndim > 1:
+                pred_np = numpy.argmax(pred_np, axis=-1)
+            pred_np = pred_np.astype("int32").reshape(-1)
+            label_np = _as_np(label).astype("int32").reshape(-1)
+            n = int(max(pred_np.max(), label_np.max())) + 1
+            if n > self.k:
+                self._grow(n - self.k)
+            numpy.add.at(self.lcm, (label_np, pred_np), 1)
+            self.num_inst += pred_np.shape[0]
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, self._calc_mcc(self.lcm))
+
+
 @register("loss")
 class Loss(EvalMetric):
     def __init__(self, name="loss", output_names=None, label_names=None):

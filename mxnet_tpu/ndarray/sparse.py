@@ -360,3 +360,40 @@ def dot(lhs, rhs, transpose_a=False, transpose_b=False):
     dr = rhs.tostype("default") if isinstance(rhs, BaseSparseNDArray) else rhs
     return _nd._invoke_nd("dot", [dl, dr], {"transpose_a": transpose_a,
                                             "transpose_b": transpose_b})
+
+
+def scatter_op(name, arr, other=None, scalar=None):
+    """Storage-preserving scatter arithmetic (reference
+    elemwise_scatter_op.cc): apply the op to the STORED values of a
+    sparse array only, keeping its indices/indptr — the semantics the
+    reference's sparse optimizers rely on (missing rows stay implicit
+    zero even for ops like +scalar that would densify).
+
+    name in {'plus_scalar', 'minus_scalar', 'elemwise_div'};
+    dense inputs fall through to the plain op."""
+    from .ndarray import NDArray
+
+    if name not in ("plus_scalar", "minus_scalar", "elemwise_div"):
+        raise MXNetError("scatter_op: unknown op %r" % (name,))
+    if not isinstance(arr, BaseSparseNDArray):
+        if name == "plus_scalar":
+            return arr + scalar
+        if name == "minus_scalar":
+            return arr - scalar
+        return arr / other
+    if name == "elemwise_div":
+        # rhs is indexed at lhs's stored locations only
+        if isinstance(arr, RowSparseNDArray):
+            rows = arr.indices._data.astype("int32")
+            denom = (other.tostype("default")
+                     if isinstance(other, BaseSparseNDArray)
+                     else other)._data[rows]
+            return RowSparseNDArray(NDArray(arr.data._data / denom),
+                                    arr.indices, arr.shape)
+        raise MXNetError("scatter_elemwise_div: CSR lhs not supported")
+    delta = scalar if name == "plus_scalar" else -scalar
+    if isinstance(arr, RowSparseNDArray):
+        return RowSparseNDArray(NDArray(arr.data._data + delta),
+                                arr.indices, arr.shape)
+    return CSRNDArray(NDArray(arr.data._data + delta), arr.indices,
+                      arr.indptr, arr.shape)

@@ -120,3 +120,49 @@ def _sample_normal(mu, sigma, shape=None, dtype="float32", **kw):
     exm = mu.reshape(mu.shape + (1,) * len(s or ())) if s else mu
     exs = sigma.reshape(sigma.shape + (1,) * len(s or ())) if s else sigma
     return exm + z * exs
+
+
+@register("_sample_unique_zipfian", uses_rng=True, num_inputs=0,
+          num_outputs=2, differentiable=False)
+def _sample_unique_zipfian(range_max=None, shape=None, **kw):
+    """Batched without-replacement log-uniform (Zipfian) candidate
+    sampler (reference: src/operator/random/unique_sample_op.cc).
+
+    Returns (samples int64 (B, N), num_tries int64 (B,)) where samples
+    follow P(k) = (log(k+2)-log(k+1))/log(range_max+1) and num_tries is
+    the rejection count — used to derive sampled-softmax expectations.
+    (The reference C++ kernel's lround/log(range_max) variant is
+    inconsistent with this documented distribution — and with its own
+    python rand_zipfian, ndarray/contrib.py:89 — so the self-consistent
+    floor/log(range_max+1) form is used here.)
+
+    TPU-native stance: the trip count is data-dependent (rejection until
+    N unique), so this runs host-side like every other graph-preparation
+    op; the reference likewise registers a CPU-only kernel."""
+    import numpy as np
+
+    s = ptuple(shape)
+    if s is None or len(s) != 2:
+        raise ValueError("_sample_unique_zipfian needs a 2-D shape, got %r"
+                         % (s,))
+    b, n = s
+    rmax = pint(range_max, 0)
+    if n > rmax:
+        raise ValueError("cannot draw %d unique samples from %d classes"
+                         % (n, rmax))
+    seed = int(jax.random.randint(_random.next_key(), (), 0, 2 ** 31 - 1))
+    rng = np.random.RandomState(seed)
+    log_rm1 = np.log(rmax + 1.0)
+    samples = np.empty((b, n), np.int64)
+    tries = np.empty((b,), np.int64)
+    for i in range(b):
+        seen = set()
+        t = 0
+        while len(seen) < n:
+            v = (int(np.exp(rng.random_sample() * log_rm1)) - 1) % rmax
+            t += 1
+            if v not in seen:
+                samples[i, len(seen)] = v
+                seen.add(v)
+        tries[i] = t
+    return jnp.asarray(samples), jnp.asarray(tries)

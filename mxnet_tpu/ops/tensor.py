@@ -799,3 +799,29 @@ def _khatri_rao(*mats, **kw):
     for m in mats[1:]:
         out = jnp.einsum("ij,kj->ikj", out, m).reshape(-1, out.shape[1])
     return out
+
+
+# ---------------------------------------------------------------------------
+# scatter arithmetic (reference: src/operator/tensor/elemwise_scatter_op.cc)
+# The reference versions exist so sparse-storage optimizers can apply
+# scalar/elementwise arithmetic to a row-sparse input's STORED values
+# without densifying.  On dense inputs (this registry's calling
+# convention) they are numerically the plain ops; the storage-preserving
+# fast path for RowSparse/CSR NDArrays lives in
+# ndarray.sparse.scatter_op (used by the eager nd surface).
+# ---------------------------------------------------------------------------
+
+
+@register("_scatter_elemwise_div", num_inputs=2)
+def _scatter_elemwise_div(lhs, rhs, **kw):
+    return lhs / rhs
+
+
+@register("_scatter_plus_scalar")
+def _scatter_plus_scalar(data, scalar=0.0, **kw):
+    return data + pfloat(scalar, 0.0)
+
+
+@register("_scatter_minus_scalar")
+def _scatter_minus_scalar(data, scalar=0.0, **kw):
+    return data - pfloat(scalar, 0.0)

@@ -264,3 +264,16 @@ def test_color_jitter_augmenters_math():
     names = {type(a).__name__ for a in augs}
     assert {"ColorJitterAug", "HueJitterAug", "LightingAug",
             "RandomGrayAug"} <= names
+
+
+def test_copy_make_border():
+    from mxnet_tpu.image import image as im
+
+    img = np.arange(12, dtype=np.uint8).reshape(2, 2, 3)
+    out = im.copyMakeBorder(img, 1, 1, 2, 2, border_type=0,
+                            value=7).asnumpy()
+    assert out.shape == (4, 6, 3)
+    assert (out[0] == 7).all() and (out[:, 0] == 7).all()
+    np.testing.assert_array_equal(out[1:3, 2:4], img)
+    rep = im.copyMakeBorder(img, 1, 0, 0, 0, border_type=1).asnumpy()
+    np.testing.assert_array_equal(rep[0], img[0])

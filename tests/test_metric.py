@@ -77,3 +77,30 @@ def test_loss_metric():
     m = mx.metric.Loss()
     m.update(None, [nd.array([1.0, 2.0, 3.0])])
     assert abs(m.get()[1] - 2.0) < 1e-6
+
+
+def test_pcc():
+    """PCC (reference metric.py:1480): reproduces the docstring value,
+    equals MCC for K=2, and handles multiclass with a growing matrix."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    fp, fn_, tp, tn = 1000, 1, 10000, 1
+    preds = [nd.array(np.array(
+        [[.3, .7]] * fp + [[.7, .3]] * tn + [[.7, .3]] * fn_
+        + [[.3, .7]] * tp, np.float32))]
+    labels = [nd.array(np.array([0] * (fp + tn) + [1] * (fn_ + tp),
+                                np.float32))]
+    pcc = mx.metric.create("pcc")
+    pcc.update(labels=labels, preds=preds)
+    assert abs(pcc.get()[1] - 0.01917751877733392) < 1e-10
+    mcc = mx.metric.MCC()
+    mcc.update(labels=labels, preds=preds)
+    assert abs(mcc.get()[1] - pcc.get()[1]) < 1e-9
+    # multiclass: grows past k=2, perfect prediction -> 1.0
+    pcc.reset()
+    lab = nd.array(np.array([0, 1, 2, 3, 2, 1], np.float32))
+    pred = nd.array(np.eye(4, dtype=np.float32)[
+        np.array([0, 1, 2, 3, 2, 1])])
+    pcc.update(labels=[lab], preds=[pred])
+    assert abs(pcc.get()[1] - 1.0) < 1e-12

@@ -60,3 +60,42 @@ def test_nd_sample_ops():
     sig = nd.array([[1.0], [1.0]])
     s = nd._sample_normal(mu, sig, shape=(100,)).asnumpy()
     assert s.shape == (2, 1, 100)
+
+
+def test_sample_unique_zipfian():
+    """Without-replacement log-uniform candidate sampler (reference
+    unique_sample_op.cc): unique per row, in-range, small-id skewed."""
+    from mxnet_tpu import nd
+
+    s, t = nd._sample_unique_zipfian(range_max=1000, shape=(3, 40))
+    sn, tn = s.asnumpy(), t.asnumpy()
+    assert sn.shape == (3, 40) and tn.shape == (3,)
+    # reference emits int64; without jax x64 the stack stores int32
+    assert sn.dtype in (np.int32, np.int64)
+    assert tn.dtype in (np.int32, np.int64)
+    for row in sn:
+        assert len(set(row.tolist())) == 40
+    assert sn.min() >= 0 and sn.max() < 1000
+    assert (tn >= 40).all()
+    s2, _ = nd._sample_unique_zipfian(range_max=100000, shape=(1, 2000))
+    assert np.median(s2.asnumpy()) < 20000  # log-uniform skew
+
+
+def test_rand_zipfian():
+    """mx.nd.contrib.rand_zipfian (reference ndarray/contrib.py:36):
+    in-range samples + correct expected-count formula."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    true_cls = nd.array(np.array([1.0, 5.0], np.float32))
+    s, exp_true, exp_s = mx.nd.contrib.rand_zipfian(true_cls, 400, 50)
+    sn = s.asnumpy()
+    assert sn.shape == (400,) and sn.min() >= 0 and sn.max() < 50
+    want = np.log(3.0 / 2.0) / np.log(51.0) * 400
+    np.testing.assert_allclose(exp_true.asnumpy()[0], want, rtol=1e-5)
+    ps = exp_s.asnumpy() / 400.0
+    np.testing.assert_allclose(
+        ps, np.log((sn + 2.0) / (sn + 1.0)) / np.log(51.0), rtol=1e-5)
+    # class 0 is the most likely: ~log(2)/log(51) of draws
+    p0 = (sn == 0).mean()
+    assert 0.05 < p0 < 0.35

@@ -200,3 +200,36 @@ def test_csr_dot_vector_rhs():
     v = mx.nd.array(np.array([1.0, 2.0, 3.0], np.float32))
     out = sp.dot(csr, v)
     np.testing.assert_allclose(out.asnumpy(), dense @ v.asnumpy())
+
+
+def test_scatter_ops_storage_preserving():
+    """_scatter_plus/minus_scalar and _scatter_elemwise_div (reference
+    elemwise_scatter_op.cc): dense fallback equals the plain op; sparse
+    path touches only stored values and keeps storage."""
+    from mxnet_tpu import nd
+    from mxnet_tpu.ndarray import sparse
+
+    a = nd.array(np.array([[1., 2.], [3., 4.]], np.float32))
+    np.testing.assert_allclose(
+        nd._scatter_plus_scalar(a, scalar=2.0).asnumpy(),
+        a.asnumpy() + 2.0)
+    np.testing.assert_allclose(
+        nd._scatter_minus_scalar(a, scalar=1.0).asnumpy(),
+        a.asnumpy() - 1.0)
+    b = nd.array(np.full((2, 2), 2.0, np.float32))
+    np.testing.assert_allclose(
+        nd._scatter_elemwise_div(a, b).asnumpy(), a.asnumpy() / 2.0)
+
+    rsp = sparse.row_sparse_array(
+        (np.array([[1., 1.], [2., 2.]], np.float32), np.array([0, 2])),
+        shape=(4, 2))
+    out = sparse.scatter_op("plus_scalar", rsp, scalar=5.0)
+    assert isinstance(out, sparse.RowSparseNDArray)
+    assert out.indices.asnumpy().tolist() == [0, 2]
+    dense = out.tostype("default").asnumpy()
+    assert dense[1].sum() == 0 and dense[3].sum() == 0  # rows stay zero
+    np.testing.assert_allclose(dense[0], [6., 6.])
+    den = nd.array(np.full((4, 2), 2., np.float32))
+    out2 = sparse.scatter_op("elemwise_div", rsp, other=den)
+    np.testing.assert_allclose(out2.data.asnumpy(),
+                               [[0.5, 0.5], [1., 1.]])
