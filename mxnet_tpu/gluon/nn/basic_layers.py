@@ -226,8 +226,13 @@ class BatchNorm(HybridBlock):
         use_global = self._kwargs["use_global_stats"] or not training
         out = F.BatchNorm(x, gamma, beta, running_mean, running_var,
                           **dict(self._kwargs, use_global_stats=use_global))
-        y, mean, var = out[0], out[1], out[2]
+        y = out[0]
         if training and not self._kwargs["use_global_stats"]:
+            # mean/var exist past index 0 only on the eager/traced path;
+            # a symbolic BatchNorm has one visible output (reference
+            # FNumVisibleOutputs) and its aux updates happen in the
+            # executor, never here
+            mean, var = out[1], out[2]
             m = self._momentum
             new_mean = m * running_mean + (1 - m) * mean
             new_var = m * running_var + (1 - m) * var

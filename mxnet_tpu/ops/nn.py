@@ -244,7 +244,13 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
     red = tuple(i for i in range(data.ndim) if i != ax)
     shape = [1] * data.ndim
     shape[ax] = data.shape[ax]
-    if pbool(use_global_stats):
+    # reference semantics (batch_norm.cc): moving stats whenever NOT
+    # training, not only when use_global_stats is set — an executor
+    # forward(is_train=False) on a default-attrs BatchNorm must
+    # normalize with the running averages
+    from .. import autograd
+
+    if pbool(use_global_stats) or not autograd.is_training():
         mean, var = moving_mean, moving_var
     else:
         mean = jnp.mean(data, axis=red)

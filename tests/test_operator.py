@@ -133,18 +133,32 @@ def test_pooling():
 
 
 def test_batchnorm():
+    from mxnet_tpu import autograd
+
     x = np.random.rand(4, 3, 5, 5).astype(np.float32)
     gamma = np.random.rand(3).astype(np.float32)
     beta = np.random.rand(3).astype(np.float32)
-    out, mean, var = nd.BatchNorm(nd.array(x), nd.array(gamma),
-                                  nd.array(beta), nd.zeros(3), nd.ones(3),
-                                  fix_gamma=False, eps=1e-5)
+    # training mode: batch statistics (reference batch_norm.cc)
+    with autograd.record():
+        out, mean, var = nd.BatchNorm(
+            nd.array(x), nd.array(gamma), nd.array(beta), nd.zeros(3),
+            nd.ones(3), fix_gamma=False, eps=1e-5)
     m = x.mean(axis=(0, 2, 3))
     v = x.var(axis=(0, 2, 3))
     ref = (x - m[None, :, None, None]) / np.sqrt(v + 1e-5)[None, :, None, None]
     ref = ref * gamma[None, :, None, None] + beta[None, :, None, None]
     assert_almost_equal(out, ref, rtol=1e-3, atol=1e-4)
     assert_almost_equal(mean, m, rtol=1e-4, atol=1e-5)
+    # inference mode (no record): moving statistics, r4 parity fix
+    mm = np.random.rand(3).astype(np.float32)
+    mv = np.random.rand(3).astype(np.float32) + 0.5
+    out_i, _, _ = nd.BatchNorm(
+        nd.array(x), nd.array(gamma), nd.array(beta), nd.array(mm),
+        nd.array(mv), fix_gamma=False, eps=1e-5)
+    ref_i = (x - mm[None, :, None, None]) \
+        / np.sqrt(mv + 1e-5)[None, :, None, None]
+    ref_i = ref_i * gamma[None, :, None, None] + beta[None, :, None, None]
+    assert_almost_equal(out_i, ref_i, rtol=1e-3, atol=1e-4)
 
 
 def test_layernorm():
