@@ -182,15 +182,29 @@ class ShardedTrainer:
                 return NamedSharding(self.mesh, spec)
         return NamedSharding(self.mesh, P())  # replicated
 
+    @staticmethod
+    def _global_put(jax, arr, sh):
+        """Place host data onto a (possibly multi-process) sharding.
+
+        Single-process: plain device_put.  Multi-process (jax.distributed
+        over DCN, SURVEY §2.3): device_put cannot target non-addressable
+        devices, so build a global Array from this process's local block
+        — for a dp-across-hosts batch axis that block is the per-worker
+        batch shard, exactly the reference's per-worker data loading."""
+        if jax.process_count() == 1:
+            return jax.device_put(arr, sh)
+        return jax.make_array_from_process_local_data(
+            sh, np.asarray(arr))
+
     def _shard_params(self, jax, NamedSharding, P):
         new_arrays = []
         for p, arr in zip(self._params, self.param_arrays):
             sh = self._param_sharding(P, NamedSharding, p, arr)
-            new_arrays.append(jax.device_put(arr, sh))
+            new_arrays.append(self._global_put(jax, arr, sh))
         self.param_arrays = new_arrays
         self.opt_state = jax.tree_util.tree_map(
-            lambda a: jax.device_put(
-                a, NamedSharding(self.mesh, P())), self.opt_state)
+            lambda a: self._global_put(
+                jax, a, NamedSharding(self.mesh, P())), self.opt_state)
 
     def _batch_sharding(self):
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -200,14 +214,18 @@ class ShardedTrainer:
         return NamedSharding(self.mesh, P(self._batch_spec))
 
     def shard_batch(self, *arrays):
-        """Place per-host batch arrays onto the mesh (dp-sharded)."""
+        """Place per-host batch arrays onto the mesh (dp-sharded).
+
+        Under multi-process jax.distributed, pass this process's LOCAL
+        batch shard (global batch = concat over workers in rank order)."""
         import jax
 
         sh = self._batch_sharding()
         out = []
         for a in arrays:
             raw = a._data if isinstance(a, NDArray) else a
-            out.append(jax.device_put(raw, sh) if sh is not None else raw)
+            out.append(self._global_put(jax, raw, sh)
+                       if sh is not None else raw)
         return out
 
     # -- the compiled step ----------------------------------------------
@@ -327,9 +345,26 @@ class ShardedTrainer:
 
     def sync_to_net(self):
         """Write the pytree back into the gluon Parameters (gathered to a
-        single addressable array so eager use works)."""
+        single addressable array so eager use works).
+
+        Under multi-process jax.distributed this is a COLLECTIVE call
+        (every process must call it): sharded params are re-replicated
+        through a jitted identity before the host fetch, since a global
+        Array spanning non-addressable devices cannot be np.asarray'd."""
+        import jax
         import jax.numpy as jnp
 
+        replicate = None
+        if jax.process_count() > 1 and self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            replicate = jax.jit(
+                lambda a: a,
+                out_shardings=NamedSharding(self.mesh, P()))
+
         for p, arr in zip(self._params, self.param_arrays):
+            if replicate is not None and hasattr(arr, "is_fully_replicated") \
+                    and not arr.is_fully_replicated:
+                arr = replicate(arr)
             host = np.asarray(arr)
             p.data()._rebind(jnp.asarray(host))
