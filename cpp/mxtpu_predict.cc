@@ -1,0 +1,322 @@
+// C predict ABI implementation (see mxtpu_predict.h).
+//
+// Reference parity: src/c_api/c_predict_api.cc.  The predictor is a
+// forked `python -m mxnet_tpu.predict_worker` driven over two pipes
+// with a length-prefixed binary protocol (documented in that module).
+// Rationale for a worker process over embedded CPython: no libpython
+// link/version coupling for the host app, crash isolation, and the
+// per-call IPC (<1ms) is noise next to the XLA compute it triggers.
+
+#include "mxtpu_predict.h"
+
+#include <errno.h>
+#include <signal.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+struct Predictor {
+  pid_t pid = -1;
+  int to_worker = -1;    // write end
+  int from_worker = -1;  // read end
+  std::vector<std::vector<uint32_t>> output_shapes;
+};
+
+// A dead worker must surface as EPIPE/-1, not kill the host app with
+// SIGPIPE: block the signal on this thread for the write's duration
+// and consume any pending instance.
+class ScopedSigpipeBlock {
+ public:
+  ScopedSigpipeBlock() {
+    sigemptyset(&set_);
+    sigaddset(&set_, SIGPIPE);
+    blocked_ = pthread_sigmask(SIG_BLOCK, &set_, &old_) == 0;
+  }
+  ~ScopedSigpipeBlock() {
+    if (!blocked_) return;
+    struct timespec zero = {0, 0};
+    while (sigtimedwait(&set_, nullptr, &zero) > 0) {
+    }
+    pthread_sigmask(SIG_SETMASK, &old_, nullptr);
+  }
+
+ private:
+  sigset_t set_, old_;
+  bool blocked_ = false;
+};
+
+bool write_all(int fd, const void *buf, size_t n) {
+  ScopedSigpipeBlock guard;
+  const char *p = static_cast<const char *>(buf);
+  while (n) {
+    ssize_t w = write(fd, p, n);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+bool read_all(int fd, void *buf, size_t n) {
+  char *p = static_cast<char *>(buf);
+  while (n) {
+    ssize_t r = read(fd, p, n);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    if (r == 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+// request = u8 opcode | u64 len | payload ; response = u8 status | u64
+// len | payload.  Returns false (with g_last_error set) on transport or
+// worker-reported error.
+bool roundtrip(Predictor *p, uint8_t opcode, const std::string &payload,
+               std::string *reply) {
+  char head[9];
+  head[0] = static_cast<char>(opcode);
+  uint64_t len = payload.size();
+  memcpy(head + 1, &len, 8);
+  if (!write_all(p->to_worker, head, 9) ||
+      (!payload.empty() &&
+       !write_all(p->to_worker, payload.data(), payload.size()))) {
+    g_last_error = "predict worker pipe write failed";
+    return false;
+  }
+  char rhead[9];
+  if (!read_all(p->from_worker, rhead, 9)) {
+    g_last_error = "predict worker died (pipe closed)";
+    return false;
+  }
+  uint8_t status = static_cast<uint8_t>(rhead[0]);
+  uint64_t rlen;
+  memcpy(&rlen, rhead + 1, 8);
+  if (rlen > (1ull << 33)) {  // corrupted frame, not a real reply
+    g_last_error = "predict worker protocol corrupt (reply length)";
+    return false;
+  }
+  std::string body(rlen, '\0');
+  if (rlen && !read_all(p->from_worker, &body[0], rlen)) {
+    g_last_error = "predict worker reply truncated";
+    return false;
+  }
+  if (status != 0) {
+    g_last_error = "predict worker error: " + body;
+    return false;
+  }
+  if (reply) *reply = std::move(body);
+  return true;
+}
+
+void append_u32(std::string *s, uint32_t v) {
+  s->append(reinterpret_cast<const char *>(&v), 4);
+}
+void append_u64(std::string *s, uint64_t v) {
+  s->append(reinterpret_cast<const char *>(&v), 8);
+}
+
+bool spawn_worker(Predictor *p) {
+  int in_pipe[2], out_pipe[2];
+  if (pipe(in_pipe) != 0) {
+    g_last_error = "pipe() failed";
+    return false;
+  }
+  if (pipe(out_pipe) != 0) {
+    g_last_error = "pipe() failed";
+    close(in_pipe[0]);
+    close(in_pipe[1]);
+    return false;
+  }
+  pid_t pid = fork();
+  if (pid < 0) {
+    g_last_error = "fork() failed";
+    close(in_pipe[0]);
+    close(in_pipe[1]);
+    close(out_pipe[0]);
+    close(out_pipe[1]);
+    return false;
+  }
+  if (pid == 0) {  // child: stdin <- in_pipe, stdout -> out_pipe
+    dup2(in_pipe[0], 0);
+    dup2(out_pipe[1], 1);
+    close(in_pipe[0]);
+    close(in_pipe[1]);
+    close(out_pipe[0]);
+    close(out_pipe[1]);
+    const char *py = getenv("MXTPU_PYTHON");
+    if (!py) py = "python3";
+    execlp(py, py, "-m", "mxnet_tpu.predict_worker",
+           static_cast<char *>(nullptr));
+    perror("execlp mxnet_tpu.predict_worker");
+    _exit(127);
+  }
+  close(in_pipe[0]);
+  close(out_pipe[1]);
+  p->pid = pid;
+  p->to_worker = in_pipe[1];
+  p->from_worker = out_pipe[0];
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char *mxtpu_predict_last_error(void) { return g_last_error.c_str(); }
+
+int mxtpu_predict_create(const char *symbol_json, const void *param_bytes,
+                         size_t param_len, uint32_t num_input_nodes,
+                         const char **input_keys,
+                         const uint32_t *input_shape_indptr,
+                         const uint32_t *input_shape_data,
+                         MXTPUPredictorHandle *out) {
+  Predictor *p = new Predictor();
+  if (!spawn_worker(p)) {
+    delete p;
+    return -1;
+  }
+  std::string payload;
+  uint64_t jlen = strlen(symbol_json);
+  append_u64(&payload, jlen);
+  payload.append(symbol_json, jlen);
+  append_u64(&payload, param_len);
+  payload.append(static_cast<const char *>(param_bytes), param_len);
+  append_u32(&payload, num_input_nodes);
+  for (uint32_t i = 0; i < num_input_nodes; ++i) {
+    uint32_t nlen = static_cast<uint32_t>(strlen(input_keys[i]));
+    append_u32(&payload, nlen);
+    payload.append(input_keys[i], nlen);
+    uint32_t ndim = input_shape_indptr[i + 1] - input_shape_indptr[i];
+    append_u32(&payload, ndim);
+    for (uint32_t d = 0; d < ndim; ++d)
+      append_u32(&payload, input_shape_data[input_shape_indptr[i] + d]);
+  }
+  std::string reply;
+  if (!roundtrip(p, 1, payload, &reply)) {
+    mxtpu_predict_free(p);
+    return -1;
+  }
+  // bounds-checked parse: a corrupted reply must fail, not overread
+  size_t off = 0;
+  auto take_u32 = [&](uint32_t *v) {
+    if (off + 4 > reply.size()) return false;
+    memcpy(v, reply.data() + off, 4);
+    off += 4;
+    return true;
+  };
+  uint32_t n_out = 0;
+  bool parse_ok = take_u32(&n_out) && n_out <= 4096;
+  if (parse_ok) {
+    p->output_shapes.resize(n_out);
+    for (uint32_t i = 0; parse_ok && i < n_out; ++i) {
+      uint32_t ndim = 0;
+      parse_ok = take_u32(&ndim) && ndim <= 64 &&
+                 off + 4ull * ndim <= reply.size();
+      if (parse_ok) {
+        p->output_shapes[i].resize(ndim);
+        memcpy(p->output_shapes[i].data(), reply.data() + off,
+               4ull * ndim);
+        off += 4ull * ndim;
+      }
+    }
+  }
+  if (!parse_ok) {
+    g_last_error = "predict worker protocol corrupt (create reply)";
+    mxtpu_predict_free(p);
+    return -1;
+  }
+  *out = p;
+  return 0;
+}
+
+int mxtpu_predict_set_input(MXTPUPredictorHandle h, const char *key,
+                            const float *data, size_t size) {
+  Predictor *p = static_cast<Predictor *>(h);
+  std::string payload;
+  uint32_t nlen = static_cast<uint32_t>(strlen(key));
+  append_u32(&payload, nlen);
+  payload.append(key, nlen);
+  payload.append(reinterpret_cast<const char *>(data), size * 4);
+  return roundtrip(p, 2, payload, nullptr) ? 0 : -1;
+}
+
+int mxtpu_predict_forward(MXTPUPredictorHandle h) {
+  return roundtrip(static_cast<Predictor *>(h), 3, "", nullptr) ? 0 : -1;
+}
+
+int mxtpu_predict_get_output_shape(MXTPUPredictorHandle h, uint32_t index,
+                                   uint32_t *shape_data, uint32_t cap,
+                                   uint32_t *ndim) {
+  Predictor *p = static_cast<Predictor *>(h);
+  if (index >= p->output_shapes.size()) {
+    g_last_error = "output index out of range";
+    return -1;
+  }
+  const auto &s = p->output_shapes[index];
+  *ndim = static_cast<uint32_t>(s.size());
+  if (cap < s.size()) {
+    g_last_error = "shape buffer too small";
+    return -1;
+  }
+  memcpy(shape_data, s.data(), 4 * s.size());
+  return 0;
+}
+
+int mxtpu_predict_get_output(MXTPUPredictorHandle h, uint32_t index,
+                             float *data, size_t size) {
+  Predictor *p = static_cast<Predictor *>(h);
+  std::string payload, reply;
+  append_u32(&payload, index);
+  if (!roundtrip(p, 4, payload, &reply)) return -1;
+  if (reply.size() != size * 4) {
+    g_last_error = "output size mismatch: worker sent " +
+                   std::to_string(reply.size() / 4) + " floats";
+    return -1;
+  }
+  memcpy(data, reply.data(), reply.size());
+  return 0;
+}
+
+int mxtpu_predict_reload_params(MXTPUPredictorHandle h,
+                                const void *param_bytes, size_t param_len) {
+  Predictor *p = static_cast<Predictor *>(h);
+  std::string payload;
+  append_u64(&payload, param_len);
+  payload.append(static_cast<const char *>(param_bytes), param_len);
+  return roundtrip(p, 5, payload, nullptr) ? 0 : -1;
+}
+
+void mxtpu_predict_free(MXTPUPredictorHandle h) {
+  Predictor *p = static_cast<Predictor *>(h);
+  if (!p) return;
+  if (p->to_worker >= 0) {
+    char head[9] = {0};  // opcode 0 = CLOSE, len 0
+    write_all(p->to_worker, head, 9);
+    close(p->to_worker);
+  }
+  if (p->from_worker >= 0) close(p->from_worker);
+  if (p->pid > 0) {
+    int status;
+    waitpid(p->pid, &status, 0);
+  }
+  delete p;
+}
+
+}  // extern "C"

@@ -1,0 +1,71 @@
+/* C predict ABI for mxnet_tpu.
+ *
+ * Reference parity: include/mxnet/c_predict_api.h — the deployment
+ * surface that runs an exported model (symbol-json + .params) from C
+ * with no Python linkage in the host application.  The implementation
+ * (mxtpu_predict.cc) drives a forked mxnet_tpu.predict_worker over a
+ * pipe; see that module's docstring for the design rationale.
+ *
+ * All functions return 0 on success, -1 on failure;
+ * mxtpu_predict_last_error() describes the most recent failure.
+ */
+#ifndef MXTPU_PREDICT_H_
+#define MXTPU_PREDICT_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void *MXTPUPredictorHandle;
+
+/* Create a predictor.
+ *   symbol_json      NUL-terminated symbol json (exported .json file)
+ *   param_bytes/len  contents of the exported .params file (reference
+ *                    binary format)
+ *   num_input_nodes  number of data inputs
+ *   input_keys       input names (e.g. {"data"})
+ *   input_shape_indptr  CSR-style offsets into input_shape_data, length
+ *                    num_input_nodes+1 (reference MXPredCreate layout)
+ *   input_shape_data concatenated dims
+ */
+int mxtpu_predict_create(const char *symbol_json,
+                         const void *param_bytes, size_t param_len,
+                         uint32_t num_input_nodes,
+                         const char **input_keys,
+                         const uint32_t *input_shape_indptr,
+                         const uint32_t *input_shape_data,
+                         MXTPUPredictorHandle *out);
+
+/* Copy a float32 row-major buffer into the named input. */
+int mxtpu_predict_set_input(MXTPUPredictorHandle h, const char *key,
+                            const float *data, size_t size);
+
+/* Run the forward pass. */
+int mxtpu_predict_forward(MXTPUPredictorHandle h);
+
+/* Shape of output `index`: *ndim dims are written to shape_data (caller
+ * buffer of capacity cap). */
+int mxtpu_predict_get_output_shape(MXTPUPredictorHandle h,
+                                   uint32_t index, uint32_t *shape_data,
+                                   uint32_t cap, uint32_t *ndim);
+
+/* Copy output `index` (float32, row-major) into data (size floats). */
+int mxtpu_predict_get_output(MXTPUPredictorHandle h, uint32_t index,
+                             float *data, size_t size);
+
+/* Hot-swap parameters (same layout as create). */
+int mxtpu_predict_reload_params(MXTPUPredictorHandle h,
+                                const void *param_bytes,
+                                size_t param_len);
+
+void mxtpu_predict_free(MXTPUPredictorHandle h);
+
+const char *mxtpu_predict_last_error(void);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* MXTPU_PREDICT_H_ */

@@ -1,0 +1,93 @@
+/* C test client for the predict ABI (reference parity:
+ * example/image-classification/predict-cpp/ — classify an input from
+ * plain C against an exported symbol-json + .params).
+ *
+ * Usage: test_predict <symbol.json> <model.params> <input.f32> \
+ *        <n> <c> <h> <w>
+ * Prints "TOP1 <index> <score>" and the first 3 logits.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+
+#include "mxtpu_predict.h"
+
+static char *read_file(const char *path, size_t *len) {
+  FILE *f = fopen(path, "rb");
+  if (!f) {
+    fprintf(stderr, "cannot open %s\n", path);
+    exit(2);
+  }
+  fseek(f, 0, SEEK_END);
+  long n = ftell(f);
+  fseek(f, 0, SEEK_SET);
+  char *buf = (char *)malloc((size_t)n + 1);
+  if (fread(buf, 1, (size_t)n, f) != (size_t)n) {
+    fprintf(stderr, "short read on %s\n", path);
+    exit(2);
+  }
+  buf[n] = '\0';
+  fclose(f);
+  *len = (size_t)n;
+  return buf;
+}
+
+int main(int argc, char **argv) {
+  if (argc != 8) {
+    fprintf(stderr,
+            "usage: %s sym.json model.params input.f32 n c h w\n",
+            argv[0]);
+    return 2;
+  }
+  size_t json_len, param_len, input_len;
+  char *json = read_file(argv[1], &json_len);
+  char *params = read_file(argv[2], &param_len);
+  char *input = read_file(argv[3], &input_len);
+  uint32_t shape[4];
+  for (int i = 0; i < 4; ++i) shape[i] = (uint32_t)atoi(argv[4 + i]);
+  size_t in_size =
+      (size_t)shape[0] * shape[1] * shape[2] * shape[3];
+  if (input_len != in_size * 4) {
+    fprintf(stderr, "input file has %zu bytes, want %zu\n", input_len,
+            in_size * 4);
+    return 2;
+  }
+
+  const char *keys[1] = {"data"};
+  uint32_t indptr[2] = {0, 4};
+  MXTPUPredictorHandle h;
+  if (mxtpu_predict_create(json, params, param_len, 1, keys, indptr,
+                           shape, &h) != 0) {
+    fprintf(stderr, "create failed: %s\n", mxtpu_predict_last_error());
+    return 1;
+  }
+  if (mxtpu_predict_set_input(h, "data", (const float *)input,
+                              in_size) != 0 ||
+      mxtpu_predict_forward(h) != 0) {
+    fprintf(stderr, "forward failed: %s\n", mxtpu_predict_last_error());
+    return 1;
+  }
+  uint32_t oshape[8], ndim;
+  if (mxtpu_predict_get_output_shape(h, 0, oshape, 8, &ndim) != 0) {
+    fprintf(stderr, "shape failed: %s\n", mxtpu_predict_last_error());
+    return 1;
+  }
+  size_t osize = 1;
+  for (uint32_t i = 0; i < ndim; ++i) osize *= oshape[i];
+  float *out = (float *)malloc(osize * 4);
+  if (mxtpu_predict_get_output(h, 0, out, osize) != 0) {
+    fprintf(stderr, "output failed: %s\n", mxtpu_predict_last_error());
+    return 1;
+  }
+  size_t best = 0;
+  for (size_t i = 1; i < osize; ++i)
+    if (out[i] > out[best]) best = i;
+  printf("TOP1 %zu %.6f\n", best, out[best]);
+  printf("LOGITS %.6f %.6f %.6f\n", out[0], osize > 1 ? out[1] : 0.0f,
+         osize > 2 ? out[2] : 0.0f);
+  mxtpu_predict_free(h);
+  free(out);
+  free(json);
+  free(params);
+  free(input);
+  return 0;
+}

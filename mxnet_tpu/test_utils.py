@@ -270,3 +270,17 @@ def list_gpus():
 
 def download(url, fname=None, dirname=None, overwrite=False, retries=5):
     raise MXNetError("network access is unavailable in this environment")
+
+
+def backend_supports_host_callbacks():
+    """True unless the active jax backend is the tunneled 'axon' PJRT
+    plugin, which lacks host send/recv callbacks (pure_callback /
+    io_callback) — the custom-op traced path needs them.  Real TPU
+    runtimes support callbacks; this is a dev-tunnel limitation only."""
+    try:
+        from jax._src import xla_bridge
+
+        ver = getattr(xla_bridge.get_backend(), "platform_version", "")
+        return "axon" not in ver
+    except Exception:
+        return True
