@@ -1,0 +1,134 @@
+"""Fine-tuning from an exported checkpoint (reference:
+example/image-classification/fine-tune.py — load a trained
+symbol+params, graft a fresh classifier head onto the feature
+extractor, train the head fast and the backbone slow).
+
+Workflow demonstrated end-to-end (and used as an integration test by
+tests/test_examples_finetune.py):
+1. "pretrain" a small resnet on synthetic 10-class data and export it
+   (stands in for a downloaded model-zoo checkpoint);
+2. `get_fine_tune_model` — cut the symbol at the flatten layer, add a
+   new FC for the target task's class count;
+3. bind a Module on the new task (20 classes), load backbone weights
+   via `set_params(allow_missing=True)`, train with a 10x smaller lr
+   on pretrained layers (`lr_mult` attr — reference's `fixed_param` /
+   finetune lr pattern).
+
+Usage: python examples/fine_tune.py [--epochs 2] [--batch-size 32]
+"""
+import argparse
+import os
+import sys
+import tempfile
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd  # noqa: E402
+
+
+def get_fine_tune_model(sym, arg_params, num_classes,
+                        layer_name="flatten"):
+    """Cut `sym` after `layer_name`, append a fresh FC+softmax; split
+    params into (reusable backbone, discarded head) — the reference
+    fine-tune.py recipe."""
+    internals = sym.get_internals()
+    outputs = [n for n in internals.list_outputs()
+               if n.endswith(layer_name + "_output")
+               or layer_name in n and n.endswith("_output")]
+    if not outputs:
+        raise ValueError("no internal output matching %r" % layer_name)
+    feat = internals[outputs[-1]]
+    net = mx.sym.FullyConnected(feat, num_hidden=num_classes,
+                                name="fc_new")
+    net = mx.sym.SoftmaxOutput(net, name="softmax")
+    new_args = {k: v for k, v in arg_params.items()
+                if not k.startswith("fc_new")}
+    return net, new_args
+
+
+def synthetic_iter(num_classes, batch_size, n_batches, seed, shape):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(batch_size * n_batches, *shape).astype(np.float32)
+    Y = rng.randint(0, num_classes, batch_size * n_batches)
+    # make classes separable: shift pixels by class id
+    X += Y[:, None, None, None] * 0.15
+    return mx.io.NDArrayIter(X, Y.astype(np.float32), batch_size,
+                             shuffle=True, label_name="softmax_label")
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--epochs", type=int, default=2)
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--network", default="resnet18_v1")
+    p.add_argument("--image-shape", default="3,32,32")
+    p.add_argument("--pretrain-classes", type=int, default=10)
+    p.add_argument("--classes", type=int, default=20)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--backbone-lr-mult", type=float, default=0.1)
+    args = p.parse_args()
+    shape = tuple(int(v) for v in args.image_shape.split(","))
+
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    with tempfile.TemporaryDirectory() as d:
+        # --- stage 1: "pretrained" checkpoint ---
+        net = vision.get_model(args.network,
+                               classes=args.pretrain_classes)
+        net.initialize(mx.init.Xavier())
+        net(nd.array(np.zeros((1,) + shape, np.float32)))
+        prefix = os.path.join(d, "base")
+        net.export(prefix)
+        sym = mx.sym.load(prefix + "-symbol.json")
+        loaded = nd.load(prefix + "-0000.params")
+        arg_params = {k.split(":", 1)[1]: v for k, v in loaded.items()
+                      if k.startswith("arg:")}
+        aux_params = {k.split(":", 1)[1]: v for k, v in loaded.items()
+                      if k.startswith("aux:")}
+
+        # --- stage 2: graft a new head ---
+        tuned_sym, backbone_args = get_fine_tune_model(
+            sym, arg_params, args.classes)
+
+        # --- stage 3: fine-tune on the target task ---
+        train = synthetic_iter(args.classes, args.batch_size, 16, 0,
+                               shape)
+        val = synthetic_iter(args.classes, args.batch_size, 4, 1, shape)
+        mod = mx.mod.Module(tuned_sym, context=mx.context.current_context())
+        mod.bind(data_shapes=train.provide_data,
+                 label_shapes=train.provide_label)
+        mod.init_params(mx.init.Xavier())
+        mod.set_params(backbone_args, aux_params, allow_missing=True,
+                       allow_extra=True)
+        # backbone trains slower than the fresh head (reference
+        # fine-tune lr_mult pattern via Optimizer.set_lr_mult)
+        mod.init_optimizer(optimizer="sgd", optimizer_params={
+            "learning_rate": args.lr, "momentum": 0.9})
+        mod._optimizer.set_lr_mult(
+            {k: args.backbone_lr_mult for k in backbone_args})
+        metric = mx.metric.Accuracy()
+        for epoch in range(args.epochs):
+            train.reset()
+            metric.reset()
+            for batch in train:
+                mod.forward(batch, is_train=True)
+                mod.update_metric(metric, batch.label)
+                mod.backward()
+                mod.update()
+            name, acc = metric.get()
+            print("epoch %d train-%s=%.3f" % (epoch, name, acc))
+        metric.reset()
+        val.reset()
+        for batch in val:
+            mod.forward(batch, is_train=False)
+            mod.update_metric(metric, batch.label)
+        print("val-%s=%.3f" % metric.get())
+        return metric.get()[1]
+
+
+if __name__ == "__main__":
+    main()

@@ -368,8 +368,17 @@ class NDArray:
     def _conv_index(self, key):
         if isinstance(key, NDArray):
             return key._data
+        if isinstance(key, list):
+            # numpy/reference-style list indexing: a[[0, 2]] is an
+            # integer-array index (jax rejects bare sequences; an empty
+            # list must coerce to an INT indexer, not float64)
+            return np.asarray(key) if key else np.asarray(key, np.int64)
         if isinstance(key, tuple):
-            return tuple(k._data if isinstance(k, NDArray) else k for k in key)
+            return tuple(
+                k._data if isinstance(k, NDArray)
+                else (np.asarray(k) if k else np.asarray(k, np.int64))
+                if isinstance(k, list) else k
+                for k in key)
         return key
 
     @staticmethod
@@ -410,8 +419,8 @@ class NDArray:
         key = self._conv_index(key)
         if isinstance(value, NDArray):
             value = value._data
-        if key is None or key == slice(None) or (
-                isinstance(key, slice) and key == slice(None, None, None)):
+        if key is None or (isinstance(key, slice)
+                           and key == slice(None, None, None)):
             if np.isscalar(value):
                 self._rebind(jnp.full_like(self._data, value))
             else:
@@ -589,7 +598,9 @@ def _array_kwarg_order(info):
 _EAGER_JIT_CACHE = {}
 # ops never worth a jit trace: zero-FLOP indexing where the index value
 # itself would key the cache (every distinct slice = a fresh compile)
-_EAGER_JIT_SKIP = {"_index_static"}
+# ops that must see CONCRETE inputs when eager: _index_static bakes the
+# key into the trace; take's mode='raise' bounds check needs host values
+_EAGER_JIT_SKIP = {"_index_static", "take"}
 
 
 def _trace_state_clean():

@@ -606,8 +606,27 @@ def _size_array(data, **kw):
 def _take(a, indices, axis=0, mode="clip", **kw):
     axis = pint(axis, 0)
     mode = mode or "clip"
+    if mode == "raise":
+        # reference semantics: out-of-bounds indices raise.  Under jit
+        # the check is impossible (data-dependent control flow); eager
+        # indices are concrete, so validate on host and fall back to
+        # clip inside traces.
+        try:
+            idx_host = np.asarray(indices)
+        except Exception:
+            idx_host = None
+        if idx_host is not None:
+            n = a.shape[axis]
+            if idx_host.size and (int(idx_host.min()) < -n
+                                  or int(idx_host.max()) >= n):
+                raise IndexError(
+                    "take(mode='raise'): index out of bounds for axis "
+                    "%d with size %d" % (axis, n))
+            # validated indices are in [-n, n): wrap maps -1 -> n-1
+            # (clip would clamp valid negatives to 0)
+            mode = "wrap"
     return jnp.take(a, indices.astype(jnp.int32), axis=axis,
-                    mode="clip" if mode == "clip" else "wrap")
+                    mode="wrap" if mode == "wrap" else "clip")
 
 
 @register("batch_take", num_inputs=2)
