@@ -16,7 +16,8 @@ import jax.numpy as jnp
 from jax import lax
 
 from .registry import register, alias
-from .utils import pbool, pint, pfloat, ptuple, pdtype, paxis, normalize_axis
+from .utils import (pbool, pint, pfloat, ptuple, pdtype, paxis,
+                    paxis_or_none, normalize_axis)
 
 # ---------------------------------------------------------------------------
 # elemwise binary (same-shape) and broadcast binary
@@ -731,7 +732,9 @@ def _eye(N=0, M=0, k=0, dtype="float32", ctx=None, **kw):
 
 @register("sort", differentiable=False)
 def _sort(data, axis=-1, is_ascend=True, **kw):
-    ax = paxis(axis, -1)
+    # axis=None means sort the FLATTENED array (reference ordering_op);
+    # paxis would fold None into the -1 default
+    ax = paxis_or_none(axis, -1)
     out = jnp.sort(data, axis=ax)
     if not pbool(is_ascend, True):
         out = jnp.flip(out, axis=ax if ax is not None else 0)
@@ -740,7 +743,7 @@ def _sort(data, axis=-1, is_ascend=True, **kw):
 
 @register("argsort", differentiable=False)
 def _argsort(data, axis=-1, is_ascend=True, dtype="float32", **kw):
-    ax = paxis(axis, -1)
+    ax = paxis_or_none(axis, -1)
     out = jnp.argsort(data, axis=ax)
     if not pbool(is_ascend, True):
         out = jnp.flip(out, axis=ax if ax is not None else 0)
@@ -754,7 +757,10 @@ def _topk_num_outputs(attrs):
 
 @register("topk", num_outputs=_topk_num_outputs, differentiable=False)
 def _topk(data, axis=-1, k=1, ret_typ="indices", is_ascend=False, dtype="float32", **kw):
-    ax = paxis(axis, -1)
+    ax = paxis_or_none(axis, -1)
+    if ax is None:       # flattened-input semantics, like sort/argsort
+        data = jnp.reshape(data, (-1,))
+        ax = 0
     k = pint(k, 1)
     is_ascend = pbool(is_ascend, False)
     ret_typ = ret_typ or "indices"

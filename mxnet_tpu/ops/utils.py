@@ -82,6 +82,16 @@ def paxis(v, default=None):
     return int(v)
 
 
+def paxis_or_none(v, default):
+    """Like paxis, but a caller-supplied explicit None (or 'None'
+    string) stays None — the ordering ops' 'flatten the input' marker —
+    while an ABSENT attr falls back to `default`.  Use where the op's
+    registered default is not None."""
+    if v is None or (isinstance(v, str) and v.strip() in ("None", "")):
+        return None
+    return paxis(v, default)
+
+
 def normalize_axis(axis, ndim):
     if axis < 0:
         axis += ndim

@@ -376,3 +376,148 @@ def test_list_index_edge_cases_from_review():
     out = nd.take(v, nd.array(np.array([5], np.int32), dtype="int32"),
                   mode="clip").asnumpy()
     assert_almost_equal(out, np.array([30.]), rtol=0, atol=0)
+
+
+# ---------------------------------------------------------------------------
+# ordering / selection / sequence families (reference test_operator.py
+# test_order, test_pick, test_sequence_* style)
+# ---------------------------------------------------------------------------
+
+
+def test_topk_matrix():
+    x = _R.rand(3, 7).astype(np.float32)
+    a = nd.array(x)
+    for k in (1, 3, 7):
+        idx = nd.topk(a, k=k, axis=1).asnumpy().astype(int)
+        want = np.argsort(-x, axis=1)[:, :k]
+        assert (idx == want).all(), (k, idx, want)
+        val = nd.topk(a, k=k, axis=1, ret_typ="value").asnumpy()
+        assert_almost_equal(val, -np.sort(-x, axis=1)[:, :k],
+                            rtol=1e-6, atol=0)
+    both = nd.topk(a, k=2, axis=0, ret_typ="both")
+    assert_almost_equal(both[0].asnumpy(),
+                        -np.sort(-x, axis=0)[:2], rtol=1e-6, atol=0)
+    # smallest
+    small = nd.topk(a, k=2, axis=1, is_ascend=True,
+                    ret_typ="value").asnumpy()
+    assert_almost_equal(small, np.sort(x, axis=1)[:, :2], rtol=1e-6,
+                        atol=0)
+
+
+def test_sort_argsort_matrix():
+    x = _R.rand(4, 5).astype(np.float32)
+    a = nd.array(x)
+    for axis in (0, 1, -1):
+        assert_almost_equal(nd.sort(a, axis=axis).asnumpy(),
+                            np.sort(x, axis=axis), rtol=0, atol=0)
+        assert (nd.argsort(a, axis=axis).asnumpy().astype(int)
+                == np.argsort(x, axis=axis, kind="stable")).all()
+    desc = nd.sort(a, axis=1, is_ascend=False).asnumpy()
+    assert_almost_equal(desc, -np.sort(-x, axis=1), rtol=0, atol=0)
+    flat = nd.argsort(a, axis=None).asnumpy().astype(int)
+    assert (flat == np.argsort(x, axis=None, kind="stable")).all()
+    # sort/topk share the flatten-on-None semantics
+    assert_almost_equal(nd.sort(a, axis=None).asnumpy(),
+                        np.sort(x, axis=None), rtol=0, atol=0)
+    desc_flat = nd.sort(a, axis=None, is_ascend=False).asnumpy()
+    assert_almost_equal(desc_flat, -np.sort(-x, axis=None), rtol=0,
+                        atol=0)
+    g3 = nd.topk(a, axis=None, k=3, ret_typ="value").asnumpy()
+    assert_almost_equal(g3, -np.sort(-x, axis=None)[:3], rtol=0,
+                        atol=0)
+
+
+def test_pick_and_where():
+    x = _R.rand(4, 6).astype(np.float32)
+    idx = np.array([0, 5, 2, 3], np.float32)
+    out = nd.pick(nd.array(x), nd.array(idx), axis=1).asnumpy()
+    assert_almost_equal(out, x[np.arange(4), idx.astype(int)], rtol=0,
+                        atol=0)
+    cond = (_R.rand(4, 6) > 0.5).astype(np.float32)
+    yv = _R.rand(4, 6).astype(np.float32)
+    out = nd.where(nd.array(cond), nd.array(x), nd.array(yv)).asnumpy()
+    assert_almost_equal(out, np.where(cond > 0, x, yv), rtol=0, atol=0)
+
+
+def test_one_hot_and_reverse():
+    idx = np.array([1, 0, 3], np.float32)
+    out = nd.one_hot(nd.array(idx), depth=4, on_value=2.0,
+                     off_value=-1.0).asnumpy()
+    want = np.full((3, 4), -1.0, np.float32)
+    want[np.arange(3), idx.astype(int)] = 2.0
+    assert_almost_equal(out, want, rtol=0, atol=0)
+    x = _R.rand(2, 3, 4).astype(np.float32)
+    out = nd.reverse(nd.array(x), axis=1).asnumpy()
+    assert_almost_equal(out, x[:, ::-1], rtol=0, atol=0)
+    out = nd.flip(nd.array(x), axis=2).asnumpy()
+    assert_almost_equal(out, x[..., ::-1], rtol=0, atol=0)
+
+
+def test_sequence_ops_matrix():
+    # (T, B, D) with per-batch valid lengths — reference sequence ops
+    T, B, D = 5, 3, 2
+    x = _R.rand(T, B, D).astype(np.float32)
+    ln = np.array([2, 5, 3], np.float32)
+    out = nd.SequenceMask(nd.array(x), nd.array(ln),
+                          use_sequence_length=True, value=-7.0).asnumpy()
+    want = x.copy()
+    for b, n in enumerate(ln.astype(int)):
+        want[n:, b] = -7.0
+    assert_almost_equal(out, want, rtol=0, atol=0)
+    out = nd.SequenceLast(nd.array(x), nd.array(ln),
+                          use_sequence_length=True).asnumpy()
+    want = np.stack([x[int(n) - 1, b] for b, n in enumerate(ln)])
+    assert_almost_equal(out, want, rtol=0, atol=0)
+    out = nd.SequenceReverse(nd.array(x), nd.array(ln),
+                             use_sequence_length=True).asnumpy()
+    want = x.copy()
+    for b, n in enumerate(ln.astype(int)):
+        want[:n, b] = x[:n, b][::-1]
+    assert_almost_equal(out, want, rtol=1e-6, atol=0)
+    # without lengths: full reverse/last
+    out = nd.SequenceLast(nd.array(x)).asnumpy()
+    assert_almost_equal(out, x[-1], rtol=0, atol=0)
+
+
+def test_batch_dot_shapes_and_transpose():
+    a = _R.rand(4, 2, 3).astype(np.float32)
+    b = _R.rand(4, 3, 5).astype(np.float32)
+    out = nd.batch_dot(nd.array(a), nd.array(b)).asnumpy()
+    assert_almost_equal(out, a @ b, rtol=1e-5, atol=1e-6)
+    out = nd.batch_dot(nd.array(a), nd.array(b.transpose(0, 2, 1)),
+                       transpose_b=True).asnumpy()
+    assert_almost_equal(out, a @ b, rtol=1e-5, atol=1e-6)
+    out = nd.batch_dot(nd.array(a.transpose(0, 2, 1)), nd.array(b),
+                       transpose_a=True).asnumpy()
+    assert_almost_equal(out, a @ b, rtol=1e-5, atol=1e-6)
+
+
+def test_embedding_gradient_rows():
+    """Embedding backward scatters into used rows only."""
+    from mxnet_tpu import autograd
+
+    W = nd.array(_R.rand(6, 3).astype(np.float32))
+    W.attach_grad()
+    idx = nd.array(np.array([1, 4, 1], np.float32))
+    with autograd.record():
+        out = nd.Embedding(idx, W, input_dim=6, output_dim=3)
+        loss = out.sum()
+    loss.backward()
+    g = W.grad.asnumpy()
+    assert_almost_equal(g[1], np.full(3, 2.0), rtol=0, atol=0)  # used 2x
+    assert_almost_equal(g[4], np.ones(3), rtol=0, atol=0)
+    assert (g[[0, 2, 3, 5]] == 0).all()
+
+
+def test_slice_like_and_broadcast_like():
+    a = _R.rand(4, 5).astype(np.float32)
+    ref = np.zeros((2, 3), np.float32)
+    out = nd.slice_like(nd.array(a), nd.array(ref)).asnumpy()
+    assert_almost_equal(out, a[:2, :3], rtol=0, atol=0)
+    out = nd.slice_like(nd.array(a), nd.array(ref),
+                        axes=(1,)).asnumpy()
+    assert_almost_equal(out, a[:, :3], rtol=0, atol=0)
+    small = _R.rand(1, 5).astype(np.float32)
+    out = nd.broadcast_like(nd.array(small), nd.array(a)).asnumpy()
+    assert_almost_equal(out, np.broadcast_to(small, (4, 5)), rtol=0,
+                        atol=0)
