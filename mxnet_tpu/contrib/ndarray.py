@@ -24,6 +24,11 @@ del _op
 # control-flow surface (parity: ndarray/contrib.py foreach/while_loop/cond)
 from ..ops.control_flow import foreach, while_loop, cond  # noqa: F401,E402
 
+# float-predicate helpers (parity: ndarray/contrib.py isinf/isfinite/isnan)
+isnan = _make("isnan")
+isinf = _make("isinf")
+isfinite = _make("isfinite")
+
 # DGL graph-sampling ops run host-side on CSR components (see
 # ops/dgl_graph.py for why they are not registry/jit ops)
 from ..ops.dgl_graph import (  # noqa: F401,E402

@@ -134,6 +134,14 @@ _UNARY = {
 for _name, _fn in _UNARY.items():
     register(_name)((lambda f: lambda data, **kw: f(data))(_fn))
 
+# float-valued predicates (reference exposes these as python helpers in
+# ndarray/contrib.py:466; registering them serves nd + sym + contrib)
+register("isnan", differentiable=False)(
+    lambda data, **kw: jnp.isnan(data).astype(data.dtype))
+register("isinf", differentiable=False)(
+    lambda data, **kw: jnp.isinf(data).astype(data.dtype))
+register("isfinite", differentiable=False)(
+    lambda data, **kw: jnp.isfinite(data).astype(data.dtype))
 register("logical_not", differentiable=False)(
     lambda data, **kw: jnp.logical_not(data).astype(data.dtype))
 register("hard_sigmoid")(

@@ -15,11 +15,13 @@ design attacks both:
    all arithmetic (cast / scale / normalize via ``preprocess``) happens
    on device inside the compiled program, fused into the first conv.
 
-Measured on the tunneled dev chip (docs/perf_notes.md): the compiled
-chain program sustains ~5.7k img/s with device-resident input; host-fed
-throughput is capped by the tunnel link (~5-30 MB/s), which this
-pipeline saturates.  On a real TPU host (PCIe, >10 GB/s) the same
-pipeline is compute-bound.
+Measured on the tunneled dev chip (docs/perf_notes.md,
+docs/serving_bench.json): device-resident input sustains 2.1k img/s
+fetching full logits and 4.8-6.7k img/s with a device-side top-5
+postprocess (vs the 2,086 img/s bs32 V100 anchor); host-fed throughput
+is capped by the tunnel link (~5-30 MB/s), of which this pipeline
+achieves 85-90%.  On a real TPU host (PCIe, >10 GB/s) the same
+pipeline is compute-bound at the device-resident numbers.
 """
 from __future__ import annotations
 

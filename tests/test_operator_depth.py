@@ -521,3 +521,19 @@ def test_slice_like_and_broadcast_like():
     out = nd.broadcast_like(nd.array(small), nd.array(a)).asnumpy()
     assert_almost_equal(out, np.broadcast_to(small, (4, 5)), rtol=0,
                         atol=0)
+
+
+def test_float_predicates():
+    x = np.array([1.0, np.nan, np.inf, -np.inf, 0.0], np.float32)
+    a = nd.array(x)
+    assert_almost_equal(nd.isnan(a).asnumpy(),
+                        np.isnan(x).astype(np.float32), rtol=0, atol=0)
+    assert_almost_equal(nd.isinf(a).asnumpy(),
+                        np.isinf(x).astype(np.float32), rtol=0, atol=0)
+    assert_almost_equal(nd.isfinite(a).asnumpy(),
+                        np.isfinite(x).astype(np.float32), rtol=0,
+                        atol=0)
+    import mxnet_tpu as mx2
+
+    assert_almost_equal(mx2.nd.contrib.isnan(a).asnumpy(),
+                        np.isnan(x).astype(np.float32), rtol=0, atol=0)
