@@ -159,7 +159,10 @@ def test_kvstore_rsp_push_pull_mesh():
                        row_ids=mx.nd.array(np.array([0, 1, 50_000])))
     got = dict(zip(out.indices.asnumpy().tolist(),
                    out.data.asnumpy()[:, 0].tolist()))
-    assert got[0] == 1.0 and got[1] == 2.0 and got[50_000] == 1.0
+    n = min(8, len(devs))   # one grad per local device: a single real
+    assert got[0] == 1.0    # chip pushes only grad 0 (row 1 stays 0)
+    assert got[1] == (2.0 if n > 1 else 0.0)
+    assert got[50_000] == 1.0
     assert out.data.shape[0] == 3
 
 
