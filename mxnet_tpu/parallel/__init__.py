@@ -11,3 +11,4 @@ from .train import ShardedTrainer  # noqa: F401
 from .ring_attention import (ring_attention, ring_attention_sharded,  # noqa: F401
                              local_attention)
 from .pipeline import pipeline_forward, gpipe_loss  # noqa: F401
+from .ulysses import ulysses_attention, ulysses_attention_sharded  # noqa: F401

@@ -1,0 +1,91 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The second standard long-context recipe alongside ring attention
+(parallel/ring_attention.py).  Where the ring rotates K/V blocks and
+keeps an online softmax, Ulysses re-shards: activations arrive
+sequence-sharded (batch, seq/P, heads, dim); one all-to-all swaps the
+sharded axis so each device holds the FULL sequence for heads/P of the
+heads, runs plain (flash-fusable) local attention, and a second
+all-to-all restores sequence sharding.  Communication is 4 all-to-alls
+of activation size per layer (q, k, v in; output back — the standard
+DeepSpeed-Ulysses accounting) — on TPU these ride ICI as XLA
+`all_to_all` collectives inside one jit program.
+
+Trade-off vs ring (docs for users picking an engine):
+- Ulysses needs heads % P == 0 and moves activations twice, but the
+  local attention is a single dense block — best when heads >= P and
+  the per-device full-sequence K/V fits HBM.
+- Ring keeps K/V resident and overlaps each hop with block compute —
+  best when seq is too long for any device to hold full K/V.
+
+The reference has NO sequence parallelism (SURVEY §2.3) — both engines
+are new TPU-first capability.
+"""
+from __future__ import annotations
+
+__all__ = ["ulysses_attention", "ulysses_attention_sharded"]
+
+
+def ulysses_attention(q, k, v, axis_name="sp", causal=False, scale=None):
+    """Exact attention over a sequence sharded along `axis_name`.
+
+    q, k, v: (batch, seq_local, heads, dim) per-device blocks, with
+    heads divisible by the axis size.  Must run inside shard_map/pmap
+    with `axis_name` bound.  Returns (batch, seq_local, heads, dim).
+    """
+    import jax.numpy as jnp
+    from jax import lax
+
+    h, d = q.shape[2], q.shape[3]
+    p = lax.psum(1, axis_name)
+    if h % p != 0:
+        raise ValueError(
+            "ulysses_attention: heads (%d) must be divisible by the "
+            "'%s' axis size (%d); use ring_attention otherwise"
+            % (h, axis_name, p))
+    scale = scale if scale is not None else d ** -0.5
+
+    def seq_to_heads(x):
+        # (b, s/P, h, d) -> (b, s, h/P, d): one tiled all_to_all trades
+        # h/P of the heads for every peer's sequence chunk (chunks land
+        # in rank order, reconstructing the global sequence)
+        return lax.all_to_all(x, axis_name, split_axis=2,
+                              concat_axis=1, tiled=True)
+
+    def heads_to_seq(x):
+        # inverse: (b, s, h/P, d) -> (b, s/P, h, d)
+        return lax.all_to_all(x, axis_name, split_axis=1,
+                              concat_axis=2, tiled=True)
+
+    from .ring_attention import local_attention
+
+    qf, kf, vf = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    out = local_attention(qf, kf, vf, causal=causal, scale=scale)
+    return heads_to_seq(out)
+
+
+_SHARDED_CACHE = {}
+
+
+def ulysses_attention_sharded(mesh, q, k, v, axis_name="sp",
+                              causal=False):
+    """Convenience wrapper: shard (batch, seq, heads, dim) inputs along
+    `axis_name` over `mesh` and run ulysses_attention under shard_map
+    (mirror of ring_attention_sharded).  The jitted program is cached
+    per (mesh, axis, causal) so per-step calls don't retrace."""
+    import functools
+
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    spec = P(None, axis_name)
+    key = (id(mesh), axis_name, bool(causal))
+    fn = _SHARDED_CACHE.get(key)
+    if fn is None:
+        fn = jax.jit(jax.shard_map(
+            functools.partial(ulysses_attention, axis_name=axis_name,
+                              causal=causal),
+            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))
+        _SHARDED_CACHE[key] = fn
+    put = lambda x: jax.device_put(x, NamedSharding(mesh, spec))
+    return fn(put(q), put(k), put(v))
