@@ -23,15 +23,24 @@ are new TPU-first capability.
 """
 from __future__ import annotations
 
+import functools as _functools
+
 __all__ = ["ulysses_attention", "ulysses_attention_sharded"]
 
 
-def ulysses_attention(q, k, v, axis_name="sp", causal=False, scale=None):
+def ulysses_attention(q, k, v, axis_name="sp", causal=False, scale=None,
+                      use_flash=False, blk_q=128, blk_k=128):
     """Exact attention over a sequence sharded along `axis_name`.
 
     q, k, v: (batch, seq_local, heads, dim) per-device blocks, with
     heads divisible by the axis size.  Must run inside shard_map/pmap
     with `axis_name` bound.  Returns (batch, seq_local, heads, dim).
+
+    use_flash=True runs the local full-sequence attention with the
+    Pallas flash kernel (ops/attention_pallas.py) — O(blk^2) scores
+    instead of the O(seq^2) matrix the dense path materializes, which
+    is what makes long sequences viable here (non-causal only, matching
+    the kernel's contract).
     """
     import jax.numpy as jnp
     from jax import lax
@@ -57,35 +66,52 @@ def ulysses_attention(q, k, v, axis_name="sp", causal=False, scale=None):
         return lax.all_to_all(x, axis_name, split_axis=1,
                               concat_axis=2, tiled=True)
 
-    from .ring_attention import local_attention
-
     qf, kf, vf = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
-    out = local_attention(qf, kf, vf, causal=causal, scale=scale)
+    if use_flash:
+        if causal:
+            raise NotImplementedError(
+                "ulysses_attention(use_flash=True) supports non-causal "
+                "attention only (same contract as ring_attention)")
+        from ..ops.attention_pallas import flash_attention_with_lse
+
+        sc = scale if scale is not None else d ** -0.5
+        out, _ = flash_attention_with_lse(qf, kf, vf, scale=sc,
+                                          blk_q=blk_q, blk_k=blk_k)
+        out = out.astype(q.dtype)
+    else:
+        from .ring_attention import local_attention
+
+        out = local_attention(qf, kf, vf, causal=causal, scale=scale)
     return heads_to_seq(out)
 
 
-_SHARDED_CACHE = {}
+@_functools.lru_cache(maxsize=32)
+def _sharded_fn(mesh, axis_name, causal, use_flash):
+    """jit+shard_map program per (mesh, axis, causal, flash) — Mesh is
+    hashable, so equal meshes share the compiled program and the cache
+    is bounded (per-step make_mesh() callers neither retrace nor leak)."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(None, axis_name)
+    # check_vma=False: pallas_call outputs don't carry varying-mesh-axes
+    # metadata (same reason ring_attention_sharded uses check_rep=False)
+    return jax.jit(jax.shard_map(
+        _functools.partial(ulysses_attention, axis_name=axis_name,
+                           causal=causal, use_flash=use_flash),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False))
 
 
 def ulysses_attention_sharded(mesh, q, k, v, axis_name="sp",
-                              causal=False):
+                              causal=False, use_flash=False):
     """Convenience wrapper: shard (batch, seq, heads, dim) inputs along
     `axis_name` over `mesh` and run ulysses_attention under shard_map
-    (mirror of ring_attention_sharded).  The jitted program is cached
-    per (mesh, axis, causal) so per-step calls don't retrace."""
-    import functools
-
+    (mirror of ring_attention_sharded)."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     spec = P(None, axis_name)
-    key = (id(mesh), axis_name, bool(causal))
-    fn = _SHARDED_CACHE.get(key)
-    if fn is None:
-        fn = jax.jit(jax.shard_map(
-            functools.partial(ulysses_attention, axis_name=axis_name,
-                              causal=causal),
-            mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec))
-        _SHARDED_CACHE[key] = fn
+    fn = _sharded_fn(mesh, axis_name, bool(causal), bool(use_flash))
     put = lambda x: jax.device_put(x, NamedSharding(mesh, spec))
     return fn(put(q), put(k), put(v))

@@ -191,3 +191,23 @@ def test_ulysses_rejects_indivisible_heads():
     x = np.random.rand(1, 8, 3, 4).astype(np.float32)  # 3 heads, P=4
     with pytest.raises(Exception, match="divisible"):
         parallel.ulysses_attention_sharded(mesh, x, x, x)
+
+
+def test_ulysses_flash_engine_matches_dense():
+    """use_flash=True (Pallas kernel, interpret mode on CPU) agrees
+    with the dense path."""
+    import jax
+    import numpy as np
+
+    from mxnet_tpu import parallel
+
+    mesh = parallel.make_mesh({"sp": 4}, jax.devices()[:4])
+    B, T, H, D = 1, 16, 4, 8
+    rng = np.random.RandomState(1)
+    q = rng.rand(B, T, H, D).astype(np.float32)
+    out = parallel.ulysses_attention_sharded(mesh, q, q, q,
+                                             use_flash=True,
+                                             axis_name="sp")
+    ref = parallel.local_attention(q, q, q)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-4, atol=2e-5)
