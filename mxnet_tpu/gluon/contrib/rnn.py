@@ -12,7 +12,7 @@ slicing so a hybridized consumer compiles to one fused XLA loop."""
 from __future__ import annotations
 
 from ..rnn.rnn_cell import (HybridRecurrentCell, ModifierCell,
-                            BidirectionalCell, _format_sequence)
+                            BidirectionalCell, _SeqView)
 from ... import ndarray
 
 __all__ = ["Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
@@ -363,13 +363,12 @@ def dynamic_unroll(cell, inputs, begin_state, drop_inputs=0, drop_outputs=0,
     if drop_inputs:
         inputs = ndarray.Dropout(inputs, p=drop_inputs,
                                  axes=(axis,))
-    seq, axis, _F, batch_size = _format_sequence(length, inputs, layout,
-                                                 False)
+    view = _SeqView(inputs, layout)
     states = begin_state
     outputs = []
     step_states = []   # per step, per state slot (for valid_length)
     for t in range(length):
-        out, states = cell(seq[t], states)
+        out, states = cell(view.steps[t], states)
         outputs.append(out)
         if valid_length is not None:
             step_states.append(states)

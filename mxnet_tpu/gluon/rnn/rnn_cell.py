@@ -12,38 +12,52 @@ __all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
            "ResidualCell", "BidirectionalCell"]
 
 
-def _cells_state_info(cells, batch_size):
-    return sum([c.state_info(batch_size) for c in cells], [])
+class _SeqView:
+    """A sequence input normalized to per-step arrays.
 
+    Accepts either a merged (layout-ordered) array or an already-split
+    list of per-step arrays; exposes `.steps` for cell-by-cell unrolling
+    plus the layout facts (`time_axis`, `batch_size`) and the inverse
+    operation (`merge`).  Cells only ever deal in steps — how the user
+    packed the sequence is this view's problem."""
 
-def _cells_begin_state(cells, **kwargs):
-    return sum([c.begin_state(**kwargs) for c in cells], [])
+    def __init__(self, inputs, layout):
+        assert inputs is not None
+        self.time_axis = layout.find("T")
+        if isinstance(inputs, (list, tuple)):
+            self.steps = list(inputs)
+            first = self.steps[0]
+            self.batch_size = first.shape[0] if first.ndim > 0 else 0
+        else:
+            t = inputs.shape[self.time_axis]
+            self.steps = [
+                inputs.slice_axis(self.time_axis, i, i + 1)
+                .squeeze(axis=self.time_axis) for i in range(t)]
+            self.batch_size = inputs.shape[layout.find("N")]
 
+    def merge(self, steps):
+        """Per-step outputs -> one layout-ordered array."""
+        return ndarray.stack(*steps, axis=self.time_axis)
 
-def _get_begin_state(cell, F, begin_state, inputs, batch_size):
-    if begin_state is None:
-        begin_state = cell.begin_state(func=ndarray.zeros,
-                                       batch_size=batch_size)
-    return begin_state
+    def split(self, merged):
+        """Inverse of merge (used after sequence-level ops like
+        SequenceMask that want the whole tensor at once)."""
+        return [merged.slice_axis(self.time_axis, i, i + 1)
+                .squeeze(axis=self.time_axis)
+                for i in range(len(self.steps))]
 
-
-def _format_sequence(length, inputs, layout, merge, in_layout=None):
-    """Returns (inputs, time_axis, F, batch_size)."""
-    assert inputs is not None
-    axis = layout.find("T")
-    batch_axis = layout.find("N")
-    F = ndarray
-    if isinstance(inputs, (list, tuple)):
-        batch_size = inputs[0].shape[0] if inputs[0].ndim > 0 else 0
-        if merge is True:
-            return ndarray.stack(*inputs, axis=axis), axis, F, batch_size
-        return list(inputs), axis, F, batch_size
-    batch_size = inputs.shape[batch_axis]
-    if merge is False:
-        seq = [inputs.slice_axis(axis, i, i + 1).squeeze(axis=axis)
-               for i in range(inputs.shape[axis])]
-        return seq, axis, F, batch_size
-    return inputs, axis, F, batch_size
+    def reversed_steps(self, valid_length=None):
+        """Steps in reverse time order.  With `valid_length`, each
+        batch row reverses only its first valid_length steps (padding
+        stays in place) — SequenceReverse semantics, which a plain
+        python reversal gets wrong for ragged batches."""
+        if valid_length is None:
+            return self.steps[::-1]
+        stacked = ndarray.stack(*self.steps, axis=0)  # time-major
+        rev = ndarray.SequenceReverse(stacked,
+                                      sequence_length=valid_length,
+                                      use_sequence_length=True)
+        return [rev[i] for i in range(len(self.steps))]
 
 
 class RecurrentCell(Block):
@@ -73,39 +87,29 @@ class RecurrentCell(Block):
                 info.update(kwargs)
             else:
                 info = kwargs
-            state = func(name="%sbegin_state_%d" % (self._prefix,
-                                                    self._init_counter)
-                         if "name" not in kwargs else kwargs.pop("name"),
-                         **{k: v for k, v in info.items() if k != "name"}) \
-                if False else func(**{k: v for k, v in info.items()
-                                      if k != "name"})
-            states.append(state)
+            states.append(func(**{k: v for k, v in info.items()
+                                  if k != "name"}))
         return states
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None, valid_length=None):
         self.reset()
-        inputs, axis, F, batch_size = _format_sequence(length, inputs, layout,
-                                                       False)
-        begin_state = _get_begin_state(self, F, begin_state, inputs,
-                                       batch_size)
-        states = begin_state
+        seq = _SeqView(inputs, layout)
+        states = begin_state if begin_state is not None else \
+            self.begin_state(func=ndarray.zeros,
+                             batch_size=seq.batch_size)
         outputs = []
-        for i in range(length):
-            output, states = self(inputs[i], states)
-            outputs.append(output)
+        for x in seq.steps[:length]:
+            out, states = self(x, states)
+            outputs.append(out)
         if valid_length is not None:
-            outputs = ndarray.stack(*outputs, axis=axis)
-            outputs = ndarray.SequenceMask(outputs,
-                                           sequence_length=valid_length,
-                                           use_sequence_length=True,
-                                           axis=axis)
-            if merge_outputs is False:
-                outputs = [outputs.slice_axis(axis, i, i + 1).squeeze(axis)
-                           for i in range(length)]
-            return outputs, states
+            masked = ndarray.SequenceMask(
+                seq.merge(outputs), sequence_length=valid_length,
+                use_sequence_length=True, axis=seq.time_axis)
+            return (seq.split(masked) if merge_outputs is False
+                    else masked), states
         if merge_outputs:
-            outputs = ndarray.stack(*outputs, axis=axis)
+            return seq.merge(outputs), states
         return outputs, states
 
     def forward(self, inputs, states):
@@ -282,25 +286,26 @@ class SequentialRNNCell(RecurrentCell):
         self.register_child(cell)
 
     def state_info(self, batch_size=0):
-        return _cells_state_info(self._children.values(), batch_size)
+        return [i for c in self._children.values()
+                for i in c.state_info(batch_size)]
 
     def begin_state(self, **kwargs):
         assert not self._modified
-        return _cells_begin_state(self._children.values(), **kwargs)
+        return [s for c in self._children.values()
+                for s in c.begin_state(**kwargs)]
 
     def __call__(self, inputs, states):
         self._counter += 1
-        next_states = []
-        p = 0
         assert all(not isinstance(cell, BidirectionalCell)
                    for cell in self._children.values())
+        next_states = []
+        p = 0
         for cell in self._children.values():
             n = len(cell.state_info())
-            state = states[p: p + n]
+            inputs, cell_next = cell(inputs, states[p:p + n])
+            next_states.extend(cell_next)
             p += n
-            inputs, state = cell(inputs, state)
-            next_states.append(state)
-        return inputs, sum(next_states, [])
+        return inputs, next_states
 
     def __len__(self):
         return len(self._children)
@@ -416,37 +421,38 @@ class BidirectionalCell(HybridRecurrentCell):
                                   "Please use unroll")
 
     def state_info(self, batch_size=0):
-        return _cells_state_info(self._children.values(), batch_size)
+        return [i for c in self._children.values()
+                for i in c.state_info(batch_size)]
 
     def begin_state(self, **kwargs):
         assert not self._modified
-        return _cells_begin_state(self._children.values(), **kwargs)
+        return [s for c in self._children.values()
+                for s in c.begin_state(**kwargs)]
 
     def unroll(self, length, inputs, begin_state=None, layout="NTC",
                merge_outputs=None, valid_length=None):
         self.reset()
-        inputs, axis, F, batch_size = _format_sequence(length, inputs, layout,
-                                                       False)
-        begin_state = _get_begin_state(self, F, begin_state, inputs,
-                                       batch_size)
-        states = begin_state
+        seq = _SeqView(inputs, layout)
+        states = begin_state if begin_state is not None else \
+            self.begin_state(func=ndarray.zeros,
+                             batch_size=seq.batch_size)
         l_cell, r_cell = self._children.values()
+        n_l = len(l_cell.state_info(seq.batch_size))
         l_outputs, l_states = l_cell.unroll(
-            length, inputs=inputs,
-            begin_state=states[:len(l_cell.state_info(batch_size))],
-            layout=layout, merge_outputs=False, valid_length=valid_length)
-        if valid_length is not None:
-            r_inputs = list(reversed(inputs))
-        else:
-            r_inputs = list(reversed(inputs))
+            length, inputs=seq.steps, begin_state=states[:n_l],
+            layout=layout, merge_outputs=False,
+            valid_length=valid_length)
+        # the right cell consumes time reversed; with valid_length each
+        # row reverses within its own valid span (ragged batches keep
+        # padding in place — a plain reversed() would feed padding first)
         r_outputs, r_states = r_cell.unroll(
-            length, inputs=r_inputs,
-            begin_state=states[len(l_cell.state_info(batch_size)):],
-            layout=layout, merge_outputs=False, valid_length=valid_length)
-        r_outputs = list(reversed(r_outputs))
+            length, inputs=seq.reversed_steps(valid_length),
+            begin_state=states[n_l:], layout=layout,
+            merge_outputs=False, valid_length=valid_length)
+        r_view = _SeqView(r_outputs, layout)
+        r_outputs = r_view.reversed_steps(valid_length)
         outputs = [ndarray.concat(l_o, r_o, dim=1)
                    for l_o, r_o in zip(l_outputs, r_outputs)]
         if merge_outputs:
-            outputs = ndarray.stack(*outputs, axis=axis)
-        states = l_states + r_states
-        return outputs, states
+            outputs = seq.merge(outputs)
+        return outputs, l_states + r_states

@@ -131,3 +131,49 @@ def test_residual_and_dropout_cells():
     dc = rnn.DropoutCell(0.5)
     out2, _ = dc(nd.ones((2, 6)), [])
     assert out2.shape == (2, 6)
+
+
+def test_bidirectional_valid_length_reverses_within_valid_span():
+    """Ragged batches: the reverse cell must consume each row's valid
+    prefix reversed (SequenceReverse semantics), not the padded tail
+    first (r4 fix; reference rnn_cell.py Bidirectional + valid_length)."""
+    import numpy as np
+
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import rnn
+
+    T, B, C, H = 4, 2, 3, 5
+    np.random.seed(0)
+    cell = rnn.BidirectionalCell(rnn.RNNCell(H, input_size=C),
+                                 rnn.RNNCell(H, input_size=C))
+    cell.initialize()
+    x = np.random.rand(T, B, C).astype(np.float32)
+    vl = nd.array(np.array([2, 4], np.float32))
+    steps = [nd.array(x[t]) for t in range(T)]
+    outs, _ = cell.unroll(T, steps, layout="TNC", merge_outputs=False,
+                          valid_length=vl)
+
+    # manual reference: forward RNN on each row's prefix; backward RNN
+    # on the reversed prefix; concat; padding rows are zero
+    l_cell, r_cell = cell._children.values()
+
+    def run(c, xs):
+        st = c.begin_state(batch_size=1, func=nd.zeros)
+        outs_ = []
+        for v in xs:
+            o, st = c(nd.array(v[None]), st)
+            outs_.append(o.asnumpy()[0])
+        return outs_
+
+    for b, n in enumerate([2, 4]):
+        l_cell.reset()
+        fwd = run(l_cell, [x[t, b] for t in range(n)])
+        r_cell.reset()
+        bwd = run(r_cell, [x[t, b] for t in reversed(range(n))])[::-1]
+        for t in range(n):
+            want = np.concatenate([fwd[t], bwd[t]])
+            np.testing.assert_allclose(outs[t].asnumpy()[b], want,
+                                       rtol=1e-5, atol=1e-5)
+        for t in range(n, T):
+            np.testing.assert_allclose(outs[t].asnumpy()[b], 0.0,
+                                       atol=1e-6)
