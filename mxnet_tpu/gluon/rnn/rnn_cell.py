@@ -41,10 +41,12 @@ class _SeqView:
 
     def split(self, merged):
         """Inverse of merge (used after sequence-level ops like
-        SequenceMask that want the whole tensor at once)."""
+        SequenceMask that want the whole tensor at once).  Uses the
+        MERGED tensor's own time size: an unroll may cover fewer steps
+        than the view holds."""
         return [merged.slice_axis(self.time_axis, i, i + 1)
                 .squeeze(axis=self.time_axis)
-                for i in range(len(self.steps))]
+                for i in range(merged.shape[self.time_axis])]
 
     def reversed_steps(self, valid_length=None):
         """Steps in reverse time order.  With `valid_length`, each
@@ -82,7 +84,6 @@ class RecurrentCell(Block):
             "directly. Call the modifier cell instead."
         states = []
         for info in self.state_info(batch_size):
-            self._init_counter += 1
             if info is not None:
                 info.update(kwargs)
             else:

@@ -177,3 +177,22 @@ def test_bidirectional_valid_length_reverses_within_valid_span():
         for t in range(n, T):
             np.testing.assert_allclose(outs[t].asnumpy()[b], 0.0,
                                        atol=1e-6)
+
+
+def test_unroll_shorter_than_provided_steps_with_valid_length():
+    """length < len(steps) with valid_length + merge_outputs=False must
+    split only the unrolled span (r4 review regression)."""
+    import numpy as np
+
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import rnn
+
+    cell = rnn.RNNCell(4, input_size=3)
+    cell.initialize()
+    steps = [nd.array(np.random.rand(2, 3).astype(np.float32))
+             for _ in range(5)]
+    vl = nd.array(np.array([2, 3], np.float32))
+    outs, _ = cell.unroll(3, steps, layout="TNC", merge_outputs=False,
+                          valid_length=vl)
+    assert len(outs) == 3
+    assert outs[0].shape == (2, 4)
