@@ -39,7 +39,7 @@ def _randint(low=0, high=1, shape=None, dtype="int32", ctx=None, **kw):
 
 
 @register("_random_exponential", uses_rng=True, num_inputs=0, differentiable=False,
-          aliases=("random_exponential",))
+          aliases=("random_exponential", "exponential"))
 def _exponential(lam=1.0, shape=None, dtype="float32", ctx=None, **kw):
     return jax.random.exponential(_random.next_key(), _shape(shape),
                                   dtype=pdtype(dtype)) / pfloat(lam, 1.0)
@@ -53,14 +53,14 @@ def _gamma(alpha=1.0, beta=1.0, shape=None, dtype="float32", ctx=None, **kw):
 
 
 @register("_random_poisson", uses_rng=True, num_inputs=0, differentiable=False,
-          aliases=("random_poisson",))
+          aliases=("random_poisson", "poisson"))
 def _poisson(lam=1.0, shape=None, dtype="float32", ctx=None, **kw):
     return jax.random.poisson(_random.next_key(), pfloat(lam, 1.0),
                               _shape(shape)).astype(pdtype(dtype))
 
 
 @register("_random_negative_binomial", uses_rng=True, num_inputs=0, differentiable=False,
-          aliases=("random_negative_binomial",))
+          aliases=("random_negative_binomial", "negative_binomial"))
 def _neg_binomial(k=1, p=1.0, shape=None, dtype="float32", ctx=None, **kw):
     lam = jax.random.gamma(_random.next_key(), pint(k, 1), _shape(shape)) \
         * (1.0 - pfloat(p, 1.0)) / pfloat(p, 1.0)
@@ -69,7 +69,9 @@ def _neg_binomial(k=1, p=1.0, shape=None, dtype="float32", ctx=None, **kw):
 
 
 @register("_random_generalized_negative_binomial", uses_rng=True, num_inputs=0,
-          differentiable=False, aliases=("random_generalized_negative_binomial",))
+          differentiable=False,
+          aliases=("random_generalized_negative_binomial",
+                   "generalized_negative_binomial"))
 def _gen_neg_binomial(mu=1.0, alpha=1.0, shape=None, dtype="float32", ctx=None, **kw):
     mu, alpha = pfloat(mu, 1.0), pfloat(alpha, 1.0)
     r = 1.0 / alpha
