@@ -90,10 +90,14 @@ bool read_all(int fd, void *buf, size_t n) {
 // worker-reported error.
 bool roundtrip(Predictor *p, uint8_t opcode, const std::string &payload,
                std::string *reply) {
+  // lengths travel little-endian on the wire (the python worker parses
+  // '<Q'); serialize explicitly so a big-endian host still speaks the
+  // documented protocol rather than its native byte order
   char head[9];
   head[0] = static_cast<char>(opcode);
   uint64_t len = payload.size();
-  memcpy(head + 1, &len, 8);
+  for (int i = 0; i < 8; ++i)
+    head[1 + i] = static_cast<char>((len >> (8 * i)) & 0xff);
   if (!write_all(p->to_worker, head, 9) ||
       (!payload.empty() &&
        !write_all(p->to_worker, payload.data(), payload.size()))) {
@@ -106,8 +110,10 @@ bool roundtrip(Predictor *p, uint8_t opcode, const std::string &payload,
     return false;
   }
   uint8_t status = static_cast<uint8_t>(rhead[0]);
-  uint64_t rlen;
-  memcpy(&rlen, rhead + 1, 8);
+  uint64_t rlen = 0;
+  for (int i = 0; i < 8; ++i)
+    rlen |= static_cast<uint64_t>(static_cast<uint8_t>(rhead[1 + i]))
+            << (8 * i);
   if (rlen > (1ull << 33)) {  // corrupted frame, not a real reply
     g_last_error = "predict worker protocol corrupt (reply length)";
     return false;
@@ -125,11 +131,25 @@ bool roundtrip(Predictor *p, uint8_t opcode, const std::string &payload,
   return true;
 }
 
+// integer fields travel little-endian ('<I'/'<Q' on the worker side);
+// serialize explicitly so big-endian hosts still speak the protocol
 void append_u32(std::string *s, uint32_t v) {
-  s->append(reinterpret_cast<const char *>(&v), 4);
+  char b[4];
+  for (int i = 0; i < 4; ++i)
+    b[i] = static_cast<char>((v >> (8 * i)) & 0xff);
+  s->append(b, 4);
 }
 void append_u64(std::string *s, uint64_t v) {
-  s->append(reinterpret_cast<const char *>(&v), 8);
+  char b[8];
+  for (int i = 0; i < 8; ++i)
+    b[i] = static_cast<char>((v >> (8 * i)) & 0xff);
+  s->append(b, 8);
+}
+uint32_t parse_u32(const char *p) {
+  uint32_t v = 0;
+  for (int i = 0; i < 4; ++i)
+    v |= static_cast<uint32_t>(static_cast<uint8_t>(p[i])) << (8 * i);
+  return v;
 }
 
 bool spawn_worker(Predictor *p) {
@@ -217,7 +237,7 @@ int mxtpu_predict_create(const char *symbol_json, const void *param_bytes,
   size_t off = 0;
   auto take_u32 = [&](uint32_t *v) {
     if (off + 4 > reply.size()) return false;
-    memcpy(v, reply.data() + off, 4);
+    *v = parse_u32(reply.data() + off);
     off += 4;
     return true;
   };
@@ -231,8 +251,8 @@ int mxtpu_predict_create(const char *symbol_json, const void *param_bytes,
                  off + 4ull * ndim <= reply.size();
       if (parse_ok) {
         p->output_shapes[i].resize(ndim);
-        memcpy(p->output_shapes[i].data(), reply.data() + off,
-               4ull * ndim);
+        for (uint32_t d = 0; d < ndim; ++d)
+          p->output_shapes[i][d] = parse_u32(reply.data() + off + 4ull * d);
         off += 4ull * ndim;
       }
     }

@@ -44,12 +44,17 @@ def rand_zipfian(true_classes, num_sampled, range_max, ctx=None):
     softmax)."""
     import math
 
+    import jax
     import numpy as np
 
+    from .. import random as _random
     from ..ndarray.ndarray import array, _as_nd
 
     log_range = math.log(range_max + 1)
-    u = np.random.random_sample(num_sampled) * log_range
+    # draw from the framework PRNG stream so mx.random.seed governs the
+    # result (ADVICE r4; _sample_unique_zipfian uses the same source)
+    u = np.asarray(jax.random.uniform(
+        _random.next_key(), (num_sampled,))).astype(np.float64) * log_range
     sampled = (np.exp(u).astype(np.int64) - 1) % range_max
 
     true_np = _as_nd(true_classes).asnumpy().astype(np.float64)

@@ -81,6 +81,7 @@ class _Ctx:
         self.initializers = initializers
         self.aux_names = set()
         self.consumed = set()
+        self.gemm_wmode = {}   # weight name -> transB it was used with
 
     def const_of(self, name, what):
         """An input that must be a compile-time constant (shape/axes/
@@ -186,10 +187,20 @@ def _i_gemm(ctx, node, ins, a, name):
                          "(fold them into the weights/bias)")
     w_name = node["input"][1]
     inits = ctx.initializers
-    num_hidden = inits[w_name].shape[0] if a.get("transB") \
-        else inits[w_name].shape[1]
-    if not a.get("transB"):
+    # transB=0 weights are stored (K, N) and FullyConnected wants (N, K);
+    # transpose once per *weight*, not per Gemm node — a weight shared by
+    # two Gemm nodes must not be transposed twice, and the initializer
+    # dict is read only after all nodes convert, so a mixed-transB share
+    # would corrupt whichever node ran first (ADVICE r4)
+    transb = bool(a.get("transB"))
+    first_use = w_name not in ctx.gemm_wmode
+    if not first_use and ctx.gemm_wmode[w_name] != transb:
+        raise MXNetError("Gemm weight %r shared with inconsistent transB"
+                         % w_name)
+    ctx.gemm_wmode[w_name] = transb
+    if not transb and first_use:
         inits[w_name] = np.ascontiguousarray(inits[w_name].T)
+    num_hidden = inits[w_name].shape[0]
     return ctx.S._invoke_sym("FullyConnected", ins,
                              {"num_hidden": int(num_hidden),
                               "no_bias": len(ins) < 3,

@@ -12,7 +12,8 @@ slicing so a hybridized consumer compiles to one fused XLA loop."""
 from __future__ import annotations
 
 from ..rnn.rnn_cell import (HybridRecurrentCell, ModifierCell,
-                            BidirectionalCell, _SeqView)
+                            BidirectionalCell, _SeqView,
+                            _states_at_valid_length)
 from ... import ndarray
 
 __all__ = ["Conv1DRNNCell", "Conv2DRNNCell", "Conv3DRNNCell",
@@ -378,11 +379,8 @@ def dynamic_unroll(cell, inputs, begin_state, drop_inputs=0, drop_outputs=0,
                                        use_sequence_length=True, axis=axis)
         # return each sample's state at its last valid step, not at the
         # last padded step
-        states = [ndarray.SequenceLast(
-                      ndarray.stack(*[s[i] for s in step_states], axis=0),
-                      sequence_length=valid_length,
-                      use_sequence_length=True)
-                  for i in range(len(states))]
+        states = _states_at_valid_length(step_states, len(states),
+                                         valid_length)
     if drop_outputs:
         outputs = ndarray.Dropout(outputs, p=drop_outputs, axes=(axis,))
     return outputs, states

@@ -12,6 +12,17 @@ __all__ = ["RecurrentCell", "HybridRecurrentCell", "RNNCell", "LSTMCell",
            "ResidualCell", "BidirectionalCell"]
 
 
+def _states_at_valid_length(step_states, n_states, valid_length):
+    """Reduce per-step states to each row's state at its last *valid*
+    step (reference rnn_cell.py:259): stack each state slot time-major
+    and take SequenceLast with the row's valid length."""
+    return [ndarray.SequenceLast(
+                ndarray.stack(*[s[i] for s in step_states], axis=0),
+                sequence_length=valid_length,
+                use_sequence_length=True)
+            for i in range(n_states)]
+
+
 class _SeqView:
     """A sequence input normalized to per-step arrays.
 
@@ -100,13 +111,20 @@ class RecurrentCell(Block):
             self.begin_state(func=ndarray.zeros,
                              batch_size=seq.batch_size)
         outputs = []
+        step_states = []   # per step, per state slot (for valid_length)
         for x in seq.steps[:length]:
             out, states = self(x, states)
             outputs.append(out)
+            if valid_length is not None:
+                step_states.append(states)
         if valid_length is not None:
             masked = ndarray.SequenceMask(
                 seq.merge(outputs), sequence_length=valid_length,
                 use_sequence_length=True, axis=seq.time_axis)
+            # each row's state at its last *valid* step, not after the
+            # padding steps
+            states = _states_at_valid_length(step_states, len(states),
+                                             valid_length)
             return (seq.split(masked) if merge_outputs is False
                     else masked), states
         if merge_outputs:

@@ -173,7 +173,19 @@ class Predictor:
             # (device jax arrays must NOT round-trip through the host)
             b = np.asarray(b)
         if self._batch_shape is None:
+            # the first observed batch fixes the compiled contract: every
+            # later batch may only shrink in the leading dim.  Make the
+            # implicit choice loud — a ragged *first* request would
+            # otherwise lock out every full-size batch (ADVICE r4);
+            # pass batch_shape=/batch_dtype= to set the contract up front.
+            import warnings
+            warnings.warn(
+                "Predictor batch contract implicitly set to %s/%s by the "
+                "first request; larger batches will be rejected — pass "
+                "batch_shape=/batch_dtype= to pin it explicitly"
+                % (tuple(b.shape), np.dtype(b.dtype)), stacklevel=3)
             self._batch_shape = tuple(b.shape)
+        if self._batch_dtype is None:
             self._batch_dtype = np.dtype(b.dtype)
         if np.dtype(b.dtype) != self._batch_dtype:
             # a silent dtype flip would recompile a second XLA program

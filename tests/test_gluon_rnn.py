@@ -196,3 +196,62 @@ def test_unroll_shorter_than_provided_steps_with_valid_length():
                           valid_length=vl)
     assert len(outs) == 3
     assert outs[0].shape == (2, 4)
+
+
+def test_unroll_valid_length_states_stop_at_last_valid_step():
+    """With valid_length, unroll must return each row's state at its
+    last *valid* step — padding timesteps must not contaminate states
+    (reference rnn_cell.py:259 SequenceLast reduction; ADVICE r4)."""
+    import numpy as np
+
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import rnn
+
+    cell = rnn.LSTMCell(5, input_size=3)
+    cell.initialize()
+    T, N = 6, 3
+    data = np.random.rand(T, N, 3).astype(np.float32)
+    steps = [nd.array(data[t]) for t in range(T)]
+    vl_np = np.array([2, 6, 4], np.float32)
+    _, states = cell.unroll(T, steps, layout="TNC",
+                            merge_outputs=False,
+                            valid_length=nd.array(vl_np))
+    # oracle: unroll each row alone to exactly its valid length
+    for row in range(N):
+        row_steps = [nd.array(data[t, row:row + 1])
+                     for t in range(int(vl_np[row]))]
+        _, row_states = cell.unroll(int(vl_np[row]), row_steps,
+                                    layout="TNC", merge_outputs=False)
+        for got, want in zip(states, row_states):
+            np.testing.assert_allclose(got.asnumpy()[row],
+                                       want.asnumpy()[0],
+                                       rtol=1e-5, atol=1e-6)
+
+
+def test_bidirectional_valid_length_states_stop_at_last_valid_step():
+    """BidirectionalCell inherits the SequenceLast state reduction via
+    its child unrolls; per-row left states must match a solo unroll of
+    the row's valid span."""
+    import numpy as np
+
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon import rnn
+
+    l_cell, r_cell = rnn.GRUCell(4, input_size=3), rnn.GRUCell(4, input_size=3)
+    bi = rnn.BidirectionalCell(l_cell, r_cell)
+    bi.initialize()
+    T, N = 5, 2
+    data = np.random.rand(T, N, 3).astype(np.float32)
+    steps = [nd.array(data[t]) for t in range(T)]
+    vl_np = np.array([3, 5], np.float32)
+    _, states = bi.unroll(T, steps, layout="TNC", merge_outputs=False,
+                          valid_length=nd.array(vl_np))
+    l_state = states[0]
+    for row in range(N):
+        row_steps = [nd.array(data[t, row:row + 1])
+                     for t in range(int(vl_np[row]))]
+        _, row_states = l_cell.unroll(int(vl_np[row]), row_steps,
+                                      layout="TNC", merge_outputs=False)
+        np.testing.assert_allclose(l_state.asnumpy()[row],
+                                   row_states[0].asnumpy()[0],
+                                   rtol=1e-5, atol=1e-6)

@@ -284,3 +284,46 @@ def test_export_rejects_training_only_output_consumers(tmp_path):
     with pytest.raises(mx.base.MXNetError):
         onnx_mxnet.export_model(bad, {}, _V.shape,
                                 onnx_file_path=str(tmp_path / "x.onnx"))
+
+
+def test_gemm_shared_weight_transposed_once(tmp_path):
+    """Two Gemm nodes sharing one transB=0 weight initializer: the
+    importer must transpose the weight once, not once per node
+    (ADVICE r4 onnx2mx _i_gemm)."""
+    from mxnet_tpu.contrib.onnx import _proto as P
+    from mxnet_tpu.contrib.onnx.mx2onnx import _tensor, _vinfo
+    from mxnet_tpu.contrib.onnx.onnx2mx import import_model
+
+    w = _RNG.rand(4, 3).astype(np.float32)   # (K, N) layout, transB=0
+    x = _RNG.rand(2, 4).astype(np.float32)
+    nodes = [
+        {"op_type": "Gemm", "input": ["x", "w"], "output": ["h"],
+         "name": "g1", "attribute": []},
+        {"op_type": "Relu", "input": ["h"], "output": ["hr"],
+         "name": "r", "attribute": []},
+        # second Gemm reuses the same weight on a (2, 4) activation —
+        # only valid if w kept its one-transpose (4, 3)->(3, 4) layout
+        {"op_type": "Gemm", "input": ["x", "w"], "output": ["y2"],
+         "name": "g2", "attribute": []},
+    ]
+    graph = {"name": "shared_gemm", "node": nodes,
+             "initializer": [_tensor("w", w)],
+             "input": [_vinfo("x", x.shape)],
+             "output": [_vinfo("hr", (2, 3)), _vinfo("y2", (2, 3))]}
+    model = {"ir_version": 7, "producer_name": "test",
+             "opset_import": [{"domain": "", "version": 13}],
+             "graph": graph}
+    path = str(tmp_path / "shared_gemm.onnx")
+    with open(path, "wb") as f:
+        f.write(P.encode(model, "ModelProto"))
+
+    sym, arg_params, aux_params = import_model(path)
+    mod = mx.mod.Module(sym, data_names=["x"], label_names=None)
+    mod.bind(data_shapes=[("x", x.shape)], for_training=False)
+    mod.set_params(arg_params, aux_params)
+    mod.forward(mx.io.DataBatch(data=[mx.nd.array(x)]), is_train=False)
+    outs = [o.asnumpy() for o in mod.get_outputs()]
+    want = x @ w
+    np.testing.assert_allclose(outs[0], np.maximum(want, 0.0),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(outs[1], want, rtol=1e-5, atol=1e-5)

@@ -99,3 +99,20 @@ def test_rand_zipfian():
     # class 0 is the most likely: ~log(2)/log(51) of draws
     p0 = (sn == 0).mean()
     assert 0.05 < p0 < 0.35
+
+
+def test_rand_zipfian_governed_by_framework_seed():
+    """rand_zipfian must draw from the framework PRNG stream so
+    mx.random.seed makes it reproducible (ADVICE r4)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    true_cls = nd.array(np.array([1.0], np.float32))
+    mx.random.seed(1234)
+    a = mx.nd.contrib.rand_zipfian(true_cls, 100, 40)[0].asnumpy()
+    mx.random.seed(1234)
+    b = mx.nd.contrib.rand_zipfian(true_cls, 100, 40)[0].asnumpy()
+    np.testing.assert_array_equal(a, b)
+    mx.random.seed(4321)
+    c = mx.nd.contrib.rand_zipfian(true_cls, 100, 40)[0].asnumpy()
+    assert not np.array_equal(a, c)

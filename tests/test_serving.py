@@ -141,3 +141,18 @@ def test_predictor_accepts_ndarray_batches():
     outs = list(pred.predict([nd.array(b), nd.array(b)]))
     ref = net(nd.array(b)).asnumpy()
     np.testing.assert_allclose(outs[0], ref, rtol=1e-5, atol=1e-5)
+
+
+def test_predictor_batch_shape_without_dtype_defaults_on_first_batch():
+    """batch_shape= alone must not brick predict: dtype defaults from
+    the first observed batch (r5 review fix)."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from mxnet_tpu.serving import Predictor
+
+    pred = Predictor(lambda x, params: x * 2.0, [],
+                     batch_shape=(4, 3))
+    b = np.ones((4, 3), np.float32)
+    out = list(pred.predict([b]))
+    np.testing.assert_allclose(out[0], b * 2.0)

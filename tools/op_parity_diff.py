@@ -41,11 +41,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 
 REF = "/root/reference/src"
 
+_ALIAS_PAT = re.compile(r'add_alias\("([A-Za-z0-9_.]+)"\)')
 _PATTERNS = [
     re.compile(r"NNVM_REGISTER_OP\(([A-Za-z0-9_.]+)\)"),
     re.compile(r"MXNET_OPERATOR_REGISTER[A-Z0-9_]*\(\s*([A-Za-z0-9_.]+)"),
     re.compile(r"MXNET_REGISTER_OP_PROPERTY\(([A-Za-z0-9_.]+)"),
-    re.compile(r'add_alias\("([A-Za-z0-9_.]+)"\)'),
+    _ALIAS_PAT,
 ]
 
 # tokens captured from macro *definitions*, not registrations
@@ -68,8 +69,10 @@ def reference_ops():
             except OSError:
                 continue
             for pat in _PATTERNS:
-                names.update(pat.findall(src))
-            alias_names.update(_PATTERNS[-1].findall(src))
+                captured = pat.findall(src)
+                names.update(captured)
+                if pat is _ALIAS_PAT:
+                    alias_names.update(captured)
     return names - _ARTIFACTS, alias_names - _ARTIFACTS
 
 
