@@ -131,8 +131,12 @@ bool roundtrip(Predictor *p, uint8_t opcode, const std::string &payload,
   return true;
 }
 
-// integer fields travel little-endian ('<I'/'<Q' on the worker side);
-// serialize explicitly so big-endian hosts still speak the protocol
+// integer framing fields travel little-endian ('<I'/'<Q' on the worker
+// side); serialize explicitly so the framing survives a big-endian
+// host.  NOTE: float tensor payloads are still shipped raw (host byte
+// order) — the full ABI remains little-endian-host-only, the explicit
+// framing just keeps the failure mode loud instead of corrupting the
+// protocol stream.
 void append_u32(std::string *s, uint32_t v) {
   char b[4];
   for (int i = 0; i < 4; ++i)

@@ -8,6 +8,11 @@
  *
  * All functions return 0 on success, -1 on failure;
  * mxtpu_predict_last_error() describes the most recent failure.
+ *
+ * Wire format: integer framing fields (opcodes, lengths, shapes) are
+ * explicitly little-endian, so framing errors stay loud everywhere;
+ * float tensor payloads are shipped in host byte order, so the ABI as
+ * a whole supports little-endian hosts only.
  */
 #ifndef MXTPU_PREDICT_H_
 #define MXTPU_PREDICT_H_

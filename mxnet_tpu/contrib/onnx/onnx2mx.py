@@ -82,6 +82,7 @@ class _Ctx:
         self.aux_names = set()
         self.consumed = set()
         self.gemm_wmode = {}   # weight name -> transB it was used with
+        self.gemm_fresh = {}   # fresh transposed-copy name -> var sym
 
     def const_of(self, name, what):
         """An input that must be a compile-time constant (shape/axes/
@@ -195,11 +196,21 @@ def _i_gemm(ctx, node, ins, a, name):
     transb = bool(a.get("transB"))
     first_use = w_name not in ctx.gemm_wmode
     if not first_use and ctx.gemm_wmode[w_name] != transb:
-        raise MXNetError("Gemm weight %r shared with inconsistent transB"
-                         % w_name)
-    ctx.gemm_wmode[w_name] = transb
-    if not transb and first_use:
-        inits[w_name] = np.ascontiguousarray(inits[w_name].T)
+        # legal ONNX: one initializer shared by Gemm nodes of differing
+        # transB.  The stored array is laid out for the first-seen
+        # orientation, so materialize its transpose under a fresh name
+        # for this node (once; later same-orientation nodes reuse it).
+        fresh = w_name + "_gemm_t"
+        if fresh not in inits:
+            inits[fresh] = np.ascontiguousarray(inits[w_name].T)
+            ctx.gemm_fresh[fresh] = ctx.S.var(fresh)
+        w_name = fresh
+        ins = [ins[0], ctx.gemm_fresh[fresh]] + list(ins[2:])
+        first_use = False
+    else:
+        ctx.gemm_wmode[w_name] = transb
+        if not transb and first_use:
+            inits[w_name] = np.ascontiguousarray(inits[w_name].T)
     num_hidden = inits[w_name].shape[0]
     return ctx.S._invoke_sym("FullyConnected", ins,
                              {"num_hidden": int(num_hidden),

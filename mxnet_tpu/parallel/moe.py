@@ -9,8 +9,17 @@ returned by the inverse all_to_all.
 
 Static shapes throughout: each device sends exactly `capacity` tokens
 to every expert (over-capacity tokens are dropped, under-capacity slots
-are masked padding — the standard top-1 switch-routing discipline), so
-one compiled program serves every step.
+are masked padding — the standard switch-routing discipline), so one
+compiled program serves every step.
+
+Routing follows the switch-transformer family: ``top_k=1`` is the
+Switch layer (gate = raw top-1 probability), ``top_k=2`` the GShard
+layer (combine weights renormalized over the chosen pair, second
+choices take capacity slots after all first choices).  Both return the
+load-balancing auxiliary loss  ``E * sum_e f_e * P_e``  (f_e = fraction
+of tokens whose first choice is expert e, P_e = mean router probability
+for e, pmean'd over the mesh axis) that training adds to the task loss
+to keep the router from collapsing onto few experts.
 """
 from __future__ import annotations
 
@@ -19,16 +28,19 @@ import functools
 __all__ = ["moe_ffn", "moe_ffn_sharded"]
 
 
-def moe_ffn(x, gate_w, w_in, w_out, axis_name="ep", capacity_factor=1.25):
-    """Top-1 switch FFN over experts sharded along `axis_name`.
+def moe_ffn(x, gate_w, w_in, w_out, axis_name="ep", capacity_factor=1.25,
+            top_k=1):
+    """Top-k switch FFN over experts sharded along `axis_name`.
 
     Per-device arguments (inside shard_map/pmap):
       x: (tokens, d_model) this device's token shard
       gate_w: (d_model, n_experts) router weights (replicated)
       w_in: (1, d_model, d_hidden) THIS device's expert up-projection
       w_out: (1, d_hidden, d_model) THIS device's expert down-projection
-    Returns (tokens, d_model): expert outputs scaled by the gate
-    probability (dropped tokens contribute zero, residual-style).
+    Returns ``(out, aux_loss)``:
+      out: (tokens, d_model) expert outputs scaled by the gate weight
+        (dropped tokens contribute zero, residual-style)
+      aux_loss: scalar load-balancing loss, identical on every device.
     """
     import jax
     import jax.numpy as jnp
@@ -36,25 +48,44 @@ def moe_ffn(x, gate_w, w_in, w_out, axis_name="ep", capacity_factor=1.25):
 
     n_exp = lax.psum(1, axis_name)
     T, D = x.shape
-    capacity = max(1, int(capacity_factor * T / n_exp))
+    capacity = max(1, int(capacity_factor * top_k * T / n_exp))
 
-    # --- route: one expert per token
+    # --- route: top_k experts per token
     logits = x @ gate_w                      # (T, E)
     probs = jax.nn.softmax(logits, axis=-1)
-    expert = jnp.argmax(probs, axis=-1)      # (T,)
-    gate = jnp.take_along_axis(probs, expert[:, None], axis=1)[:, 0]
+    topk_probs, topk_idx = lax.top_k(probs, top_k)   # (T, k)
+    if top_k == 1:
+        combine = topk_probs                 # Switch: raw probability
+    else:
+        combine = topk_probs / topk_probs.sum(-1, keepdims=True)
 
-    # --- position of each token within its expert's send buffer; tokens
-    # past capacity are dropped (mask instead of dynamic shapes)
-    onehot = jax.nn.one_hot(expert, n_exp, dtype=jnp.int32)   # (T, E)
-    pos = jnp.cumsum(onehot, axis=0) - 1                      # (T, E)
-    slot = jnp.take_along_axis(pos, expert[:, None], axis=1)[:, 0]
-    keep = slot < capacity
+    # --- load-balancing aux loss (Switch eq. 4, global over the axis)
+    f_local = jnp.mean(jax.nn.one_hot(topk_idx[:, 0], n_exp,
+                                      dtype=probs.dtype), axis=0)
+    p_local = jnp.mean(probs, axis=0)
+    f = lax.pmean(f_local, axis_name)
+    p = lax.pmean(p_local, axis_name)
+    aux = n_exp * jnp.sum(f * p)
+
+    # --- capacity slots in rank-priority order: every token's first
+    # choice is seated before any second choice (GShard discipline)
+    slots, keeps = [], []
+    counts = jnp.zeros((n_exp,), jnp.int32)
+    for r in range(top_k):
+        oh = jax.nn.one_hot(topk_idx[:, r], n_exp, dtype=jnp.int32)
+        pos = jnp.cumsum(oh, axis=0) - 1 + counts          # (T, E)
+        slot = jnp.take_along_axis(pos, topk_idx[:, r:r + 1],
+                                   axis=1)[:, 0]
+        counts = counts + oh.sum(axis=0)
+        slots.append(slot)
+        keeps.append(slot < capacity)
 
     # --- scatter tokens into (E, capacity, D) send buffers
     send = jnp.zeros((n_exp, capacity, D), x.dtype)
-    send = send.at[expert, jnp.clip(slot, 0, capacity - 1)].add(
-        jnp.where(keep[:, None], x, 0))
+    for r in range(top_k):
+        send = send.at[topk_idx[:, r],
+                       jnp.clip(slots[r], 0, capacity - 1)].add(
+            jnp.where(keeps[r][:, None], x, 0))
 
     # --- exchange: device i's row e goes to device e (all_to_all over
     # ICI); afterwards this device holds every peer's tokens for ITS
@@ -69,27 +100,31 @@ def moe_ffn(x, gate_w, w_in, w_out, axis_name="ep", capacity_factor=1.25):
     # --- return trip + un-scatter back to token order
     back = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0,
                           tiled=False)                     # (E, cap, D)
-    out = back[expert, jnp.clip(slot, 0, capacity - 1)]
-    out = jnp.where(keep[:, None], out, 0)
-    return out * gate[:, None].astype(out.dtype)
+    out = jnp.zeros_like(x)
+    for r in range(top_k):
+        got = back[topk_idx[:, r], jnp.clip(slots[r], 0, capacity - 1)]
+        got = jnp.where(keeps[r][:, None], got, 0)
+        out = out + got * combine[:, r:r + 1].astype(out.dtype)
+    return out, aux
 
 
 def moe_ffn_sharded(mesh, x, gate_w, w_in, w_out, axis_name="ep",
-                    capacity_factor=1.25):
+                    capacity_factor=1.25, top_k=1):
     """Convenience wrapper: shard tokens and experts over `mesh`.
 
     x: (total_tokens, d_model) — token dim sharded over axis_name
     w_in: (n_experts, d_model, d_hidden), w_out: (n_experts, d_hidden,
-    d_model) — expert dim sharded; gate_w replicated."""
+    d_model) — expert dim sharded; gate_w replicated.
+    Returns ``(out, aux_loss)`` like :func:`moe_ffn`."""
+    import jax
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
 
-    fn = shard_map(
+    fn = jax.shard_map(
         functools.partial(moe_ffn, axis_name=axis_name,
-                          capacity_factor=capacity_factor),
+                          capacity_factor=capacity_factor, top_k=top_k),
         mesh=mesh,
         in_specs=(P(axis_name, None), P(None, None),
                   P(axis_name, None, None), P(axis_name, None, None)),
-        out_specs=P(axis_name, None),
-        check_rep=False)
+        out_specs=(P(axis_name, None), P()),
+        check_vma=False)
     return fn(x, gate_w, w_in, w_out)

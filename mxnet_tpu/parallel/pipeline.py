@@ -69,7 +69,6 @@ def gpipe_loss(mesh, stage_fn, loss_fn, x, num_micro, axis_name="pp"):
     import jax.numpy as jnp
     from jax import lax
     from jax.sharding import PartitionSpec as P
-    from jax.experimental.shard_map import shard_map
 
     def inner(xb):
         mbs = xb.reshape((num_micro, xb.shape[0] // num_micro)
@@ -81,6 +80,6 @@ def gpipe_loss(mesh, stage_fn, loss_fn, x, num_micro, axis_name="pp"):
         loss = jnp.where(stage == n_stage - 1, loss, 0.0)
         return lax.psum(loss, axis_name)
 
-    fn = shard_map(inner, mesh=mesh, in_specs=P(),
-                   out_specs=P(), check_rep=False)
+    fn = jax.shard_map(inner, mesh=mesh, in_specs=P(),
+                       out_specs=P(), check_vma=False)
     return fn(x)

@@ -95,7 +95,7 @@ def _sharded_fn(mesh, axis_name, causal, use_flash):
 
     spec = P(None, axis_name)
     # check_vma=False: pallas_call outputs don't carry varying-mesh-axes
-    # metadata (same reason ring_attention_sharded uses check_rep=False)
+    # metadata (same reason ring_attention_sharded uses check_vma=False)
     return jax.jit(jax.shard_map(
         _functools.partial(ulysses_attention, axis_name=axis_name,
                            causal=causal, use_flash=use_flash),

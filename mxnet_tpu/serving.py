@@ -179,11 +179,13 @@ class Predictor:
             # otherwise lock out every full-size batch (ADVICE r4);
             # pass batch_shape=/batch_dtype= to set the contract up front.
             import warnings
+            dt_note = "" if self._batch_dtype is not None \
+                else "/%s" % np.dtype(b.dtype)
             warnings.warn(
-                "Predictor batch contract implicitly set to %s/%s by the "
+                "Predictor batch contract implicitly set to %s%s by the "
                 "first request; larger batches will be rejected — pass "
-                "batch_shape=/batch_dtype= to pin it explicitly"
-                % (tuple(b.shape), np.dtype(b.dtype)), stacklevel=3)
+                "batch_shape= to pin it explicitly"
+                % (tuple(b.shape), dt_note), stacklevel=3)
             self._batch_shape = tuple(b.shape)
         if self._batch_dtype is None:
             self._batch_dtype = np.dtype(b.dtype)

@@ -327,3 +327,43 @@ def test_gemm_shared_weight_transposed_once(tmp_path):
     np.testing.assert_allclose(outs[0], np.maximum(want, 0.0),
                                rtol=1e-5, atol=1e-5)
     np.testing.assert_allclose(outs[1], want, rtol=1e-5, atol=1e-5)
+
+
+def test_gemm_shared_weight_mixed_transb(tmp_path):
+    """Legal ONNX: one initializer shared by Gemm nodes with differing
+    transB — the importer materializes a transposed copy under a fresh
+    name for the minority orientation (r5 review fix)."""
+    from mxnet_tpu.contrib.onnx import _proto as P
+    from mxnet_tpu.contrib.onnx.mx2onnx import _attr, _tensor, _vinfo
+    from mxnet_tpu.contrib.onnx.onnx2mx import import_model
+
+    w = _RNG.rand(4, 3).astype(np.float32)   # (K, N) for the transB=0 node
+    x = _RNG.rand(2, 4).astype(np.float32)   # feeds transB=0
+    z = _RNG.rand(2, 3).astype(np.float32)   # feeds transB=1 (z @ w.T)
+    nodes = [
+        {"op_type": "Gemm", "input": ["x", "w"], "output": ["y0"],
+         "name": "g0", "attribute": []},                       # x @ w
+        {"op_type": "Gemm", "input": ["z", "w"], "output": ["y1"],
+         "name": "g1", "attribute": [_attr("transB", 1)]},     # z @ w.T
+    ]
+    graph = {"name": "mixed_gemm", "node": nodes,
+             "initializer": [_tensor("w", w)],
+             "input": [_vinfo("x", x.shape), _vinfo("z", z.shape)],
+             "output": [_vinfo("y0", (2, 3)), _vinfo("y1", (2, 4))]}
+    model = {"ir_version": 7, "producer_name": "test",
+             "opset_import": [{"domain": "", "version": 13}],
+             "graph": graph}
+    path = str(tmp_path / "mixed_gemm.onnx")
+    with open(path, "wb") as f:
+        f.write(P.encode(model, "ModelProto"))
+
+    sym, arg_params, aux_params = import_model(path)
+    mod = mx.mod.Module(sym, data_names=["x", "z"], label_names=None)
+    mod.bind(data_shapes=[("x", x.shape), ("z", z.shape)],
+             for_training=False)
+    mod.set_params(arg_params, aux_params)
+    mod.forward(mx.io.DataBatch(data=[mx.nd.array(x), mx.nd.array(z)]),
+                is_train=False)
+    outs = [o.asnumpy() for o in mod.get_outputs()]
+    np.testing.assert_allclose(outs[0], x @ w, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(outs[1], z @ w.T, rtol=1e-5, atol=1e-5)
