@@ -27,30 +27,31 @@ def log(msg):
           flush=True)
 
 
-def main():
-    log("importing jax/mxnet_tpu")
+def build_trainer(batch=None):
+    """The benchmark-of-record configuration: ResNet-50 v1, bf16
+    compute + fp32 master (on accelerator), momentum SGD, one fused XLA
+    program per step, synthetic bs-`batch` data.  Shared by bench.py
+    and tools/mfu_accounting.py so the roofline accounting always
+    describes the exact program the headline number comes from.
+
+    Returns (trainer, x, y, batch, on_tpu)."""
     import jax
 
     import mxnet_tpu as mx
     from mxnet_tpu import nd, gluon, parallel
     from mxnet_tpu.gluon.model_zoo import vision
 
-    batch = int(os.environ.get("BENCH_BATCH", "256"))  # best measured MXU utilization
-    steps = int(os.environ.get("BENCH_STEPS", "40"))
-    warmup = int(os.environ.get("BENCH_WARMUP", "2"))
+    if batch is None:
+        # bs256: best measured utilization (flat 128-512, OOM at 1024 —
+        # docs/perf_notes.md MFU section)
+        batch = int(os.environ.get("BENCH_BATCH", "256"))
     on_tpu = any(d.platform != "cpu" for d in jax.devices())
     if not on_tpu:
-        # keep CPU smoke runs fast
-        batch = min(batch, 16)
-        steps = min(steps, 3)
-        warmup = 1
-    log("devices=%s batch=%d steps=%d" % (jax.devices(), batch, steps))
+        batch = min(batch, 16)  # keep CPU smoke runs fast
 
     net = vision.resnet50_v1(classes=1000)
     net.initialize(mx.init.Xavier())
-    log("model built + host-initialized")
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
-
     trainer = parallel.ShardedTrainer(
         net, lambda o, l: loss_fn(o, l), mesh=None, optimizer="sgd",
         optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
@@ -59,7 +60,21 @@ def main():
     rng = np.random.RandomState(0)
     x = nd.array(rng.rand(batch, 3, 224, 224).astype(np.float32))
     y = nd.array(rng.randint(0, 1000, batch).astype(np.float32))
-    log("synthetic batch ready; compiling train step")
+    return trainer, x, y, batch, on_tpu
+
+
+def main():
+    log("importing jax/mxnet_tpu")
+    import jax
+
+    steps = int(os.environ.get("BENCH_STEPS", "40"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "2"))
+    trainer, x, y, batch, on_tpu = build_trainer()
+    if not on_tpu:
+        steps = min(steps, 3)
+        warmup = 1
+    log("devices=%s batch=%d steps=%d" % (jax.devices(), batch, steps))
+    log("model built + host-initialized; compiling train step")
 
     # warmup/compile
     for i in range(warmup):

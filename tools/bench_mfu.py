@@ -206,8 +206,7 @@ def main():
         log("wrote %s" % args.json)
 
 
+T0 = time.time()
+
 if __name__ == "__main__":
-    T0 = time.time()
     main()
-else:
-    T0 = time.time()

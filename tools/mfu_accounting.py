@@ -28,8 +28,6 @@ import os
 import sys
 import time
 
-import numpy as np
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
@@ -47,32 +45,22 @@ def main():
                    default=int(os.environ.get("BENCH_BATCH", "256")))
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--json", default=None)
-    p.add_argument("--mfu-probe", default="docs/mfu_probe.json")
+    p.add_argument("--mfu-probe",
+                   default=os.path.join(os.path.dirname(os.path.dirname(
+                       os.path.abspath(__file__))), "docs",
+                       "mfu_probe.json"))
     args = p.parse_args()
 
     import jax
 
-    import mxnet_tpu as mx
-    from mxnet_tpu import nd, gluon, parallel
-    from mxnet_tpu.gluon.model_zoo import vision
     from mxnet_tpu import random as _random
+    import bench
 
-    on_tpu = any(d.platform != "cpu" for d in jax.devices())
-    batch = args.batch if on_tpu else min(args.batch, 8)
+    # the exact bench.py program (shared builder, same model/optimizer/
+    # dtype/synthetic data) so the accounting describes the headline run
+    trainer, x, y, batch, on_tpu = bench.build_trainer(args.batch)
     steps = args.steps if on_tpu else 2
     log("devices=%s batch=%d" % (jax.devices(), batch))
-
-    net = vision.resnet50_v1(classes=1000)
-    net.initialize(mx.init.Xavier())
-    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
-    trainer = parallel.ShardedTrainer(
-        net, lambda o, l: loss_fn(o, l), mesh=None, optimizer="sgd",
-        optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
-        dtype=jax.numpy.bfloat16 if on_tpu else None)
-
-    rng = np.random.RandomState(0)
-    x = nd.array(rng.rand(batch, 3, 224, 224).astype(np.float32))
-    y = nd.array(rng.randint(0, 1000, batch).astype(np.float32))
 
     loss = trainer.step([x], y)  # compile + init
     log("warmup done (loss=%.3f)" % float(loss))
@@ -100,7 +88,11 @@ def main():
         % (secs * 1e3, img_s, lv))
 
     ceilings = {}
-    if os.path.exists(args.mfu_probe):
+    if not os.path.exists(args.mfu_probe):
+        log("WARNING: probe artifact %s not found — emitting raw "
+            "counters WITHOUT the roofline verdict (run "
+            "tools/bench_mfu.py first)" % args.mfu_probe)
+    else:
         with open(args.mfu_probe) as f:
             probe = json.load(f)
         ceilings = {
