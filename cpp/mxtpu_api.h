@@ -1,0 +1,121 @@
+/* C train/NDArray ABI for mxnet_tpu.
+ *
+ * Reference parity: the core of include/mxnet/c_api.h (NDArray CRUD,
+ * MXImperativeInvoke, symbol load/infer-shape, executor bind/forward/
+ * backward) — the subset a cpp-package-style client needs to TRAIN a
+ * model, complementing the predict-only surface in mxtpu_predict.h.
+ * The implementation (mxtpu_api.cc) drives a forked
+ * `python -m mxnet_tpu.api_worker` over pipes; see that module's
+ * docstring for the protocol and the worker-process design rationale.
+ *
+ * All functions return 0 on success, -1 on failure;
+ * mxtpu_api_last_error() describes the most recent failure.  Handles
+ * are opaque u64 ids scoped to their session.  Tensor payloads are
+ * host byte order (little-endian hosts only, like the predict ABI);
+ * framing integers are explicitly little-endian.
+ */
+#ifndef MXTPU_API_H_
+#define MXTPU_API_H_
+
+#include <stddef.h>
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void *MXTPUSessionHandle;
+typedef uint64_t MXTPUHandle; /* ndarray / symbol / executor id */
+
+/* dtype codes for ndarray create/from-data */
+#define MXTPU_DTYPE_F32 0
+#define MXTPU_DTYPE_I32 1
+
+/* -- session ---------------------------------------------------------- */
+int MXTPUSessionCreate(MXTPUSessionHandle *out);
+int MXTPUSessionFree(MXTPUSessionHandle sess);
+const char *mxtpu_api_last_error(void);
+
+/* -- ndarray ---------------------------------------------------------- */
+int MXTPUNDArrayCreate(MXTPUSessionHandle sess, const uint32_t *dims,
+                       uint32_t ndim, int dtype, int ones,
+                       MXTPUHandle *out);
+int MXTPUNDArrayFromData(MXTPUSessionHandle sess, const uint32_t *dims,
+                         uint32_t ndim, int dtype, const void *data,
+                         size_t nbytes, MXTPUHandle *out);
+/* copies the full tensor into buf (caller sizes it from the shape) */
+int MXTPUNDArrayToHost(MXTPUSessionHandle sess, MXTPUHandle h, void *buf,
+                       size_t nbytes);
+/* overwrite an existing array's contents in place (the c_api
+ * MXNDArraySyncCopyFromCPU shape); bound executors see the update */
+int MXTPUNDArrayCopyFromCPU(MXTPUSessionHandle sess, MXTPUHandle h,
+                            const void *data, size_t nbytes);
+int MXTPUNDArrayShape(MXTPUSessionHandle sess, MXTPUHandle h,
+                      uint32_t *dims, uint32_t cap, uint32_t *ndim);
+int MXTPUNDArrayFree(MXTPUSessionHandle sess, MXTPUHandle h);
+
+/* -- imperative invoke ------------------------------------------------ */
+/* invoke a registered operator by name with string attributes (the
+ * c_api MXImperativeInvoke shape); outputs come back as fresh handles.
+ * Ops with in-place semantics (e.g. sgd_update) mutate their input
+ * handles, so a bound executor sees the update. */
+int MXTPUImperativeInvoke(MXTPUSessionHandle sess, const char *op,
+                          uint32_t n_in, const MXTPUHandle *in,
+                          uint32_t n_attr, const char *const *keys,
+                          const char *const *vals, MXTPUHandle *out,
+                          uint32_t out_cap, uint32_t *n_out);
+
+/* -- symbol ----------------------------------------------------------- */
+int MXTPUSymbolFromJSON(MXTPUSessionHandle sess, const char *json,
+                        MXTPUHandle *out);
+int MXTPUSymbolFromFile(MXTPUSessionHandle sess, const char *path,
+                        MXTPUHandle *out);
+/* newline-joined argument names, NUL-terminated (truncates at cap) */
+int MXTPUSymbolListArguments(MXTPUSessionHandle sess, MXTPUHandle sym,
+                             char *buf, size_t cap);
+/* infer shapes from named input shapes.  Results are flattened
+ * (ndims[i] dims each, concatenated) in list_arguments order for args
+ * and graph-output order for outputs.  arg_cap/out_cap bound the
+ * *entry* counts (sizes of arg_ndims/out_ndims); the *_dims_cap bound
+ * the flattened dim buffers. */
+int MXTPUSymbolInferShape(MXTPUSessionHandle sess, MXTPUHandle sym,
+                          uint32_t n_provided, const char *const *names,
+                          const uint32_t *ndims,
+                          const uint32_t *dims_concat,
+                          uint32_t *arg_ndims, uint32_t arg_cap,
+                          uint32_t *arg_dims_concat,
+                          uint32_t arg_dims_cap, uint32_t *n_args,
+                          uint32_t *out_ndims, uint32_t out_cap,
+                          uint32_t *out_dims_concat,
+                          uint32_t out_dims_cap, uint32_t *n_outputs);
+int MXTPUSymbolFree(MXTPUSessionHandle sess, MXTPUHandle sym);
+
+/* -- executor --------------------------------------------------------- */
+/* with_grad != 0 allocates a zeroed gradient array for every bound
+ * argument (grad_req "write"); 0 binds for inference. */
+int MXTPUExecutorBind(MXTPUSessionHandle sess, MXTPUHandle sym,
+                      uint32_t n_args, const char *const *arg_names,
+                      const MXTPUHandle *arg_handles, uint32_t n_aux,
+                      const char *const *aux_names,
+                      const MXTPUHandle *aux_handles, int with_grad,
+                      MXTPUHandle *out);
+int MXTPUExecutorForward(MXTPUSessionHandle sess, MXTPUHandle exec,
+                         int is_train, MXTPUHandle *outputs,
+                         uint32_t cap, uint32_t *n_out);
+/* n_heads == 0: loss-op semantics (ones_like head gradients) */
+int MXTPUExecutorBackward(MXTPUSessionHandle sess, MXTPUHandle exec,
+                          uint32_t n_heads, const MXTPUHandle *heads);
+/* gradient array for a bound argument; the handle stays valid across
+ * backward calls (the executor rebinds it in place) */
+int MXTPUExecutorArgGrad(MXTPUSessionHandle sess, MXTPUHandle exec,
+                         const char *arg_name, MXTPUHandle *out);
+int MXTPUExecutorFree(MXTPUSessionHandle sess, MXTPUHandle exec);
+
+/* -- misc ------------------------------------------------------------- */
+int MXTPURandomSeed(MXTPUSessionHandle sess, uint64_t seed);
+
+#ifdef __cplusplus
+} /* extern "C" */
+#endif
+
+#endif /* MXTPU_API_H_ */

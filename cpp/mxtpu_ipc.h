@@ -1,0 +1,222 @@
+/* Shared worker-process IPC layer for the mxtpu C ABIs
+ * (mxtpu_predict.cc and mxtpu_api.cc).
+ *
+ * Framing: request = u8 opcode | u64 len | payload; response =
+ * u8 status | u64 len | payload.  Integer framing fields travel
+ * explicitly little-endian ('<I'/'<Q' on the python worker side) so
+ * the framing survives a big-endian host; tensor payloads are shipped
+ * raw (host byte order), so the full ABIs remain little-endian-host-
+ * only — the explicit framing just keeps the failure mode loud
+ * instead of corrupting the protocol stream.
+ */
+#ifndef MXTPU_IPC_H_
+#define MXTPU_IPC_H_
+
+#include <errno.h>
+#include <pthread.h>
+#include <signal.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+#include <sys/types.h>
+#include <sys/wait.h>
+#include <unistd.h>
+
+#include <string>
+
+namespace mxtpu_ipc {
+
+struct Worker {
+  pid_t pid = -1;
+  int to_worker = -1;    // write end
+  int from_worker = -1;  // read end
+};
+
+/* A dead worker must surface as EPIPE/-1, not kill the host app with
+ * SIGPIPE: block the signal on this thread for the write's duration
+ * and consume any pending instance. */
+class ScopedSigpipeBlock {
+ public:
+  ScopedSigpipeBlock() {
+    sigemptyset(&set_);
+    sigaddset(&set_, SIGPIPE);
+    blocked_ = pthread_sigmask(SIG_BLOCK, &set_, &old_) == 0;
+  }
+  ~ScopedSigpipeBlock() {
+    if (!blocked_) return;
+    struct timespec zero = {0, 0};
+    while (sigtimedwait(&set_, nullptr, &zero) > 0) {
+    }
+    pthread_sigmask(SIG_SETMASK, &old_, nullptr);
+  }
+
+ private:
+  sigset_t set_, old_;
+  bool blocked_ = false;
+};
+
+inline bool write_all(int fd, const void *buf, size_t n) {
+  ScopedSigpipeBlock guard;
+  const char *p = static_cast<const char *>(buf);
+  while (n) {
+    ssize_t w = write(fd, p, n);
+    if (w < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    p += w;
+    n -= static_cast<size_t>(w);
+  }
+  return true;
+}
+
+inline bool read_all(int fd, void *buf, size_t n) {
+  char *p = static_cast<char *>(buf);
+  while (n) {
+    ssize_t r = read(fd, p, n);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return false;
+    }
+    if (r == 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+inline void append_u32(std::string *s, uint32_t v) {
+  char b[4];
+  for (int i = 0; i < 4; ++i)
+    b[i] = static_cast<char>((v >> (8 * i)) & 0xff);
+  s->append(b, 4);
+}
+
+inline void append_u64(std::string *s, uint64_t v) {
+  char b[8];
+  for (int i = 0; i < 8; ++i)
+    b[i] = static_cast<char>((v >> (8 * i)) & 0xff);
+  s->append(b, 8);
+}
+
+inline uint32_t parse_u32(const char *p) {
+  uint32_t v = 0;
+  for (int i = 0; i < 4; ++i)
+    v |= static_cast<uint32_t>(static_cast<uint8_t>(p[i])) << (8 * i);
+  return v;
+}
+
+inline uint64_t parse_u64(const char *p) {
+  uint64_t v = 0;
+  for (int i = 0; i < 8; ++i)
+    v |= static_cast<uint64_t>(static_cast<uint8_t>(p[i])) << (8 * i);
+  return v;
+}
+
+/* fork/exec `python -m <module>` with stdin/stdout wired to the pipes;
+ * MXTPU_PYTHON overrides the interpreter. */
+inline bool spawn_worker(const char *module, Worker *w,
+                         std::string *err) {
+  int in_pipe[2], out_pipe[2];
+  if (pipe(in_pipe) != 0) {
+    *err = "pipe() failed";
+    return false;
+  }
+  if (pipe(out_pipe) != 0) {
+    *err = "pipe() failed";
+    close(in_pipe[0]);
+    close(in_pipe[1]);
+    return false;
+  }
+  pid_t pid = fork();
+  if (pid < 0) {
+    *err = "fork() failed";
+    close(in_pipe[0]);
+    close(in_pipe[1]);
+    close(out_pipe[0]);
+    close(out_pipe[1]);
+    return false;
+  }
+  if (pid == 0) {  // child: stdin <- in_pipe, stdout -> out_pipe
+    dup2(in_pipe[0], 0);
+    dup2(out_pipe[1], 1);
+    close(in_pipe[0]);
+    close(in_pipe[1]);
+    close(out_pipe[0]);
+    close(out_pipe[1]);
+    const char *py = getenv("MXTPU_PYTHON");
+    if (!py) py = "python3";
+    execlp(py, py, "-m", module, static_cast<char *>(nullptr));
+    perror("execlp worker module");
+    _exit(127);
+  }
+  close(in_pipe[0]);
+  close(out_pipe[1]);
+  w->pid = pid;
+  w->to_worker = in_pipe[1];
+  w->from_worker = out_pipe[0];
+  return true;
+}
+
+/* Send the CLOSE frame, close the pipes, and reap the worker. */
+inline void shutdown_worker(Worker *w) {
+  if (w->to_worker >= 0) {
+    char head[9] = {0};  // opcode 0 = CLOSE, zero length
+    write_all(w->to_worker, head, 9);
+    close(w->to_worker);
+    w->to_worker = -1;
+  }
+  if (w->from_worker >= 0) {
+    close(w->from_worker);
+    w->from_worker = -1;
+  }
+  if (w->pid > 0) {
+    int status = 0;
+    waitpid(w->pid, &status, 0);
+    w->pid = -1;
+  }
+}
+
+/* One request/response round-trip; on failure fills *err. */
+inline bool roundtrip(const Worker &w, uint8_t opcode,
+                      const std::string &payload, std::string *reply,
+                      std::string *err, const char *who) {
+  char head[9];
+  head[0] = static_cast<char>(opcode);
+  uint64_t len = payload.size();
+  for (int i = 0; i < 8; ++i)
+    head[1 + i] = static_cast<char>((len >> (8 * i)) & 0xff);
+  if (!write_all(w.to_worker, head, 9) ||
+      (!payload.empty() &&
+       !write_all(w.to_worker, payload.data(), payload.size()))) {
+    *err = std::string(who) + " worker pipe write failed";
+    return false;
+  }
+  char rhead[9];
+  if (!read_all(w.from_worker, rhead, 9)) {
+    *err = std::string(who) + " worker died (pipe closed)";
+    return false;
+  }
+  uint8_t status = static_cast<uint8_t>(rhead[0]);
+  uint64_t rlen = parse_u64(rhead + 1);
+  if (rlen > (1ull << 33)) {  // corrupted frame, not a real reply
+    *err = std::string(who) + " worker protocol corrupt (reply length)";
+    return false;
+  }
+  std::string body(rlen, '\0');
+  if (rlen && !read_all(w.from_worker, &body[0], rlen)) {
+    *err = std::string(who) + " worker reply truncated";
+    return false;
+  }
+  if (status != 0) {
+    *err = std::string(who) + " worker error: " + body;
+    return false;
+  }
+  if (reply) *reply = std::move(body);
+  return true;
+}
+
+}  // namespace mxtpu_ipc
+
+#endif  // MXTPU_IPC_H_

@@ -21,182 +21,30 @@
 #include <string>
 #include <vector>
 
+#include "mxtpu_ipc.h"
+
 namespace {
+
+using mxtpu_ipc::append_u32;
+using mxtpu_ipc::append_u64;
+using mxtpu_ipc::parse_u32;
 
 thread_local std::string g_last_error;
 
 struct Predictor {
-  pid_t pid = -1;
-  int to_worker = -1;    // write end
-  int from_worker = -1;  // read end
+  mxtpu_ipc::Worker w;
   std::vector<std::vector<uint32_t>> output_shapes;
 };
 
-// A dead worker must surface as EPIPE/-1, not kill the host app with
-// SIGPIPE: block the signal on this thread for the write's duration
-// and consume any pending instance.
-class ScopedSigpipeBlock {
- public:
-  ScopedSigpipeBlock() {
-    sigemptyset(&set_);
-    sigaddset(&set_, SIGPIPE);
-    blocked_ = pthread_sigmask(SIG_BLOCK, &set_, &old_) == 0;
-  }
-  ~ScopedSigpipeBlock() {
-    if (!blocked_) return;
-    struct timespec zero = {0, 0};
-    while (sigtimedwait(&set_, nullptr, &zero) > 0) {
-    }
-    pthread_sigmask(SIG_SETMASK, &old_, nullptr);
-  }
-
- private:
-  sigset_t set_, old_;
-  bool blocked_ = false;
-};
-
-bool write_all(int fd, const void *buf, size_t n) {
-  ScopedSigpipeBlock guard;
-  const char *p = static_cast<const char *>(buf);
-  while (n) {
-    ssize_t w = write(fd, p, n);
-    if (w < 0) {
-      if (errno == EINTR) continue;
-      return false;
-    }
-    p += w;
-    n -= static_cast<size_t>(w);
-  }
-  return true;
+bool spawn_worker(Predictor *p) {
+  return mxtpu_ipc::spawn_worker("mxnet_tpu.predict_worker", &p->w,
+                                 &g_last_error);
 }
 
-bool read_all(int fd, void *buf, size_t n) {
-  char *p = static_cast<char *>(buf);
-  while (n) {
-    ssize_t r = read(fd, p, n);
-    if (r < 0) {
-      if (errno == EINTR) continue;
-      return false;
-    }
-    if (r == 0) return false;
-    p += r;
-    n -= static_cast<size_t>(r);
-  }
-  return true;
-}
-
-// request = u8 opcode | u64 len | payload ; response = u8 status | u64
-// len | payload.  Returns false (with g_last_error set) on transport or
-// worker-reported error.
 bool roundtrip(Predictor *p, uint8_t opcode, const std::string &payload,
                std::string *reply) {
-  // lengths travel little-endian on the wire (the python worker parses
-  // '<Q'); serialize explicitly so a big-endian host still speaks the
-  // documented protocol rather than its native byte order
-  char head[9];
-  head[0] = static_cast<char>(opcode);
-  uint64_t len = payload.size();
-  for (int i = 0; i < 8; ++i)
-    head[1 + i] = static_cast<char>((len >> (8 * i)) & 0xff);
-  if (!write_all(p->to_worker, head, 9) ||
-      (!payload.empty() &&
-       !write_all(p->to_worker, payload.data(), payload.size()))) {
-    g_last_error = "predict worker pipe write failed";
-    return false;
-  }
-  char rhead[9];
-  if (!read_all(p->from_worker, rhead, 9)) {
-    g_last_error = "predict worker died (pipe closed)";
-    return false;
-  }
-  uint8_t status = static_cast<uint8_t>(rhead[0]);
-  uint64_t rlen = 0;
-  for (int i = 0; i < 8; ++i)
-    rlen |= static_cast<uint64_t>(static_cast<uint8_t>(rhead[1 + i]))
-            << (8 * i);
-  if (rlen > (1ull << 33)) {  // corrupted frame, not a real reply
-    g_last_error = "predict worker protocol corrupt (reply length)";
-    return false;
-  }
-  std::string body(rlen, '\0');
-  if (rlen && !read_all(p->from_worker, &body[0], rlen)) {
-    g_last_error = "predict worker reply truncated";
-    return false;
-  }
-  if (status != 0) {
-    g_last_error = "predict worker error: " + body;
-    return false;
-  }
-  if (reply) *reply = std::move(body);
-  return true;
-}
-
-// integer framing fields travel little-endian ('<I'/'<Q' on the worker
-// side); serialize explicitly so the framing survives a big-endian
-// host.  NOTE: float tensor payloads are still shipped raw (host byte
-// order) — the full ABI remains little-endian-host-only, the explicit
-// framing just keeps the failure mode loud instead of corrupting the
-// protocol stream.
-void append_u32(std::string *s, uint32_t v) {
-  char b[4];
-  for (int i = 0; i < 4; ++i)
-    b[i] = static_cast<char>((v >> (8 * i)) & 0xff);
-  s->append(b, 4);
-}
-void append_u64(std::string *s, uint64_t v) {
-  char b[8];
-  for (int i = 0; i < 8; ++i)
-    b[i] = static_cast<char>((v >> (8 * i)) & 0xff);
-  s->append(b, 8);
-}
-uint32_t parse_u32(const char *p) {
-  uint32_t v = 0;
-  for (int i = 0; i < 4; ++i)
-    v |= static_cast<uint32_t>(static_cast<uint8_t>(p[i])) << (8 * i);
-  return v;
-}
-
-bool spawn_worker(Predictor *p) {
-  int in_pipe[2], out_pipe[2];
-  if (pipe(in_pipe) != 0) {
-    g_last_error = "pipe() failed";
-    return false;
-  }
-  if (pipe(out_pipe) != 0) {
-    g_last_error = "pipe() failed";
-    close(in_pipe[0]);
-    close(in_pipe[1]);
-    return false;
-  }
-  pid_t pid = fork();
-  if (pid < 0) {
-    g_last_error = "fork() failed";
-    close(in_pipe[0]);
-    close(in_pipe[1]);
-    close(out_pipe[0]);
-    close(out_pipe[1]);
-    return false;
-  }
-  if (pid == 0) {  // child: stdin <- in_pipe, stdout -> out_pipe
-    dup2(in_pipe[0], 0);
-    dup2(out_pipe[1], 1);
-    close(in_pipe[0]);
-    close(in_pipe[1]);
-    close(out_pipe[0]);
-    close(out_pipe[1]);
-    const char *py = getenv("MXTPU_PYTHON");
-    if (!py) py = "python3";
-    execlp(py, py, "-m", "mxnet_tpu.predict_worker",
-           static_cast<char *>(nullptr));
-    perror("execlp mxnet_tpu.predict_worker");
-    _exit(127);
-  }
-  close(in_pipe[0]);
-  close(out_pipe[1]);
-  p->pid = pid;
-  p->to_worker = in_pipe[1];
-  p->from_worker = out_pipe[0];
-  return true;
+  return mxtpu_ipc::roundtrip(p->w, opcode, payload, reply,
+                              &g_last_error, "predict");
 }
 
 }  // namespace
@@ -330,16 +178,7 @@ int mxtpu_predict_reload_params(MXTPUPredictorHandle h,
 void mxtpu_predict_free(MXTPUPredictorHandle h) {
   Predictor *p = static_cast<Predictor *>(h);
   if (!p) return;
-  if (p->to_worker >= 0) {
-    char head[9] = {0};  // opcode 0 = CLOSE, len 0
-    write_all(p->to_worker, head, 9);
-    close(p->to_worker);
-  }
-  if (p->from_worker >= 0) close(p->from_worker);
-  if (p->pid > 0) {
-    int status;
-    waitpid(p->pid, &status, 0);
-  }
+  mxtpu_ipc::shutdown_worker(&p->w);
   delete p;
 }
 

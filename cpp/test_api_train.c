@@ -1,0 +1,174 @@
+/* C training client for the train/NDArray ABI (mxtpu_api.h) —
+ * reference parity: a cpp-package-style client (cpp-package/example/
+ * mlp.cpp shape) driving a full train loop from plain C: symbol load,
+ * infer-shape, executor bind with gradients, forward/backward, and
+ * in-place sgd_update via imperative invoke.
+ *
+ * Usage: test_api_train <mlp_symbol.json>
+ * Trains y = relu(x W1 + b1) W2 + b2 against a linear target with MSE
+ * (LinearRegressionOutput) on synthetic data; prints per-epoch loss and
+ * "TRAIN OK first=<loss0> last=<lossN>"; exits nonzero unless the loss
+ * fell by 10x.
+ */
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+
+#include "mxtpu_api.h"
+
+#define BATCH 32
+#define DIN 8
+#define DH 16
+#define DOUT 1
+#define STEPS 150
+
+static unsigned long rng_state = 12345;
+static float frand(void) { /* deterministic LCG in [-0.5, 0.5) */
+  rng_state = rng_state * 6364136223846793005UL + 1442695040888963407UL;
+  return ((rng_state >> 33) & 0xffffff) / (float)0x1000000 - 0.5f;
+}
+
+static void die(const char *what) {
+  fprintf(stderr, "FAIL %s: %s\n", what, mxtpu_api_last_error());
+  exit(1);
+}
+
+int main(int argc, char **argv) {
+  if (argc != 2) {
+    fprintf(stderr, "usage: %s mlp_symbol.json\n", argv[0]);
+    return 2;
+  }
+
+  MXTPUSessionHandle sess;
+  if (MXTPUSessionCreate(&sess) != 0) die("session");
+  if (MXTPURandomSeed(sess, 7) != 0) die("seed");
+
+  MXTPUHandle sym;
+  if (MXTPUSymbolFromFile(sess, argv[1], &sym) != 0) die("symbol load");
+
+  char args_buf[1024];
+  if (MXTPUSymbolListArguments(sess, sym, args_buf, sizeof(args_buf)))
+    die("list args");
+  printf("ARGS %s\n", args_buf);
+
+  /* infer shapes from the data input alone */
+  const char *in_names[] = {"data", "label"};
+  uint32_t in_ndims[] = {2, 2};
+  uint32_t in_dims[] = {BATCH, DIN, BATCH, DOUT};
+  uint32_t arg_ndims[16], arg_dims[64], n_args = 0;
+  uint32_t out_ndims[4], out_dims[16], n_outs = 0;
+  if (MXTPUSymbolInferShape(sess, sym, 2, in_names, in_ndims, in_dims,
+                            arg_ndims, 16, arg_dims, 64, &n_args,
+                            out_ndims, 4, out_dims, 16, &n_outs) != 0)
+    die("infer shape");
+  printf("INFER n_args=%u n_outs=%u\n", n_args, n_outs);
+
+  /* synthetic regression task: y = sum(x) * 0.5 */
+  float xbuf[BATCH * DIN], ybuf[BATCH * DOUT];
+
+  /* parameters: small random init on the host */
+  float w1[DH * DIN], b1[DH], w2[DOUT * DH], b2[DOUT];
+  for (int i = 0; i < DH * DIN; ++i) w1[i] = frand() * 0.6f;
+  for (int i = 0; i < DH; ++i) b1[i] = 0.0f;
+  for (int i = 0; i < DOUT * DH; ++i) w2[i] = frand() * 0.6f;
+  for (int i = 0; i < DOUT; ++i) b2[i] = 0.0f;
+
+  uint32_t d_x[] = {BATCH, DIN}, d_y[] = {BATCH, DOUT};
+  uint32_t d_w1[] = {DH, DIN}, d_b1[] = {DH};
+  uint32_t d_w2[] = {DOUT, DH}, d_b2[] = {DOUT};
+  MXTPUHandle h_x, h_y, h_w1, h_b1, h_w2, h_b2;
+  if (MXTPUNDArrayCreate(sess, d_x, 2, MXTPU_DTYPE_F32, 0, &h_x) ||
+      MXTPUNDArrayCreate(sess, d_y, 2, MXTPU_DTYPE_F32, 0, &h_y) ||
+      MXTPUNDArrayFromData(sess, d_w1, 2, MXTPU_DTYPE_F32, w1,
+                           sizeof(w1), &h_w1) ||
+      MXTPUNDArrayFromData(sess, d_b1, 1, MXTPU_DTYPE_F32, b1,
+                           sizeof(b1), &h_b1) ||
+      MXTPUNDArrayFromData(sess, d_w2, 2, MXTPU_DTYPE_F32, w2,
+                           sizeof(w2), &h_w2) ||
+      MXTPUNDArrayFromData(sess, d_b2, 1, MXTPU_DTYPE_F32, b2,
+                           sizeof(b2), &h_b2))
+    die("ndarray create");
+
+  /* sanity: shape round-trip */
+  uint32_t shp[4], nd = 0;
+  if (MXTPUNDArrayShape(sess, h_w1, shp, 4, &nd) != 0 || nd != 2 ||
+      shp[0] != DH || shp[1] != DIN)
+    die("shape check");
+
+  const char *names[] = {"data", "fc1_weight", "fc1_bias", "fc2_weight",
+                         "fc2_bias", "label"};
+  MXTPUHandle handles[] = {h_x, h_w1, h_b1, h_w2, h_b2, h_y};
+  MXTPUHandle exe;
+  if (MXTPUExecutorBind(sess, sym, 6, names, handles, 0, NULL, NULL, 1,
+                        &exe) != 0)
+    die("bind");
+
+  MXTPUHandle g_w1, g_b1, g_w2, g_b2;
+  if (MXTPUExecutorArgGrad(sess, exe, "fc1_weight", &g_w1) ||
+      MXTPUExecutorArgGrad(sess, exe, "fc1_bias", &g_b1) ||
+      MXTPUExecutorArgGrad(sess, exe, "fc2_weight", &g_w2) ||
+      MXTPUExecutorArgGrad(sess, exe, "fc2_bias", &g_b2))
+    die("arg grad");
+
+  /* rescale_grad = 1/batch: regression-output grads are summed over
+   * the batch (the reference Trainer discipline) */
+  const char *kw[] = {"lr", "rescale_grad"};
+  const char *kv[] = {"0.5", "0.03125"};
+  MXTPUHandle weights[] = {h_w1, h_b1, h_w2, h_b2};
+  MXTPUHandle grads[] = {g_w1, g_b1, g_w2, g_b2};
+
+  float first_loss = -1.0f, loss = 0.0f;
+  for (int step = 0; step < STEPS; ++step) {
+    /* fresh synthetic batch, uploaded into new arrays bound by name */
+    for (int i = 0; i < BATCH; ++i) {
+      float s = 0.0f;
+      for (int j = 0; j < DIN; ++j) {
+        xbuf[i * DIN + j] = frand();
+        s += xbuf[i * DIN + j];
+      }
+      ybuf[i] = 0.5f * s;
+    }
+    /* refresh the bound data/label arrays in place (the c_api
+     * MXNDArraySyncCopyFromCPU discipline — the executor sees the
+     * update without rebinding) */
+    if (MXTPUNDArrayCopyFromCPU(sess, h_x, xbuf, sizeof(xbuf)) ||
+        MXTPUNDArrayCopyFromCPU(sess, h_y, ybuf, sizeof(ybuf)))
+      die("batch upload");
+
+    MXTPUHandle outs[4];
+    uint32_t n_out = 0;
+    if (MXTPUExecutorForward(sess, exe, 1, outs, 4, &n_out) != 0)
+      die("forward");
+    if (MXTPUExecutorBackward(sess, exe, 0, NULL) != 0) die("backward");
+
+    /* read the prediction to compute MSE host-side */
+    float pred[BATCH * DOUT];
+    if (MXTPUNDArrayToHost(sess, outs[0], pred, sizeof(pred)) != 0)
+      die("fetch pred");
+    loss = 0.0f;
+    for (int i = 0; i < BATCH; ++i) {
+      float d2 = pred[i] - ybuf[i];
+      loss += d2 * d2;
+    }
+    loss /= BATCH;
+    if (first_loss < 0) first_loss = loss;
+    if (step % 10 == 0) printf("STEP %d mse=%.6f\n", step, loss);
+    for (uint32_t i = 0; i < n_out; ++i) MXTPUNDArrayFree(sess, outs[i]);
+
+    /* in-place SGD on each weight through imperative invoke */
+    for (int i = 0; i < 4; ++i) {
+      MXTPUHandle upd_in[] = {weights[i], grads[i]};
+      MXTPUHandle upd_out[1];
+      uint32_t n_upd = 0;
+      if (MXTPUImperativeInvoke(sess, "sgd_update", 2, upd_in, 2, kw,
+                                kv, upd_out, 1, &n_upd) != 0)
+        die("sgd_update");
+    }
+  }
+
+  printf("TRAIN OK first=%.6f last=%.6f\n", first_loss, loss);
+  MXTPUExecutorFree(sess, exe);
+  MXTPUSymbolFree(sess, sym);
+  MXTPUSessionFree(sess);
+  return loss < first_loss / 10.0f ? 0 : 1;
+}

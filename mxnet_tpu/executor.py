@@ -150,13 +150,15 @@ class Executor:
             raise MXNetError("backward called before forward(is_train=True)")
         values, rng = self._pending
         if out_grads is None:
-            cots = tuple(
-                jnp.ones(self.arg_dict[self._arg_names[0]].shape[:0] or (),
-                         dtype=np.float32)
-                if False else None for _ in self._out_names)
-            # ones_like each output: need shapes — use eval_shape-free path:
-            outs, aux = self._jit_fwd_train(values, rng)
-            cots = tuple(jnp.ones_like(o) for o in outs)
+            # ones_like head gradients (loss-op semantics).  Shapes come
+            # from an abstract trace — executing the forward program
+            # just to learn output shapes would add a full device pass
+            # per backward (r5 review: the C ABI train loop paid it)
+            import jax
+
+            out_shapes, _aux_shapes = jax.eval_shape(
+                self._jit_fwd_train, values, rng)
+            cots = tuple(jnp.ones(o.shape, o.dtype) for o in out_shapes)
         else:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]

@@ -1,0 +1,61 @@
+"""C train/NDArray ABI end-to-end (VERDICT r4 #6; reference
+include/mxnet/c_api.h core + cpp-package mlp example).
+
+Builds an MLP symbol, then drives a FULL training run from a plain-C
+client (cpp/test_api_train.c) through libmxtpu_runtime.so and the api
+worker: symbol load + list-arguments + infer-shape, NDArray create/
+upload/fetch/in-place refresh, executor bind with gradients, forward/
+backward, and in-place sgd_update via imperative invoke.  The client
+exits nonzero unless the MSE falls 10x."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+import mxnet_tpu as mx
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CPP = os.path.join(REPO, "cpp")
+
+
+def _build():
+    r = subprocess.run(["make", "-C", CPP, "libmxtpu_runtime.so",
+                        "test_api_train"], capture_output=True, text=True)
+    if r.returncode != 0:
+        pytest.skip("native toolchain unavailable: %s" % r.stderr[-300:])
+    return os.path.join(CPP, "test_api_train")
+
+
+def _mlp_json(path):
+    data = mx.sym.var("data")
+    label = mx.sym.var("label")
+    h = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu", name="relu1")
+    o = mx.sym.FullyConnected(h, num_hidden=1, name="fc2")
+    out = mx.sym.LinearRegressionOutput(o, label, name="lro")
+    with open(path, "w") as f:
+        f.write(out.tojson())
+
+
+def test_c_client_trains_mlp_end_to_end(tmp_path):
+    client = _build()
+    sym_path = str(tmp_path / "mlp-symbol.json")
+    _mlp_json(sym_path)
+
+    env = dict(os.environ, MXTPU_PYTHON=sys.executable,
+               MXTPU_API_CPU="1", JAX_PLATFORMS="cpu",
+               PYTHONPATH=REPO + os.pathsep
+               + os.environ.get("PYTHONPATH", ""))
+    r = subprocess.run([client, sym_path], capture_output=True,
+                       text=True, env=env, timeout=600)
+    assert r.returncode == 0, r.stdout + r.stderr
+    lines = r.stdout.splitlines()
+    # the client checked the 10x improvement itself; re-assert from the
+    # reported numbers and sanity-check the intermediate surfaces
+    assert any(ln.startswith("ARGS ") for ln in lines)
+    assert any(ln.startswith("INFER n_args=6 n_outs=1") for ln in lines)
+    final = [ln for ln in lines if ln.startswith("TRAIN OK")][0]
+    first = float(final.split("first=")[1].split()[0])
+    last = float(final.split("last=")[1])
+    assert last < first / 10.0, final
