@@ -220,7 +220,17 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
                 info, attrs = node.info, node.attrs
                 rng_key = node.rng_key
 
-                def f(*arrs):
+                # static inputs (e.g. a boolean mask that defines the
+                # output shape) stay concrete: close over them instead
+                # of tracing, and give them no gradient
+                static = set(getattr(info, "static_inputs", ()) or ())
+                dyn_idx = [i for i in range(len(node.input_arrays))
+                           if i not in static]
+
+                def f(*dyn_arrs):
+                    arrs = list(node.input_arrays)
+                    for i, a in zip(dyn_idx, dyn_arrs):
+                        arrs[i] = a
                     if rng_key is None:
                         return info.fn(*arrs, **attrs)
                     # replay the forward's exact randomness (e.g. the
@@ -233,10 +243,14 @@ def backward(heads, head_grads=None, retain_graph=False, train_mode=True):
                     finally:
                         _random.pop_trace_key()
 
-                _, vjp_fn = jax.vjp(f, *node.input_arrays)
+                _, vjp_fn = jax.vjp(
+                    f, *[node.input_arrays[i] for i in dyn_idx])
                 multi = len(node.output_refs) > 1
                 cot = tuple(out_grads) if multi else out_grads[0]
-                in_grads = vjp_fn(cot)
+                dyn_grads = vjp_fn(cot)
+                in_grads = [None] * len(node.input_arrays)
+                for i, g in zip(dyn_idx, dyn_grads):
+                    in_grads[i] = g
             for ref, g in zip(node.input_refs, in_grads):
                 if ref is None or g is None:
                     continue

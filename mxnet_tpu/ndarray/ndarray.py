@@ -807,6 +807,23 @@ def array(source_array, ctx=None, dtype=None):
     npv = npv.astype(dtype_str_to_np(dtype) if isinstance(dtype, str) else dtype)
     import jax
 
+    if npv.dtype in (np.int64, np.uint64) and npv.size \
+            and not jax.config.jax_enable_x64:
+        # jax downcasts 64-bit ints to 32-bit at device_put when x64 is
+        # off; values beyond the 32-bit range would TRUNCATE silently —
+        # make it loud (the reference's large-tensor int64 support is a
+        # build flag; here it is jax_enable_x64).  Bounds differ by
+        # signedness: uint64 -> uint32 keeps values up to 2**32-1.
+        hi = 2**32 - 1 if npv.dtype == np.uint64 else 2**31 - 1
+        mx_, mn_ = int(npv.max()), int(npv.min())
+        if mx_ > hi or mn_ < -2**31:
+            import warnings
+            warnings.warn(
+                "mx.nd.array: %s values exceed the 32-bit range and "
+                "will be truncated (jax x64 is off); enable "
+                "large-tensor mode with "
+                "jax.config.update('jax_enable_x64', True) before any "
+                "array creation" % npv.dtype, stacklevel=2)
     ctx = ctx or current_context()
     return NDArray(jax.device_put(npv, ctx.jax_device), ctx)
 

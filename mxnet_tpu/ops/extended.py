@@ -360,12 +360,17 @@ def _ifft(data, compute_size=128, **kw):
     return jnp.fft.ifft(cplx, axis=-1).real.astype(jnp.float32) * n
 
 
-@register("_contrib_boolean_mask", num_inputs=2, differentiable=False)
+@register("_contrib_boolean_mask", num_inputs=2, static_inputs=(1,),
+          aliases=("boolean_mask",))
 def _boolean_mask(data, index, axis=0, **kw):
-    if isinstance(data, jax.core.Tracer):
+    # the MASK defines the output shape, so it must be concrete; data
+    # may be traced (autograd vjp closes over the mask via
+    # static_inputs, so the gradient scatters into kept rows — the
+    # reference contrib op's backward)
+    if isinstance(index, jax.core.Tracer):
         raise NotImplementedError(
-            "boolean_mask produces a data-dependent shape and cannot run "
-            "inside jit; call it eagerly")
+            "boolean_mask produces an index-dependent shape and cannot "
+            "run inside jit; call it eagerly")
     keep = np.where(np.asarray(index) != 0)[0]
     return jnp.take(data, jnp.asarray(keep), axis=pint(axis, 0))
 

@@ -45,11 +45,12 @@ class OpInfo:
     __slots__ = (
         "name", "fn", "num_inputs", "num_outputs", "differentiable",
         "mutate_inputs", "doc", "aliases", "uses_rng", "visible_outputs",
+        "static_inputs",
     )
 
     def __init__(self, name, fn, num_inputs=1, num_outputs=1,
                  differentiable=True, mutate_inputs=(), doc=None,
-                 uses_rng=False, visible_outputs=None):
+                 uses_rng=False, visible_outputs=None, static_inputs=()):
         self.name = name
         self.fn = fn
         self.num_inputs = num_inputs
@@ -63,6 +64,10 @@ class OpInfo:
         # training-internal (BatchNorm mean/var) and hidden from symbol
         # composition
         self.visible_outputs = visible_outputs
+        # indices of inputs that must stay CONCRETE under the autograd
+        # vjp replay (e.g. a boolean mask that defines the output
+        # shape); they receive no gradient
+        self.static_inputs = tuple(static_inputs)
 
     def n_outputs(self, attrs=None):
         if callable(self.num_outputs):
@@ -82,13 +87,14 @@ class OpInfo:
 
 def register(name, num_inputs=1, num_outputs=1, differentiable=True,
              mutate_inputs=(), aliases=(), uses_rng=False,
-             visible_outputs=None):
+             visible_outputs=None, static_inputs=()):
     """Decorator: register a jax-traceable function as an operator."""
 
     def _reg(fn):
         info = OpInfo(name, fn, num_inputs, num_outputs, differentiable,
                       mutate_inputs, uses_rng=uses_rng,
-                      visible_outputs=visible_outputs)
+                      visible_outputs=visible_outputs,
+                      static_inputs=static_inputs)
         if name in _OP_REGISTRY:
             raise MXNetError("op %r already registered" % name)
         _OP_REGISTRY[name] = info

@@ -682,12 +682,9 @@ def _where(condition, x, y, **kw):
     return jnp.where(condition != 0, x, y)
 
 
-@register("boolean_mask", num_inputs=2)
-def _boolean_mask(data, index, axis=0, **kw):
-    # dynamic output shape: only usable eagerly (not under jit) — parity
-    # with reference contrib op which is also dynamic (SURVEY §5 long-ctx).
-    mask = np.asarray(index) != 0
-    return jnp.compress(mask, data, axis=pint(axis, 0))
+# boolean_mask: registered once in ops/extended.py (as
+# _contrib_boolean_mask with the bare name as alias) — one guarded
+# implementation so the concrete-mask contract cannot drift.
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,327 @@
+"""Edge-case batteries for the highest-traffic ops (VERDICT r4 #4),
+modeled on the reference's test_operator.py matrices: conv
+padding/dilation/stride/groups, pooling count-include-pad variants,
+BatchNorm axis variants, indexing corner cases — cross-checked against
+torch (an independent implementation; the reference cross-checks
+against its own CPU/GPU pair the same way) plus int64 guards for the
+indexing paths."""
+import numpy as np
+import pytest
+import torch
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+_R = np.random.RandomState(21)
+
+
+def _t(x):
+    return torch.from_numpy(np.ascontiguousarray(x))
+
+
+# --- Convolution matrix ----------------------------------------------
+
+CONV_CFGS = [
+    # (in_ch, out_ch, kernel, stride, pad, dilate, groups)
+    (3, 4, (3, 3), (1, 1), (0, 0), (1, 1), 1),
+    (3, 4, (3, 3), (1, 1), (1, 1), (1, 1), 1),
+    (3, 4, (3, 3), (2, 2), (1, 1), (1, 1), 1),
+    (3, 4, (3, 3), (1, 1), (2, 2), (2, 2), 1),
+    (3, 4, (3, 3), (2, 1), (0, 1), (1, 2), 1),
+    (4, 4, (3, 3), (1, 1), (1, 1), (1, 1), 2),
+    (4, 4, (1, 1), (1, 1), (0, 0), (1, 1), 4),
+    (3, 4, (1, 1), (2, 2), (0, 0), (1, 1), 1),
+    (3, 4, (5, 3), (1, 1), (2, 1), (1, 1), 1),
+    (3, 4, (2, 2), (3, 3), (1, 1), (1, 1), 1),
+]
+
+
+@pytest.mark.parametrize("cfg", CONV_CFGS,
+                         ids=[str(i) for i in range(len(CONV_CFGS))])
+def test_convolution_matrix_vs_torch(cfg):
+    cin, cout, k, s, p, d, g = cfg
+    x = _R.randn(2, cin, 9, 9).astype(np.float32)
+    w = _R.randn(cout, cin // g, *k).astype(np.float32)
+    b = _R.randn(cout).astype(np.float32)
+    out = nd.Convolution(nd.array(x), nd.array(w), nd.array(b),
+                         kernel=k, stride=s, pad=p, dilate=d,
+                         num_filter=cout, num_group=g).asnumpy()
+    want = torch.nn.functional.conv2d(
+        _t(x), _t(w), _t(b), stride=s, padding=p, dilation=d,
+        groups=g).numpy()
+    np.testing.assert_allclose(out, want, rtol=2e-4, atol=2e-4)
+
+
+@pytest.mark.parametrize("cfg", CONV_CFGS[:6],
+                         ids=[str(i) for i in range(6)])
+def test_convolution_matrix_gradients_vs_torch(cfg):
+    cin, cout, k, s, p, d, g = cfg
+    x = _R.randn(1, cin, 7, 7).astype(np.float32)
+    w = _R.randn(cout, cin // g, *k).astype(np.float32)
+
+    from mxnet_tpu import autograd
+
+    xa, wa = nd.array(x), nd.array(w)
+    xa.attach_grad()
+    wa.attach_grad()
+    with autograd.record():
+        out = nd.Convolution(xa, wa, kernel=k, stride=s, pad=p,
+                             dilate=d, num_filter=cout, num_group=g,
+                             no_bias=True)
+        loss = (out * out).sum()
+    loss.backward()
+
+    xt, wt = _t(x).requires_grad_(True), _t(w).requires_grad_(True)
+    ot = torch.nn.functional.conv2d(xt, wt, None, stride=s, padding=p,
+                                    dilation=d, groups=g)
+    (ot * ot).sum().backward()
+    np.testing.assert_allclose(xa.grad.asnumpy(), xt.grad.numpy(),
+                               rtol=2e-3, atol=2e-3)
+    np.testing.assert_allclose(wa.grad.asnumpy(), wt.grad.numpy(),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_conv1d_and_conv3d_vs_torch():
+    x1 = _R.randn(2, 3, 11).astype(np.float32)
+    w1 = _R.randn(4, 3, 3).astype(np.float32)
+    out = nd.Convolution(nd.array(x1), nd.array(w1), kernel=(3,),
+                         num_filter=4, no_bias=True, pad=(1,),
+                         stride=(2,)).asnumpy()
+    want = torch.nn.functional.conv1d(_t(x1), _t(w1), stride=2,
+                                      padding=1).numpy()
+    np.testing.assert_allclose(out, want, rtol=2e-4, atol=2e-4)
+
+    x3 = _R.randn(1, 2, 5, 5, 5).astype(np.float32)
+    w3 = _R.randn(3, 2, 2, 2, 2).astype(np.float32)
+    out = nd.Convolution(nd.array(x3), nd.array(w3), kernel=(2, 2, 2),
+                         num_filter=3, no_bias=True).asnumpy()
+    want = torch.nn.functional.conv3d(_t(x3), _t(w3)).numpy()
+    np.testing.assert_allclose(out, want, rtol=2e-4, atol=2e-4)
+
+
+def test_deconvolution_matrix_vs_torch():
+    for s, p, adj in [((1, 1), (0, 0), (0, 0)), ((2, 2), (1, 1), (0, 0)),
+                      ((2, 2), (0, 0), (1, 1)), ((3, 2), (1, 0), (0, 1))]:
+        x = _R.randn(1, 3, 5, 5).astype(np.float32)
+        w = _R.randn(3, 4, 3, 3).astype(np.float32)
+        out = nd.Deconvolution(nd.array(x), nd.array(w), kernel=(3, 3),
+                               stride=s, pad=p, adj=adj, num_filter=4,
+                               no_bias=True).asnumpy()
+        want = torch.nn.functional.conv_transpose2d(
+            _t(x), _t(w), stride=s, padding=p,
+            output_padding=adj).numpy()
+        np.testing.assert_allclose(out, want, rtol=2e-4, atol=2e-4,
+                                   err_msg=str((s, p, adj)))
+
+
+# --- Pooling matrix ---------------------------------------------------
+
+POOL_CFGS = [
+    ("max", (2, 2), (2, 2), (0, 0), False),
+    ("max", (3, 3), (1, 1), (1, 1), False),
+    ("max", (2, 2), (1, 1), (0, 0), False),
+    ("avg", (2, 2), (2, 2), (0, 0), True),
+    ("avg", (3, 3), (1, 1), (1, 1), True),
+    ("avg", (3, 3), (1, 1), (1, 1), False),
+    ("avg", (2, 2), (2, 2), (1, 1), False),
+]
+
+
+@pytest.mark.parametrize("cfg", POOL_CFGS,
+                         ids=[str(i) for i in range(len(POOL_CFGS))])
+def test_pooling_matrix_vs_torch(cfg):
+    ptype, k, s, p, count_pad = cfg
+    x = _R.randn(2, 3, 8, 8).astype(np.float32)
+    out = nd.Pooling(nd.array(x), kernel=k, stride=s, pad=p,
+                     pool_type=ptype,
+                     count_include_pad=count_pad).asnumpy()
+    if ptype == "max":
+        want = torch.nn.functional.max_pool2d(
+            _t(x), k, stride=s, padding=p).numpy()
+    else:
+        want = torch.nn.functional.avg_pool2d(
+            _t(x), k, stride=s, padding=p,
+            count_include_pad=count_pad).numpy()
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+
+
+def test_global_pooling_vs_torch():
+    x = _R.randn(2, 3, 6, 5).astype(np.float32)
+    out = nd.Pooling(nd.array(x), global_pool=True,
+                     pool_type="avg", kernel=(1, 1)).asnumpy()
+    want = x.mean(axis=(2, 3), keepdims=True)
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+    out = nd.Pooling(nd.array(x), global_pool=True,
+                     pool_type="max", kernel=(1, 1)).asnumpy()
+    np.testing.assert_allclose(out, x.max(axis=(2, 3), keepdims=True),
+                               rtol=1e-6)
+
+
+def test_pooling_lp_norm():
+    x = np.abs(_R.randn(1, 1, 4, 4)).astype(np.float32)
+    out = nd.Pooling(nd.array(x), kernel=(2, 2), stride=(2, 2),
+                     pool_type="lp", p_value=2).asnumpy()
+    want = torch.nn.functional.lp_pool2d(_t(x), 2, 2, stride=2).numpy()
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+# --- BatchNorm axis variants -----------------------------------------
+
+@pytest.mark.parametrize("axis", [1, -1, 2])
+def test_batchnorm_axis_variants(axis):
+    """Batch statistics are computed over all axes but `axis` when
+    training (autograd.record); inference uses the moving stats."""
+    from mxnet_tpu import autograd
+
+    x = _R.randn(2, 3, 4, 5).astype(np.float32)
+    c = x.shape[axis]
+    gamma = _R.rand(c).astype(np.float32) + 0.5
+    beta = _R.randn(c).astype(np.float32)
+    mean = np.zeros(c, np.float32)
+    var = np.ones(c, np.float32)
+    with autograd.record(train_mode=True):
+        out = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                           nd.array(mean), nd.array(var), axis=axis,
+                           fix_gamma=False)
+    out = (out[0] if isinstance(out, (list, tuple)) else out).asnumpy()
+    # oracle: normalize over all axes but `axis` (training statistics)
+    ax = axis % x.ndim
+    red = tuple(i for i in range(x.ndim) if i != ax)
+    m = x.mean(axis=red, keepdims=True)
+    v = x.var(axis=red, keepdims=True)
+    shape = [1] * x.ndim
+    shape[ax] = c
+    want = (x - m) / np.sqrt(v + 1e-3) * gamma.reshape(shape) \
+        + beta.reshape(shape)
+    np.testing.assert_allclose(out, want, rtol=2e-3, atol=2e-3)
+    # inference: moving stats (zeros/ones) -> affine only
+    out_inf = nd.BatchNorm(nd.array(x), nd.array(gamma),
+                           nd.array(beta), nd.array(mean),
+                           nd.array(var), axis=axis, fix_gamma=False)
+    out_inf = (out_inf[0] if isinstance(out_inf, (list, tuple))
+               else out_inf).asnumpy()
+    want_inf = x / np.sqrt(1 + 1e-3) * gamma.reshape(shape) \
+        + beta.reshape(shape)
+    np.testing.assert_allclose(out_inf, want_inf, rtol=2e-3, atol=2e-3)
+
+
+def test_batchnorm_use_global_stats():
+    x = _R.randn(2, 3, 4, 4).astype(np.float32)
+    gamma = np.ones(3, np.float32)
+    beta = np.zeros(3, np.float32)
+    mean = _R.randn(3).astype(np.float32)
+    var = np.abs(_R.randn(3)).astype(np.float32) + 0.5
+    out = nd.BatchNorm(nd.array(x), nd.array(gamma), nd.array(beta),
+                       nd.array(mean), nd.array(var),
+                       use_global_stats=True, fix_gamma=False)
+    out = (out[0] if isinstance(out, (list, tuple)) else out).asnumpy()
+    want = (x - mean.reshape(1, 3, 1, 1)) / \
+        np.sqrt(var.reshape(1, 3, 1, 1) + 1e-3)
+    np.testing.assert_allclose(out, want, rtol=2e-4, atol=2e-4)
+
+
+# --- indexing corner cases -------------------------------------------
+
+def test_take_modes():
+    a = np.arange(12, dtype=np.float32).reshape(4, 3)
+    # clip mode (default): out-of-range indices clamp
+    idx = np.array([-1., 0., 3., 9.], np.float32)
+    out = nd.take(nd.array(a), nd.array(idx), mode="clip").asnumpy()
+    want = a[np.clip(idx.astype(int), 0, 3)]
+    np.testing.assert_array_equal(out, want)
+    # wrap mode: indices take modulo
+    out = nd.take(nd.array(a), nd.array(idx), mode="wrap").asnumpy()
+    want = a[idx.astype(int) % 4]
+    np.testing.assert_array_equal(out, want)
+
+
+def test_take_axis_variants():
+    a = _R.randn(3, 4, 5).astype(np.float32)
+    idx = np.array([[0., 2.], [2., 1.]], np.float32)
+    for axis in (0, 1, 2, -1):
+        out = nd.take(nd.array(a), nd.array(idx), axis=axis).asnumpy()
+        want = np.take(a, idx.astype(int), axis=axis)
+        np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+def test_gather_nd_corner_indices():
+    a = _R.randn(3, 4, 5).astype(np.float32)
+    # full-depth indices
+    idx = np.array([[0, 2, 1], [2, 3, 0]], np.float32).T  # (3, 2)
+    out = nd.gather_nd(nd.array(a), nd.array(idx)).asnumpy()
+    want = a[[0, 2], [2, 3], [1, 0]]
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+    # partial-depth: trailing dims come along
+    idx = np.array([[0, 2], [1, 3]], np.float32)  # (2, 2): rows+cols
+    out = nd.gather_nd(nd.array(a), nd.array(idx)).asnumpy()
+    want = a[[0, 2], [1, 3]]
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+def test_scatter_nd_roundtrip():
+    idx = np.array([[0, 2], [1, 0]], np.float32)
+    data = np.array([5., 7.], np.float32)
+    out = nd.scatter_nd(nd.array(data), nd.array(idx),
+                        shape=(3, 3)).asnumpy()
+    want = np.zeros((3, 3), np.float32)
+    want[0, 1] = 5.0
+    want[2, 0] = 7.0
+    np.testing.assert_array_equal(out, want)
+
+
+def test_slice_with_negative_bounds_and_step():
+    a = np.arange(24, dtype=np.float32).reshape(4, 6)
+    out = nd.slice(nd.array(a), begin=(1, -5), end=(3, -1)).asnumpy()
+    np.testing.assert_array_equal(out, a[1:3, -5:-1])
+    out = nd.slice(nd.array(a), begin=(3, 5), end=(0, 0),
+                   step=(-1, -2)).asnumpy()
+    np.testing.assert_array_equal(out, a[3:0:-1, 5:0:-2])
+
+
+def test_embedding_int_dtype_indices():
+    w = _R.randn(6, 3).astype(np.float32)
+    for dt in (np.float32, np.int32, np.int64):
+        idx = np.array([[0, 5], [2, 1]], dt)
+        out = nd.Embedding(nd.array(idx), nd.array(w), input_dim=6,
+                           output_dim=3).asnumpy()
+        np.testing.assert_allclose(out, w[idx.astype(int)], rtol=1e-6)
+
+
+# --- int64 guards for the indexing paths -----------------------------
+
+def test_int64_indices_preserved_within_int32_range():
+    a = _R.randn(10, 2).astype(np.float32)
+    idx64 = np.array([9, 0, 7], np.int64)
+    out = nd.take(nd.array(a), nd.array(idx64)).asnumpy()
+    np.testing.assert_allclose(out, a[idx64], rtol=1e-6)
+
+
+def test_int64_overflow_is_loud_not_silent():
+    """Values beyond int32 must WARN on the default (non-x64) build —
+    the reference gates real int64 indexing behind its large-tensor
+    build flag; ours is jax_enable_x64 (r5 guard)."""
+    import warnings
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        nd.array(np.array([2 ** 40, 1], np.int64))
+    assert any("int64" in str(x.message) and "truncat" in str(x.message)
+               for x in w), [str(x.message) for x in w]
+    # in-range int64 stays silent
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        nd.array(np.array([2 ** 20, 1], np.int64))
+    assert not any("int64" in str(x.message) for x in w)
+
+
+def test_arange_and_size_arithmetic_use_python_ints():
+    """Shape/size products must not wrap at 2^31 (they are python ints
+    host-side even though device indexing is int32)."""
+    a = nd.zeros((1, 2))
+    big = (65536, 65536)
+    # infer_shape arithmetic on virtual shapes beyond int32 must not wrap
+    s = mx.sym.var("x")
+    r = mx.sym.Reshape(s, shape=(-1,))
+    _, out_shapes, _ = r.infer_shape(x=big)
+    assert out_shapes[0] == (65536 * 65536,)
+    assert a.size == 2
