@@ -431,10 +431,6 @@ CASES = {
                                    stride1=1, stride2=1),
         {"a": _any((1, 1, 3, 3)), "b": _any((1, 1, 3, 3))},
         {"grad_nodes": ["a"], "rtol": 8e-2}),
-    "_image_to_tensor": (
-        lambda: mx.sym.square(_v()) * 255.0, D23),  # via image pipeline
-    "_image_normalize": (
-        lambda: (_v() - 0.5) / 0.25, D23),          # same lowering
 }
 
 # --- piecewise-constant ops: assert zero gradient ---------------------
@@ -442,6 +438,8 @@ ZERO_GRAD = ["ceil", "floor", "round", "rint", "fix", "trunc", "sign"]
 
 # --- differentiable ops whose gradients live in dedicated suites ------
 COVERED = {
+    "_image_to_tensor": "test_image_op_gradients in this file",
+    "_image_normalize": "test_image_op_gradients in this file",
     "SoftmaxOutput": "test_loss_head_gradients_analytic in this file",
     "LinearRegressionOutput": "test_loss_head_gradients_analytic",
     "MAERegressionOutput": "test_loss_head_gradients_analytic",
@@ -522,7 +520,6 @@ def test_numeric_gradient(op):
     build, loc = entry[0], dict(entry[1])
     kw = dict(entry[2]) if len(entry) > 2 else {}
     aux = kw.pop("aux", None)
-    kw.pop("fd_free", None)  # loss heads: backward() already correct
     sym = build()
     if sym.list_outputs() and len(sym.list_outputs()) > 1:
         sym = sym[0]
@@ -667,3 +664,27 @@ def test_index_static_gradient_eager():
     want = np.zeros((3, 4), np.float32)
     want[1:, :2] = 3.0
     np.testing.assert_allclose(data.grad.asnumpy(), want, atol=1e-6)
+
+
+def test_image_op_gradients():
+    """image.to_tensor / image.normalize gradients via eager autograd:
+    to_tensor transposes+scales by 1/255; normalize is (x-mean)/std."""
+    from mxnet_tpu import autograd, nd
+
+    x = np.ascontiguousarray(
+        (_R.rand(5, 4, 3) * 255).astype(np.float32))
+    xa = nd.array(x)
+    xa.attach_grad()
+    with autograd.record():
+        t = nd._image_to_tensor(xa)          # (C, H, W), /255
+        out = nd._image_normalize(t, mean=(0.3, 0.4, 0.5),
+                                  std=(0.2, 0.25, 0.5))
+        loss = (out * out).sum()
+    loss.backward()
+    tn = x.transpose(2, 0, 1) / 255.0
+    mean = np.array([0.3, 0.4, 0.5], np.float32).reshape(3, 1, 1)
+    std = np.array([0.2, 0.25, 0.5], np.float32).reshape(3, 1, 1)
+    o = (tn - mean) / std
+    want = (2 * o / std / 255.0).transpose(1, 2, 0)
+    np.testing.assert_allclose(xa.grad.asnumpy(), want, rtol=1e-4,
+                               atol=1e-5)

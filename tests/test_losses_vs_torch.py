@@ -1,0 +1,224 @@
+"""Gluon loss battery cross-checked against torch.nn.functional — an
+independent implementation oracle (the reference validates losses
+against hand-derived numpy in tests/python/unittest/test_loss.py:1;
+torch gives the same independence with less transcription risk).
+Covers values AND gradients, plus the weighting/batch-axis semantics
+the gluon Loss base class owns."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+F = torch.nn.functional
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+from mxnet_tpu.gluon import loss as gloss
+
+_R = np.random.RandomState(33)
+
+
+def _t(x, grad=False):
+    t = torch.from_numpy(np.ascontiguousarray(x))
+    return t.requires_grad_(True) if grad else t
+
+
+def _mx_loss_and_grad(loss_fn, pred, *args):
+    pa = nd.array(pred)
+    pa.attach_grad()
+    with autograd.record():
+        out = loss_fn(pa, *[nd.array(a) for a in args])
+        total = out.sum()
+    total.backward()
+    return out.asnumpy(), pa.grad.asnumpy()
+
+
+def test_l2_loss_vs_torch():
+    pred = _R.randn(4, 5).astype(np.float32)
+    label = _R.randn(4, 5).astype(np.float32)
+    out, g = _mx_loss_and_grad(gloss.L2Loss(), pred, label)
+    # gluon L2 = 1/2 MSE, mean over the sample axes per batch element
+    pt = _t(pred, grad=True)
+    want = 0.5 * ((pt - _t(label)) ** 2).mean(dim=1)
+    want.sum().backward()
+    np.testing.assert_allclose(out, want.detach().numpy(), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(g, pt.grad.numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_l1_loss_vs_torch():
+    pred = _R.randn(4, 5).astype(np.float32) + 0.3
+    label = _R.randn(4, 5).astype(np.float32)
+    out, g = _mx_loss_and_grad(gloss.L1Loss(), pred, label)
+    pt = _t(pred, grad=True)
+    want = (pt - _t(label)).abs().mean(dim=1)
+    want.sum().backward()
+    np.testing.assert_allclose(out, want.detach().numpy(), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(g, pt.grad.numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_softmax_ce_loss_vs_torch():
+    pred = _R.randn(6, 4).astype(np.float32)
+    label = _R.randint(0, 4, 6).astype(np.float32)
+    out, g = _mx_loss_and_grad(gloss.SoftmaxCrossEntropyLoss(), pred,
+                               label)
+    pt = _t(pred, grad=True)
+    want = F.cross_entropy(pt, _t(label).long(), reduction="none")
+    want.sum().backward()
+    np.testing.assert_allclose(out, want.detach().numpy(), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(g, pt.grad.numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_softmax_ce_loss_soft_labels_vs_torch():
+    pred = _R.randn(5, 3).astype(np.float32)
+    soft = np.abs(_R.rand(5, 3).astype(np.float32))
+    soft /= soft.sum(1, keepdims=True)
+    out, g = _mx_loss_and_grad(
+        gloss.SoftmaxCrossEntropyLoss(sparse_label=False), pred, soft)
+    pt = _t(pred, grad=True)
+    want = -(F.log_softmax(pt, dim=-1) * _t(soft)).sum(dim=-1)
+    want.sum().backward()
+    np.testing.assert_allclose(out, want.detach().numpy(), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(g, pt.grad.numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_sigmoid_bce_loss_vs_torch():
+    pred = _R.randn(4, 3).astype(np.float32)
+    label = (_R.rand(4, 3) > 0.5).astype(np.float32)
+    out, g = _mx_loss_and_grad(gloss.SigmoidBinaryCrossEntropyLoss(),
+                               pred, label)
+    pt = _t(pred, grad=True)
+    want = F.binary_cross_entropy_with_logits(
+        pt, _t(label), reduction="none").mean(dim=1)
+    want.sum().backward()
+    np.testing.assert_allclose(out, want.detach().numpy(), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(g, pt.grad.numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_kl_div_loss_vs_torch():
+    pred = _R.randn(4, 5).astype(np.float32)
+    target = np.abs(_R.rand(4, 5).astype(np.float32))
+    target /= target.sum(1, keepdims=True)
+    # gluon KLDivLoss(from_logits=False) applies log_softmax itself
+    out, g = _mx_loss_and_grad(gloss.KLDivLoss(from_logits=False), pred,
+                               target)
+    pt = _t(pred, grad=True)
+    want = F.kl_div(F.log_softmax(pt, dim=-1), _t(target),
+                    reduction="none").mean(dim=1)
+    want.sum().backward()
+    np.testing.assert_allclose(out, want.detach().numpy(), rtol=1e-5,
+                               atol=1e-6)
+    np.testing.assert_allclose(g, pt.grad.numpy(), rtol=1e-5, atol=1e-6)
+
+
+def test_huber_loss_vs_torch():
+    pred = _R.randn(4, 5).astype(np.float32) * 3
+    label = _R.randn(4, 5).astype(np.float32)
+    rho = 1.0
+    out, g = _mx_loss_and_grad(gloss.HuberLoss(rho=rho), pred, label)
+    pt = _t(pred, grad=True)
+    want = F.huber_loss(pt, _t(label), delta=rho,
+                        reduction="none").mean(dim=1)
+    want.sum().backward()
+    np.testing.assert_allclose(out, want.detach().numpy(), rtol=1e-5,
+                               atol=1e-5)
+    np.testing.assert_allclose(g, pt.grad.numpy(), rtol=1e-5, atol=1e-5)
+
+
+def test_ctc_loss_vs_torch():
+    T_, B, C = 8, 2, 5
+    pred = _R.randn(B, T_, C).astype(np.float32)
+    label = np.array([[1., 2., 0.], [3., 1., 2.]], np.float32)
+    out, g = _mx_loss_and_grad(gloss.CTCLoss(), pred, label)
+    # torch: (T, B, C) log-probs, blank=last class in gluon (C-1)...
+    # gluon CTCLoss uses blank index 0? Reference gluon CTCLoss maps to
+    # mx.nd.CTCLoss whose blank_label default is 'first'... our loss
+    # follows gluon semantics: labels are 1-based with 0 = padding?
+    # The committed test_operator_depth pins exact values; here assert
+    # finiteness + gradient shape to keep torch-semantics mapping out
+    # of scope.
+    assert out.shape == (B,)
+    assert np.isfinite(out).all()
+    assert g.shape == pred.shape and np.isfinite(g).all()
+
+
+def test_hinge_losses_vs_oracle():
+    pred = _R.randn(5, 1).astype(np.float32)
+    label = np.where(_R.rand(5, 1) > 0.5, 1.0, -1.0).astype(np.float32)
+    out, g = _mx_loss_and_grad(gloss.HingeLoss(), pred, label)
+    want = np.maximum(0.0, 1 - pred * label).mean(axis=1)
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+    out, _ = _mx_loss_and_grad(gloss.SquaredHingeLoss(), pred, label)
+    want = (np.maximum(0.0, 1 - pred * label) ** 2).mean(axis=1)
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+    out, _ = _mx_loss_and_grad(gloss.LogisticLoss(), pred, label)
+    want = np.log1p(np.exp(-pred * label)).mean(axis=1)
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-5)
+
+
+def test_triplet_loss_vs_torch():
+    a = _R.randn(4, 6).astype(np.float32)
+    p = _R.randn(4, 6).astype(np.float32)
+    n = _R.randn(4, 6).astype(np.float32)
+    out = gloss.TripletLoss(margin=1.0)(
+        nd.array(a), nd.array(p), nd.array(n)).asnumpy()
+    # gluon reference (gluon/loss.py TripletLoss): SUM over the
+    # embedding axis, then relu with the margin
+    want = np.maximum(
+        ((a - p) ** 2).sum(1) - ((a - n) ** 2).sum(1) + 1.0, 0.0)
+    np.testing.assert_allclose(out, want, rtol=1e-4, atol=1e-5)
+
+
+def test_poisson_nll_loss_vs_torch():
+    pred = _R.rand(4, 3).astype(np.float32) + 0.2
+    target = _R.poisson(2.0, (4, 3)).astype(np.float32)
+    out = gloss.PoissonNLLLoss(from_logits=False)(
+        nd.array(pred), nd.array(target)).asnumpy()
+    # gluon semantics: ONE scalar, mean over all elements (reference
+    # gluon/loss.py PoissonNLLLoss)
+    want = F.poisson_nll_loss(_t(pred), _t(target), log_input=False,
+                              full=False, reduction="mean",
+                              eps=1e-08).numpy()
+    np.testing.assert_allclose(np.asarray(out).reshape(()), want,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_cosine_embedding_loss_oracle():
+    a = _R.randn(4, 6).astype(np.float32)
+    b = _R.randn(4, 6).astype(np.float32)
+    label = np.where(_R.rand(4) > 0.5, 1.0, -1.0).astype(np.float32)
+    out = gloss.CosineEmbeddingLoss()(
+        nd.array(a), nd.array(b), nd.array(label)).asnumpy()
+    cos = (a * b).sum(1) / (np.linalg.norm(a, axis=1)
+                            * np.linalg.norm(b, axis=1) + 1e-12)
+    want = np.where(label > 0, 1 - cos, np.maximum(0.0, cos))
+    np.testing.assert_allclose(out.reshape(-1), want, rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_loss_weight_and_sample_weight_semantics():
+    """The gluon Loss base class owns weighting: a scalar `weight`
+    scales everything; `sample_weight` broadcasts per sample."""
+    pred = _R.randn(4, 5).astype(np.float32)
+    label = _R.randn(4, 5).astype(np.float32)
+    base = gloss.L2Loss()(nd.array(pred), nd.array(label)).asnumpy()
+    scaled = gloss.L2Loss(weight=3.0)(
+        nd.array(pred), nd.array(label)).asnumpy()
+    np.testing.assert_allclose(scaled, 3.0 * base, rtol=1e-6)
+    sw = np.array([1., 0., 2., 0.5], np.float32).reshape(4, 1)
+    weighted = gloss.L2Loss()(nd.array(pred), nd.array(label),
+                              nd.array(sw)).asnumpy()
+    np.testing.assert_allclose(weighted, base * sw[:, 0], rtol=1e-5)
+
+
+def test_batch_axis_variant():
+    pred = _R.randn(3, 4).astype(np.float32)
+    label = _R.randn(3, 4).astype(np.float32)
+    out = gloss.L2Loss(batch_axis=1)(
+        nd.array(pred), nd.array(label)).asnumpy()
+    want = 0.5 * ((pred - label) ** 2).mean(axis=0)
+    np.testing.assert_allclose(out, want, rtol=1e-5, atol=1e-6)
+    assert out.shape == (4,)

@@ -7,7 +7,8 @@ against its own CPU/GPU pair the same way) plus int64 guards for the
 indexing paths."""
 import numpy as np
 import pytest
-import torch
+
+torch = pytest.importorskip("torch")
 
 import mxnet_tpu as mx
 from mxnet_tpu import nd

@@ -1,0 +1,221 @@
+"""Optimizer trajectory cross-check against torch.optim — run N update
+steps on identical weights/gradient streams and compare the final
+weights (the reference pins optimizer math with numpy re-derivations in
+tests/python/unittest/test_optimizer.py:1; torch is an equivalent
+independent oracle for the shared algorithms).
+
+Semantics notes (kept wd=0 where the frameworks disagree by design):
+- mxnet SGD couples wd into the gradient (like torch SGD weight_decay)
+- mxnet Adam's bias correction folds into the lr each step (same math
+  as torch's); wd is L2-coupled like torch.Adam's
+- mxnet momentum update: m = mu*m - lr*(grad); w += m, vs torch's
+  m = mu*m + grad; w -= lr*m — identical for constant lr
+"""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+_R = np.random.RandomState(44)
+STEPS = 12
+SHAPE = (5, 4)
+
+
+def _run_mx(opt, grads, w0):
+    w = nd.array(w0.copy())
+    state = opt.create_state(0, w)
+    for g in grads:
+        opt.update(0, w, nd.array(g), state)
+    return w.asnumpy()
+
+
+def _run_torch(make_opt, grads, w0):
+    w = torch.from_numpy(w0.copy()).requires_grad_(True)
+    topt = make_opt([w])
+    for g in grads:
+        topt.zero_grad()
+        w.grad = torch.from_numpy(g.copy())
+        topt.step()
+    return w.detach().numpy()
+
+
+def _grad_stream(n=STEPS):
+    return [_R.randn(*SHAPE).astype(np.float32) for _ in range(n)]
+
+
+def test_sgd_vs_torch():
+    w0 = _R.randn(*SHAPE).astype(np.float32)
+    grads = _grad_stream()
+    got = _run_mx(mx.optimizer.SGD(learning_rate=0.05, wd=0.0), grads, w0)
+    want = _run_torch(lambda p: torch.optim.SGD(p, lr=0.05), grads, w0)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_sgd_weight_decay_vs_torch():
+    w0 = _R.randn(*SHAPE).astype(np.float32)
+    grads = _grad_stream()
+    got = _run_mx(mx.optimizer.SGD(learning_rate=0.05, wd=0.01), grads,
+                  w0)
+    want = _run_torch(
+        lambda p: torch.optim.SGD(p, lr=0.05, weight_decay=0.01), grads,
+        w0)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+def test_sgd_momentum_vs_torch():
+    w0 = _R.randn(*SHAPE).astype(np.float32)
+    grads = _grad_stream()
+    got = _run_mx(mx.optimizer.SGD(learning_rate=0.05, momentum=0.9),
+                  grads, w0)
+    want = _run_torch(
+        lambda p: torch.optim.SGD(p, lr=0.05, momentum=0.9), grads, w0)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_adam_vs_torch():
+    w0 = _R.randn(*SHAPE).astype(np.float32)
+    grads = _grad_stream()
+    got = _run_mx(mx.optimizer.Adam(learning_rate=0.01), grads, w0)
+    want = _run_torch(
+        lambda p: torch.optim.Adam(p, lr=0.01, betas=(0.9, 0.999),
+                                   eps=1e-8), grads, w0)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_rmsprop_centered_vs_torch():
+    w0 = _R.randn(*SHAPE).astype(np.float32)
+    grads = _grad_stream()
+    # mxnet RMSProp centered=True matches torch centered RMSprop
+    got = _run_mx(
+        mx.optimizer.RMSProp(learning_rate=0.01, gamma1=0.9, gamma2=0.9,
+                             epsilon=1e-8, centered=True), grads, w0)
+    want = _run_torch(
+        lambda p: torch.optim.RMSprop(p, lr=0.01, alpha=0.9, eps=1e-8,
+                                      momentum=0.9, centered=True),
+        grads, w0)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=1e-4)
+
+
+def test_adagrad_vs_torch():
+    w0 = _R.randn(*SHAPE).astype(np.float32)
+    grads = _grad_stream()
+    got = _run_mx(mx.optimizer.AdaGrad(learning_rate=0.05, eps=1e-10),
+                  grads, w0)
+    want = _run_torch(
+        lambda p: torch.optim.Adagrad(p, lr=0.05, eps=1e-10), grads, w0)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_adadelta_vs_torch():
+    w0 = _R.randn(*SHAPE).astype(np.float32)
+    grads = _grad_stream()
+    got = _run_mx(mx.optimizer.AdaDelta(rho=0.9, epsilon=1e-6), grads,
+                  w0)
+    want = _run_torch(
+        lambda p: torch.optim.Adadelta(p, lr=1.0, rho=0.9, eps=1e-6),
+        grads, w0)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_adamax_vs_torch():
+    w0 = _R.randn(*SHAPE).astype(np.float32)
+    grads = _grad_stream()
+    got = _run_mx(mx.optimizer.Adamax(learning_rate=0.004), grads, w0)
+    want = _run_torch(
+        lambda p: torch.optim.Adamax(p, lr=0.004, betas=(0.9, 0.999),
+                                     eps=1e-8), grads, w0)
+    np.testing.assert_allclose(got, want, rtol=2e-3, atol=2e-4)
+
+
+def test_nag_against_manual_recurrence():
+    """NAG has no exact torch twin (torch nesterov differs in the
+    first-step convention); pin against the reference recurrence
+    (sgd/nag mom update, optimizer.py / sgd_op): m = mu*m + g';
+    w -= lr*(g' + mu*m)."""
+    w0 = _R.randn(*SHAPE).astype(np.float32)
+    grads = _grad_stream()
+    got = _run_mx(mx.optimizer.NAG(learning_rate=0.05, momentum=0.9),
+                  grads, w0)
+    w = w0.copy()
+    m = np.zeros_like(w)
+    for g in grads:
+        m = 0.9 * m + g
+        w = w - 0.05 * (g + 0.9 * m)
+    np.testing.assert_allclose(got, w, rtol=1e-4, atol=1e-5)
+
+
+def test_signsgd_and_signum():
+    w0 = _R.randn(*SHAPE).astype(np.float32)
+    grads = _grad_stream()
+    # SignSGD is Signum with momentum forced off (momentum=0
+    # selects the signsgd_update kernel)
+    got = _run_mx(mx.optimizer.SignSGD(learning_rate=0.01,
+                                       momentum=0.0), grads, w0)
+    w = w0.copy()
+    for g in grads:
+        w = w - 0.01 * np.sign(g)
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+    got = _run_mx(mx.optimizer.Signum(learning_rate=0.01, momentum=0.9),
+                  grads, w0)
+    w = w0.copy()
+    m = np.zeros_like(w)
+    for g in grads:
+        m = 0.9 * m - (1 - 0.9) * g
+        w = w + 0.01 * np.sign(m)
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_rescale_and_clip_gradient():
+    """rescale_grad and clip_gradient apply before the update math
+    (the reference Trainer contract: rescale=1/batch)."""
+    w0 = _R.randn(*SHAPE).astype(np.float32)
+    grads = [g * 8 for g in _grad_stream(6)]
+    got = _run_mx(mx.optimizer.SGD(learning_rate=0.05,
+                                   rescale_grad=0.125,
+                                   clip_gradient=0.5), grads, w0)
+    w = w0.copy()
+    for g in grads:
+        w = w - 0.05 * np.clip(g * 0.125, -0.5, 0.5)
+    np.testing.assert_allclose(got, w, rtol=1e-5, atol=1e-6)
+
+
+def test_multi_precision_sgd_bf16():
+    """mp SGD keeps an fp32 master copy: many tiny updates must not be
+    lost to bf16 rounding."""
+    import jax.numpy as jnp
+
+    w0 = np.ones(SHAPE, np.float32)
+    w16 = nd.array(w0).astype("bfloat16")
+    opt = mx.optimizer.SGD(learning_rate=1e-3, multi_precision=True)
+    state = opt.create_state_multi_precision(0, w16)
+    g = np.full(SHAPE, 1e-3, np.float32)
+    for _ in range(100):
+        opt.update_multi_precision(0, w16, nd.array(g).astype("bfloat16"),
+                                   state)
+    # 100 updates of 1e-6 each: bf16 alone would round every one away
+    got = w16.astype("float32").asnumpy()
+    np.testing.assert_allclose(got, w0 - 1e-4, rtol=5e-3)
+
+
+def test_lr_scheduler_drives_updates():
+    from mxnet_tpu.lr_scheduler import FactorScheduler
+
+    sched = FactorScheduler(step=2, factor=0.5, base_lr=0.1)
+    opt = mx.optimizer.SGD(learning_rate=0.1, lr_scheduler=sched)
+    w = nd.array(np.zeros((1,), np.float32))
+    state = opt.create_state(0, w)
+    g = nd.array(np.ones((1,), np.float32))
+    deltas = []
+    prev = 0.0
+    for _ in range(6):
+        opt.update(0, w, g, state)
+        cur = float(w.asnumpy()[0])
+        deltas.append(prev - cur)
+        prev = cur
+    # lr halves every 2 updates: 0.1 0.1 0.05 0.05 0.025 0.025
+    np.testing.assert_allclose(
+        deltas, [0.1, 0.1, 0.05, 0.05, 0.025, 0.025], rtol=1e-5)

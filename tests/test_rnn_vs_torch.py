@@ -1,0 +1,159 @@
+"""Fused gluon RNN layers vs torch.nn — same weights, same inputs,
+same outputs (the reference cross-checks its fused RNN against cuDNN
+and against cell-by-cell unrolls; torch implements the same cuDNN
+equations, so an explicit weight transplant makes it an independent
+oracle).  Gate order is the cuDNN convention both sides: LSTM (i,f,g,o),
+GRU (r,z,n)."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.gluon import rnn as grnn
+
+_R = np.random.RandomState(55)
+
+T_, B, I, H = 5, 3, 4, 6
+
+
+def _transplant(layer, tmod, num_layers=1, bidirectional=False):
+    """Copy our layer's parameters into the torch module."""
+    dirs = ["l", "r"] if bidirectional else ["l"]
+    for li in range(num_layers):
+        for d, dname in enumerate(dirs):
+            sfx = "_reverse" if dname == "r" else ""
+            pget = lambda n: getattr(
+                layer, "%s%d_%s" % (dname, li, n)).data().asnumpy()
+            getattr(tmod, "weight_ih_l%d%s" % (li, sfx)).data = \
+                torch.from_numpy(pget("i2h_weight"))
+            getattr(tmod, "weight_hh_l%d%s" % (li, sfx)).data = \
+                torch.from_numpy(pget("h2h_weight"))
+            getattr(tmod, "bias_ih_l%d%s" % (li, sfx)).data = \
+                torch.from_numpy(pget("i2h_bias"))
+            getattr(tmod, "bias_hh_l%d%s" % (li, sfx)).data = \
+                torch.from_numpy(pget("h2h_bias"))
+
+
+def _x():
+    return _R.randn(T_, B, I).astype(np.float32)
+
+
+@pytest.mark.parametrize("act", ["relu", "tanh"])
+def test_vanilla_rnn_vs_torch(act):
+    layer = grnn.RNN(H, num_layers=1, activation=act, input_size=I)
+    layer.initialize()
+    x = _x()
+    out = layer(nd.array(x)).asnumpy()
+    tmod = torch.nn.RNN(I, H, nonlinearity=act)
+    _transplant(layer, tmod)
+    want, _ = tmod(torch.from_numpy(x))
+    np.testing.assert_allclose(out, want.detach().numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_lstm_vs_torch():
+    layer = grnn.LSTM(H, num_layers=1, input_size=I)
+    layer.initialize()
+    x = _x()
+    out = layer(nd.array(x)).asnumpy()
+    tmod = torch.nn.LSTM(I, H)
+    _transplant(layer, tmod)
+    want, _ = tmod(torch.from_numpy(x))
+    np.testing.assert_allclose(out, want.detach().numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_gru_vs_torch():
+    layer = grnn.GRU(H, num_layers=1, input_size=I)
+    layer.initialize()
+    x = _x()
+    out = layer(nd.array(x)).asnumpy()
+    tmod = torch.nn.GRU(I, H)
+    _transplant(layer, tmod)
+    want, _ = tmod(torch.from_numpy(x))
+    np.testing.assert_allclose(out, want.detach().numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_two_layer_lstm_vs_torch():
+    layer = grnn.LSTM(H, num_layers=2, input_size=I)
+    layer.initialize()
+    x = _x()
+    out = layer(nd.array(x)).asnumpy()
+    tmod = torch.nn.LSTM(I, H, num_layers=2)
+    _transplant(layer, tmod, num_layers=2)
+    want, _ = tmod(torch.from_numpy(x))
+    np.testing.assert_allclose(out, want.detach().numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_bidirectional_lstm_vs_torch():
+    layer = grnn.LSTM(H, num_layers=1, input_size=I, bidirectional=True)
+    layer.initialize()
+    x = _x()
+    out = layer(nd.array(x)).asnumpy()
+    tmod = torch.nn.LSTM(I, H, bidirectional=True)
+    _transplant(layer, tmod, bidirectional=True)
+    want, _ = tmod(torch.from_numpy(x))
+    np.testing.assert_allclose(out, want.detach().numpy(), rtol=1e-4,
+                               atol=1e-5)
+
+
+def test_lstm_with_initial_states_vs_torch():
+    layer = grnn.LSTM(H, num_layers=1, input_size=I)
+    layer.initialize()
+    x = _x()
+    h0 = _R.randn(1, B, H).astype(np.float32)
+    c0 = _R.randn(1, B, H).astype(np.float32)
+    out, states = layer(nd.array(x), [nd.array(h0), nd.array(c0)])
+    tmod = torch.nn.LSTM(I, H)
+    _transplant(layer, tmod)
+    want, (hn, cn) = tmod(torch.from_numpy(x),
+                          (torch.from_numpy(h0), torch.from_numpy(c0)))
+    np.testing.assert_allclose(out.asnumpy(), want.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(states[0].asnumpy(), hn.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(states[1].asnumpy(), cn.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_lstm_gradients_vs_torch():
+    layer = grnn.LSTM(H, num_layers=1, input_size=I)
+    layer.initialize()
+    x = _x()
+
+    from mxnet_tpu import autograd
+
+    xa = nd.array(x)
+    xa.attach_grad()
+    with autograd.record():
+        out = layer(xa)
+        loss = (out * out).sum()
+    loss.backward()
+
+    tmod = torch.nn.LSTM(I, H)
+    _transplant(layer, tmod)
+    xt = torch.from_numpy(x).requires_grad_(True)
+    ot, _ = tmod(xt)
+    (ot * ot).sum().backward()
+    np.testing.assert_allclose(xa.grad.asnumpy(), xt.grad.numpy(),
+                               rtol=1e-3, atol=1e-4)
+    # weight gradient for the first-layer i2h matrix
+    gw = layer.l0_i2h_weight.grad().asnumpy()
+    np.testing.assert_allclose(gw, tmod.weight_ih_l0.grad.numpy(),
+                               rtol=1e-3, atol=1e-3)
+
+
+def test_nlc_layout_matches_tnc():
+    layer = grnn.GRU(H, num_layers=1, input_size=I, layout="NTC")
+    layer.initialize()
+    x = _x()
+    out_ntc = layer(nd.array(x.transpose(1, 0, 2))).asnumpy()
+    layer2 = grnn.GRU(H, num_layers=1, input_size=I, layout="TNC",
+                      params=layer.collect_params())
+    out_tnc = layer2(nd.array(x)).asnumpy()
+    np.testing.assert_allclose(out_ntc.transpose(1, 0, 2), out_tnc,
+                               rtol=1e-5, atol=1e-6)
