@@ -1,0 +1,16 @@
+"""Shared example bootstrap helpers."""
+import os
+
+
+def force_platform_from_env():
+    """The TPU plugin overrides JAX_PLATFORMS at import time; the
+    config flag is the only reliable pre-init selector (see
+    __graft_entry__._force_cpu_platform).  Call before importing
+    mxnet_tpu."""
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass

@@ -54,9 +54,10 @@ def init_distributed(coordinator=None, num_processes=None, process_id=None):
 def make_mesh(axes=None, devices=None):
     """Build a Mesh from named axis sizes, e.g. {'dp': 4, 'tp': 2}.
 
-    Axis order is fixed (dp, tp, pp, sp, ep) so dp neighbors sit farthest
-    apart and tp/sp ride the fastest ICI dimension — the standard layout
-    recipe (shard the heaviest-traffic axis innermost)."""
+    Axis order is fixed (dp, pp, ep, sp, mp, tp) so dp neighbors sit
+    farthest apart and mp/tp ride the fastest ICI dimension — the
+    standard layout recipe (shard the heaviest-traffic axis innermost).
+    Unknown axis names raise."""
     import jax
     from jax.sharding import Mesh
 
@@ -64,7 +65,15 @@ def make_mesh(axes=None, devices=None):
         devices = jax.devices()
     if axes is None:
         axes = {"dp": len(devices)}
-    order = [a for a in ("dp", "pp", "ep", "sp", "tp") if a in axes]
+    canonical = ("dp", "pp", "ep", "sp", "mp", "tp")
+    order = [a for a in canonical if a in axes]
+    # an unknown axis name must be loud, not silently dropped (r5: a
+    # {'dp':4,'xx':2} request used to yield a dp-only mesh and the
+    # caller's PartitionSpec('xx') failed far away at placement time)
+    unknown = [a for a in axes if a not in canonical]
+    if unknown:
+        raise ValueError("unknown mesh axis names %s (supported: %s)"
+                         % (unknown, list(canonical)))
     sizes = [axes[a] for a in order]
     n = int(np.prod(sizes))
     if n > len(devices):
