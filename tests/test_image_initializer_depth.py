@@ -1,0 +1,235 @@
+"""Image transform + initializer batteries against numpy oracles
+(reference: tests/python/unittest/test_image.py and test_init.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image, nd
+
+_R = np.random.RandomState(77)
+
+
+def _img(h=12, w=16):
+    return nd.array((_R.rand(h, w, 3) * 255).astype(np.uint8)
+                    .astype(np.float32))
+
+
+# --- crops / resize ---------------------------------------------------
+
+def test_fixed_and_center_crop_oracle():
+    src = _img()
+    out, rect = image.fixed_crop(src, 3, 2, 8, 6), None
+    np.testing.assert_array_equal(out.asnumpy(),
+                                  src.asnumpy()[2:8, 3:11])
+    out, rect = image.center_crop(src, (8, 6))
+    x0, y0 = (16 - 8) // 2, (12 - 6) // 2
+    np.testing.assert_array_equal(out.asnumpy(),
+                                  src.asnumpy()[y0:y0 + 6, x0:x0 + 8])
+    assert rect == (x0, y0, 8, 6)
+
+
+def test_random_crop_within_bounds_and_seeded():
+    src = _img()
+    np.random.seed(3)   # image-layer crops draw from the numpy RNG
+    out1, rect1 = image.random_crop(src, (8, 6))
+    assert out1.shape == (6, 8, 3)
+    x0, y0, w, h = rect1
+    assert 0 <= x0 <= 16 - w and 0 <= y0 <= 12 - h
+    np.testing.assert_array_equal(out1.asnumpy(),
+                                  src.asnumpy()[y0:y0 + h, x0:x0 + w])
+    np.random.seed(3)
+    out2, rect2 = image.random_crop(src, (8, 6))
+    assert rect1 == rect2
+
+
+def test_resize_short_aspect_preserving():
+    src = _img(h=12, w=16)
+    out = image.resize_short(src, 6)
+    # short side 12 -> 6, long side scales 16 * 6/12 = 8
+    assert out.shape == (6, 8, 3)
+
+
+def test_copy_make_border():
+    src = _img(h=4, w=5)
+    out = image.copyMakeBorder(src, 1, 2, 3, 4, value=7.0)
+    o = out.asnumpy()
+    assert o.shape == (4 + 1 + 2, 5 + 3 + 4, 3)
+    np.testing.assert_array_equal(o[1:5, 3:8], src.asnumpy())
+    assert (o[0] == 7.0).all() and (o[:, :3] == 7.0).all()
+
+
+def test_color_normalize_oracle():
+    src = _img()
+    mean = nd.array(np.array([10., 20., 30.], np.float32))
+    std = nd.array(np.array([2., 4., 8.], np.float32))
+    out = image.color_normalize(src, mean, std).asnumpy()
+    want = (src.asnumpy() - np.array([10, 20, 30])) / np.array([2, 4, 8])
+    np.testing.assert_allclose(out, want, rtol=1e-5)
+
+
+# --- augmenters -------------------------------------------------------
+
+def test_horizontal_flip_always():
+    src = _img()
+    aug = image.HorizontalFlipAug(p=1.0)
+    np.testing.assert_array_equal(aug(src).asnumpy(),
+                                  src.asnumpy()[:, ::-1])
+    aug0 = image.HorizontalFlipAug(p=0.0)
+    np.testing.assert_array_equal(aug0(src).asnumpy(), src.asnumpy())
+
+
+def test_brightness_contrast_jitter_bounds():
+    # pixel values bounded away from zero so the ratio is well-defined
+    src = nd.array((_R.rand(6, 6, 3) * 100 + 50).astype(np.float32))
+    b = image.BrightnessJitterAug(brightness=0.5)(src).asnumpy()
+    ratio = b / src.asnumpy()
+    # a single scalar factor in [0.5, 1.5] applied uniformly
+    assert 0.5 - 1e-5 <= ratio.mean() <= 1.5 + 1e-5
+    assert ratio.std() < 1e-3
+
+    c = image.ContrastJitterAug(contrast=0.5)(src).asnumpy()
+    assert c.shape == src.shape and np.isfinite(c).all()
+
+
+def test_saturation_and_hue_preserve_gray():
+    """A gray image has zero chroma: saturation jitter must leave it
+    unchanged, hue jitter nearly so (rounding only)."""
+    gray = nd.array(np.full((6, 6, 3), 77.0, np.float32))
+    s = image.SaturationJitterAug(saturation=0.9)(gray).asnumpy()
+    np.testing.assert_allclose(s, 77.0, atol=1e-3)
+    h = image.HueJitterAug(hue=0.9)(gray).asnumpy()
+    np.testing.assert_allclose(h, 77.0, atol=0.5)
+
+
+def test_create_augmenter_pipeline_runs():
+    augs = image.CreateAugmenter(data_shape=(3, 8, 8), resize=10,
+                                 rand_mirror=True, brightness=0.1,
+                                 contrast=0.1, saturation=0.1,
+                                 mean=True, std=True)
+    out = _img()
+    for a in augs:
+        out = a(out)
+    o = out.asnumpy() if hasattr(out, "asnumpy") else np.asarray(out)
+    assert o.shape[-3:] in ((8, 8, 3), (3, 8, 8))
+
+
+def test_imencode_imdecode_roundtrip():
+    # a smooth gradient: JPEG handles it faithfully at q95 (random
+    # noise would not compress losslessly enough for a tight bound)
+    yy, xx = np.mgrid[0:10, 0:11]
+    src = np.stack([yy * 20, xx * 20, (yy + xx) * 10],
+                   axis=-1).astype(np.uint8)
+    buf = image.imencode(nd.array(src.astype(np.float32)), quality=95)
+    back = image.imdecode(np.frombuffer(bytes(buf), np.uint8))
+    b = back.asnumpy()
+    assert b.shape == (10, 11, 3)
+    # JPEG is lossy; at q95 the reconstruction stays close
+    assert np.abs(b.astype(np.int32) - src.astype(np.int32)).mean() < 12
+
+
+# --- initializers -----------------------------------------------------
+
+def _init_arr(init, shape, name="fc1_weight"):
+    from mxnet_tpu.initializer import InitDesc
+
+    arr = nd.zeros(shape)
+    init(InitDesc(name), arr)
+    return arr.asnumpy()
+
+
+def test_constant_zero_one():
+    assert (_init_arr(mx.init.Zero(), (3, 4)) == 0).all()
+    assert (_init_arr(mx.init.One(), (3, 4)) == 1).all()
+    assert (_init_arr(mx.init.Constant(2.5), (3, 4)) == 2.5).all()
+
+
+def test_uniform_normal_ranges():
+    mx.random.seed(0)
+    u = _init_arr(mx.init.Uniform(0.3), (200, 50))
+    assert u.min() >= -0.3 and u.max() <= 0.3
+    assert abs(u.mean()) < 0.01
+    n = _init_arr(mx.init.Normal(0.5), (200, 50))
+    assert abs(n.std() - 0.5) < 0.02 and abs(n.mean()) < 0.02
+
+
+@pytest.mark.parametrize("rnd_type,factor,magnitude", [
+    ("uniform", "avg", 3.0), ("gaussian", "in", 2.0),
+    ("uniform", "out", 1.0)])
+def test_xavier_scale_matches_fan_formula(rnd_type, factor, magnitude):
+    shape = (64, 32)
+    fan_out, fan_in = shape
+    factor_val = {"avg": (fan_in + fan_out) / 2.0, "in": fan_in,
+                  "out": fan_out}[factor]
+    scale = np.sqrt(magnitude / factor_val)
+    mx.random.seed(1)
+    a = _init_arr(mx.init.Xavier(rnd_type=rnd_type, factor_type=factor,
+                                 magnitude=magnitude), shape)
+    if rnd_type == "uniform":
+        assert a.min() >= -scale - 1e-6 and a.max() <= scale + 1e-6
+        # uniform(-s, s) has std s/sqrt(3)
+        assert abs(a.std() - scale / np.sqrt(3)) < 0.08 * scale
+    else:
+        assert abs(a.std() - scale) < 0.08 * scale
+
+
+def test_msra_prelu_scale():
+    shape = (64, 32)
+    a = _init_arr(mx.init.MSRAPrelu(slope=0.25), shape)
+    # magnitude = 2/(1+slope^2), factor avg
+    scale = np.sqrt((2.0 / (1 + 0.25 ** 2)) / ((64 + 32) / 2.0))
+    assert abs(a.std() - scale) < 0.1 * scale
+
+
+def test_orthogonal_produces_orthogonal_rows():
+    a = _init_arr(mx.init.Orthogonal(scale=1.0), (16, 64))
+    g = a @ a.T
+    np.testing.assert_allclose(g, np.eye(16), atol=1e-4)
+
+
+def test_bilinear_upsampling_kernel():
+    a = _init_arr(mx.init.Bilinear(), (1, 1, 4, 4), name="upsample_w")
+    # the classic bilinear kernel is symmetric and sums rows equally
+    k = a[0, 0]
+    np.testing.assert_allclose(k, k[::-1, ::-1], rtol=1e-6)
+
+
+def test_lstmbias_sets_forget_gate():
+    """Explicit per-param initializers travel in the __init__ attr of
+    the InitDesc (the gluon Parameter path) and bypass the name-suffix
+    routing — a bare *_bias name would route to zeros."""
+    from mxnet_tpu.initializer import InitDesc
+
+    arr = nd.zeros((32,))   # 4 gates x 8 hidden
+    lb = mx.init.LSTMBias(forget_bias=1.0)
+    lb(InitDesc("lstm_i2h_bias", attrs={"__init__": lb.dumps()}), arr)
+    a = arr.asnumpy()
+    # gate order (i, f, g, o): the forget quarter is 1, rest 0
+    np.testing.assert_array_equal(a[8:16], np.ones(8))
+    assert (a[:8] == 0).all() and (a[16:] == 0).all()
+
+
+def test_initializer_dispatch_by_name_pattern():
+    """Initializer.__call__ honors name conventions: *_bias -> zeros,
+    *_gamma -> ones (the reference's attribute-based dispatch)."""
+    from mxnet_tpu.initializer import InitDesc
+
+    init = mx.init.Xavier()
+    b = nd.zeros((7,))
+    init(InitDesc("fc1_bias"), b)
+    assert (b.asnumpy() == 0).all()
+    g = nd.zeros((7,))
+    init(InitDesc("bn0_gamma"), g)
+    assert (g.asnumpy() == 1).all()
+
+
+def test_mixed_initializer():
+    from mxnet_tpu.initializer import InitDesc
+
+    init = mx.init.Mixed([".*embed.*", ".*"],
+                         [mx.init.Constant(9.0), mx.init.Zero()])
+    b = nd.zeros((4,))
+    init(InitDesc("embed0_weight"), b)
+    assert (b.asnumpy() == 9.0).all()
+    w = nd.zeros((4,))
+    init(InitDesc("fc_weight"), w)
+    assert (w.asnumpy() == 0).all()

@@ -1,0 +1,159 @@
+"""Metric battery against hand-computed numpy oracles (reference:
+tests/python/unittest/test_metric.py pins the same quantities).
+Every metric class gets a value check plus the update/reset/accumulate
+contract the Module fit loop depends on."""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+_R = np.random.RandomState(66)
+
+
+def _upd(m, labels, preds):
+    m.update([nd.array(l) for l in labels], [nd.array(p) for p in preds])
+
+
+def test_accuracy_oracle_and_accumulation():
+    m = mx.metric.Accuracy()
+    p1 = np.array([[0.9, 0.1], [0.2, 0.8], [0.6, 0.4]], np.float32)
+    l1 = np.array([0., 1., 1.])
+    _upd(m, [l1], [p1])
+    assert m.get()[1] == 2.0 / 3.0
+    # accumulation across updates
+    p2 = np.array([[0.3, 0.7]], np.float32)
+    _upd(m, [np.array([1.])], [p2])
+    assert m.get()[1] == 3.0 / 4.0
+    m.reset()
+    assert np.isnan(m.get()[1])
+
+
+def test_topk_accuracy_oracle():
+    m = mx.metric.TopKAccuracy(top_k=2)
+    pred = np.array([[0.1, 0.2, 0.7],     # top2 = {2, 1}
+                     [0.8, 0.15, 0.05],   # top2 = {0, 1}
+                     [0.35, 0.4, 0.25]],  # top2 = {1, 0}
+                    np.float32)
+    label = np.array([1., 2., 0.])
+    _upd(m, [label], [pred])
+    assert abs(m.get()[1] - 2.0 / 3.0) < 1e-9
+
+
+def test_f1_and_mcc_binary_oracle():
+    pred = np.array([[0.2, 0.8], [0.7, 0.3], [0.4, 0.6], [0.9, 0.1]],
+                    np.float32)
+    label = np.array([1., 0., 0., 1.])
+    # predicted classes: 1, 0, 1, 0 -> tp=1 fp=1 fn=1 tn=1
+    m = mx.metric.F1()
+    _upd(m, [label], [pred])
+    prec, rec = 1 / 2, 1 / 2
+    want_f1 = 2 * prec * rec / (prec + rec)
+    assert abs(m.get()[1] - want_f1) < 1e-9
+    m = mx.metric.MCC()
+    _upd(m, [label], [pred])
+    want_mcc = (1 * 1 - 1 * 1) / np.sqrt((1 + 1) * (1 + 1) * (1 + 1)
+                                         * (1 + 1))
+    assert abs(m.get()[1] - want_mcc) < 1e-9
+
+
+def test_regression_metrics_oracle():
+    pred = _R.randn(6, 3).astype(np.float32)
+    label = _R.randn(6, 3).astype(np.float32)
+    m = mx.metric.MAE()
+    _upd(m, [label], [pred])
+    assert abs(m.get()[1] - np.abs(pred - label).mean()) < 1e-6
+    m = mx.metric.MSE()
+    _upd(m, [label], [pred])
+    assert abs(m.get()[1] - ((pred - label) ** 2).mean()) < 1e-6
+    m = mx.metric.RMSE()
+    _upd(m, [label], [pred])
+    assert abs(m.get()[1]
+               - np.sqrt(((pred - label) ** 2).mean())) < 1e-6
+
+
+def test_cross_entropy_and_perplexity_oracle():
+    pred = np.array([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]], np.float32)
+    label = np.array([0., 1.])
+    ce = -np.mean([np.log(0.7), np.log(0.8)])
+    m = mx.metric.CrossEntropy()
+    _upd(m, [label], [pred])
+    assert abs(m.get()[1] - ce) < 1e-6
+    m = mx.metric.Perplexity(ignore_label=None)
+    _upd(m, [label], [pred])
+    assert abs(m.get()[1] - np.exp(ce)) < 1e-5
+    m = mx.metric.NegativeLogLikelihood()
+    _upd(m, [label], [pred])
+    assert abs(m.get()[1] - ce) < 1e-6
+
+
+def test_perplexity_ignore_label():
+    pred = np.array([[0.7, 0.2, 0.1], [0.1, 0.8, 0.1]], np.float32)
+    label = np.array([0., 2.])   # second row ignored
+    m = mx.metric.Perplexity(ignore_label=2)
+    _upd(m, [label], [pred])
+    assert abs(m.get()[1] - np.exp(-np.log(0.7))) < 1e-5
+
+
+def test_pearson_and_pcc_oracle():
+    pred = _R.randn(24).astype(np.float32)
+    label = (0.8 * pred + 0.3 * _R.randn(24)).astype(np.float32)
+    m = mx.metric.PearsonCorrelation()
+    _upd(m, [label], [pred])
+    want = np.corrcoef(pred, label)[0, 1]
+    assert abs(m.get()[1] - want) < 1e-5
+
+    # PCC (multiclass Matthews generalization): agreement with the
+    # binary MCC on a binary problem
+    p2 = np.array([[0.2, 0.8], [0.7, 0.3], [0.4, 0.6], [0.9, 0.1]],
+                  np.float32)
+    l2 = np.array([1., 0., 0., 1.])
+    pcc = mx.metric.PCC()
+    _upd(pcc, [l2], [p2])
+    mcc = mx.metric.MCC()
+    _upd(mcc, [l2], [p2])
+    assert abs(pcc.get()[1] - mcc.get()[1]) < 1e-9
+
+
+def test_loss_metric_and_custom_metric():
+    m = mx.metric.Loss()
+    m.update(None, [nd.array(np.array([1.0, 3.0]))])
+    assert abs(m.get()[1] - 2.0) < 1e-6
+
+    cm = mx.metric.CustomMetric(
+        lambda l, p: float(np.abs(l - p).max()), name="maxerr")
+    l = np.array([1., 2.], np.float32)
+    p = np.array([1.5, 1.0], np.float32)
+    _upd(cm, [l], [p])
+    assert abs(cm.get()[1] - 1.0) < 1e-6
+
+
+def test_composite_metric():
+    c = mx.metric.CompositeEvalMetric([mx.metric.Accuracy(),
+                                       mx.metric.MSE()])
+    pred = np.array([[0.9, 0.1], [0.2, 0.8]], np.float32)
+    label = np.array([0., 1.])
+    _upd(c, [label], [pred])
+    names, vals = c.get()
+    assert "accuracy" in names[0] and vals[0] == 1.0
+
+
+def test_create_by_name_registry():
+    for name, cls in [("acc", mx.metric.Accuracy),
+                      ("accuracy", mx.metric.Accuracy),
+                      ("mse", mx.metric.MSE), ("mae", mx.metric.MAE),
+                      ("rmse", mx.metric.RMSE), ("f1", mx.metric.F1),
+                      ("mcc", mx.metric.MCC), ("pcc", mx.metric.PCC),
+                      ("ce", mx.metric.CrossEntropy),
+                      ("nll_loss", mx.metric.NegativeLogLikelihood),
+                      ("top_k_accuracy", mx.metric.TopKAccuracy)]:
+        m = mx.metric.create(name)
+        assert isinstance(m, cls), (name, type(m))
+
+
+def test_metric_name_value_and_global_stats():
+    m = mx.metric.Accuracy(name="trainacc")
+    pred = np.array([[0.9, 0.1]], np.float32)
+    _upd(m, [np.array([0.])], [pred])
+    name, value = m.get()
+    assert name == "trainacc" and value == 1.0
+    assert m.get_name_value() == [("trainacc", 1.0)]
