@@ -27,12 +27,17 @@ def log(msg):
           flush=True)
 
 
-def build_trainer(batch=None):
+def build_trainer(batch=None, remat_policy=None):
     """The benchmark-of-record configuration: ResNet-50 v1, bf16
     compute + fp32 master (on accelerator), momentum SGD, one fused XLA
-    program per step, synthetic bs-`batch` data.  Shared by bench.py
-    and tools/mfu_accounting.py so the roofline accounting always
-    describes the exact program the headline number comes from.
+    program per step, synthetic bs-`batch` data.  Shared by bench.py,
+    tools/mfu_accounting.py and tools/bench_remat_sweep.py so the
+    roofline accounting always describes the exact program the headline
+    number comes from.
+
+    ``remat_policy`` (or the MXNET_REMAT_POLICY env default) selects an
+    activation-rematerialization policy for the backward pass — see
+    mxnet_tpu.remat.list_policies().
 
     Returns (trainer, x, y, batch, on_tpu)."""
     import jax
@@ -55,7 +60,8 @@ def build_trainer(batch=None):
     trainer = parallel.ShardedTrainer(
         net, lambda o, l: loss_fn(o, l), mesh=None, optimizer="sgd",
         optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
-        dtype=jax.numpy.bfloat16 if on_tpu else None)
+        dtype=jax.numpy.bfloat16 if on_tpu else None,
+        remat_policy=remat_policy)
 
     rng = np.random.RandomState(0)
     x = nd.array(rng.rand(batch, 3, 224, 224).astype(np.float32))

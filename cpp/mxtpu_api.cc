@@ -252,6 +252,13 @@ int MXTPUSymbolListArguments(MXTPUSessionHandle sess, MXTPUHandle sym,
     w += len;
     off += len;
   }
+  // the loop's per-name check reserves NUL space only when n > 0; a
+  // zero-argument symbol reaches here with w == 0 and an unchecked
+  // write would be out of bounds for cap == 0
+  if (w >= cap) {
+    g_last_error = "argument name buffer too small";
+    return -1;
+  }
   buf[w] = '\0';
   return 0;
 }

@@ -78,8 +78,16 @@ from . import contrib  # noqa: F401
 from . import operator  # noqa: F401
 from . import util  # noqa: F401
 
+from . import remat  # noqa: F401
+
 __version__ = "2.0.0.tpu1"
 
 config.warn_unknown()
 if config.get("MXNET_PROFILER_AUTOSTART"):
     profiler.start()
+if config.get("MXNET_COMPILE_CACHE") and config.compile_cache_safe():
+    # persistent XLA compilation cache (platform bootstrap): cache-warm
+    # runs skip the ~97 s bench.py compile.  MXNET_COMPILE_CACHE=0
+    # opts out; MXNET_COMPILE_CACHE_DIR moves it.  Skipped on the
+    # forced-multi-device CPU harness (see config.compile_cache_safe).
+    config.enable_compile_cache()

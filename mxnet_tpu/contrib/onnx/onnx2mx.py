@@ -81,7 +81,6 @@ class _Ctx:
         self.initializers = initializers
         self.aux_names = set()
         self.consumed = set()
-        self.gemm_wmode = {}   # weight name -> transB it was used with
         self.gemm_fresh = {}   # fresh transposed-copy name -> var sym
 
     def const_of(self, name, what):
@@ -188,29 +187,22 @@ def _i_gemm(ctx, node, ins, a, name):
                          "(fold them into the weights/bias)")
     w_name = node["input"][1]
     inits = ctx.initializers
-    # transB=0 weights are stored (K, N) and FullyConnected wants (N, K);
-    # transpose once per *weight*, not per Gemm node — a weight shared by
-    # two Gemm nodes must not be transposed twice, and the initializer
-    # dict is read only after all nodes convert, so a mixed-transB share
-    # would corrupt whichever node ran first (ADVICE r4)
+    # transB=0 weights are stored (K, N) and FullyConnected wants (N, K).
+    # NEVER mutate inits[w_name] in place: the initializer may be shared
+    # with a non-Gemm consumer (MatMul/Add/...) that needs the original
+    # layout, and the dict is read only after all nodes convert, so an
+    # in-place transpose would silently corrupt that consumer.  Instead
+    # materialize the transposed copy once under a fresh name (the same
+    # mechanism the mixed-transB share always used) and leave the
+    # original untouched; transB=1 nodes use the original as-is.
     transb = bool(a.get("transB"))
-    first_use = w_name not in ctx.gemm_wmode
-    if not first_use and ctx.gemm_wmode[w_name] != transb:
-        # legal ONNX: one initializer shared by Gemm nodes of differing
-        # transB.  The stored array is laid out for the first-seen
-        # orientation, so materialize its transpose under a fresh name
-        # for this node (once; later same-orientation nodes reuse it).
+    if not transb:
         fresh = w_name + "_gemm_t"
         if fresh not in inits:
             inits[fresh] = np.ascontiguousarray(inits[w_name].T)
             ctx.gemm_fresh[fresh] = ctx.S.var(fresh)
         w_name = fresh
         ins = [ins[0], ctx.gemm_fresh[fresh]] + list(ins[2:])
-        first_use = False
-    else:
-        ctx.gemm_wmode[w_name] = transb
-        if not transb and first_use:
-            inits[w_name] = np.ascontiguousarray(inits[w_name].T)
     num_hidden = inits[w_name].shape[0]
     return ctx.S._invoke_sym("FullyConnected", ins,
                              {"num_hidden": int(num_hidden),
@@ -660,9 +652,15 @@ def import_model(model_file):
     sym = S.Group(outputs) if len(outputs) > 1 else outputs[0]
 
     arg_params, aux_params = {}, {}
+    live = set(sym.list_inputs())
     for name, arr in initializers.items():
         if name in ctx.consumed:
             continue  # attr-folded (e.g. Reshape shape tensors)
+        if name not in live:
+            # not referenced by the final graph — e.g. a Gemm weight
+            # whose consumers all use the fresh transposed copy; binding
+            # it would make Module.set_params reject the param dict
+            continue
         target = aux_params if name in ctx.aux_names else arg_params
         target[name] = array(arr.astype(np.float32)
                              if arr.dtype == np.float64 else arr)

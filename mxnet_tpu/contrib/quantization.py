@@ -176,35 +176,18 @@ def _quantize_symbol(sym, eligible):
         quantize_v2(x) -> int8 kernel (int32 accum) -> dequantize_int32
         [-> broadcast bias add in fp32]
 
-    so the matmul/conv really executes in int8 on the MXU."""
+    so the matmul/conv really executes in int8 on the MXU.  Runs on the
+    shared graph-rewrite engine (symbol/fusion.py), the same pass
+    infrastructure as BN folding and conv+BN+ReLU fusion."""
     from ..symbol import symbol as S
+    from ..symbol.fusion import rewrite_graph
 
-    memo = {}
-
-    def rebuild(node):
-        if id(node) in memo:
-            return memo[id(node)]
-        if node.op is None:
-            out = S.Symbol([(node, 0)])
-            memo[id(node)] = out
-            return out
-        ins = []
-        for (n, i) in node.inputs:
-            s = rebuild(n)
-            ins.append(s[i] if len(s) > 1 else s)
+    def emit(node, ins, _sub):
         if id(node) in eligible:
-            out = _emit_quantized(S, node, ins)
-        else:
-            out = S._invoke_sym(node.op, ins, dict(node.attrs),
-                                name=node.name)
-        memo[id(node)] = out
-        return out
+            return _emit_quantized(S, node, ins)
+        return None
 
-    outs = []
-    for (node, i) in sym._entries:
-        s = rebuild(node)
-        outs.append(s[i] if len(s) > 1 else s)
-    return S.Group(outs)
+    return rewrite_graph(sym, emit)
 
 
 def _emit_quantized(S, node, ins):

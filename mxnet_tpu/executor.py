@@ -34,8 +34,15 @@ __all__ = ["Executor"]
 
 class Executor:
     def __init__(self, symbol, ctx, args, args_grad, grad_req, aux_states,
-                 shared_exec=None):
+                 shared_exec=None, remat_policy=None):
         import jax
+
+        from .remat import resolve_policy
+
+        # validate eagerly so a typo'd policy fails at bind, not at the
+        # first backward; None defers to MXNET_REMAT_POLICY
+        resolve_policy(remat_policy)
+        self._remat_policy = remat_policy
 
         self._symbol = symbol
         self._ctx = ctx or current_context()
@@ -78,8 +85,11 @@ class Executor:
         self._jit_fwd_train = jax.jit(functools.partial(fwd, is_train=True))
 
         grad_names = self._grad_names
+        remat_policy = self._remat_policy
 
         def fwd_bwd(values, rng, cots):
+            from .remat import apply_remat
+
             oa = {k: v for k, v in values.items() if k not in grad_names}
             ga = {k: values[k] for k in grad_names}
 
@@ -87,11 +97,16 @@ class Executor:
                 outs, aux = fwd({**oa, **ga_}, rng, True)
                 return outs, aux
 
+            # activation-remat policy: trade bwd HBM re-reads for
+            # recompute (no-op when the policy is off)
+            f = apply_remat(f, remat_policy)
+
             outs, vjp_fn, aux = jax.vjp(f, ga, has_aux=True)
             (grads,) = vjp_fn(cots)
             return outs, aux, grads
 
         self._jit_fwd_bwd = jax.jit(fwd_bwd)
+        self._cot_struct_cache = {}  # bound-shape key -> output structs
 
     # ------------------------------------------------------------------
     @property
@@ -153,12 +168,21 @@ class Executor:
             # ones_like head gradients (loss-op semantics).  Shapes come
             # from an abstract trace — executing the forward program
             # just to learn output shapes would add a full device pass
-            # per backward (r5 review: the C ABI train loop paid it)
-            import jax
+            # per backward (r5 review: the C ABI train loop paid it).
+            # The abstract trace itself is a Python re-trace of the whole
+            # forward, so cache the resulting structs per bound-shape
+            # signature: steady-state training re-traces zero times
+            # (ADVICE r5)
+            key = tuple(sorted((n, tuple(v.shape), str(v.dtype))
+                               for n, v in values.items()))
+            out_structs = self._cot_struct_cache.get(key)
+            if out_structs is None:
+                import jax
 
-            out_shapes, _aux_shapes = jax.eval_shape(
-                self._jit_fwd_train, values, rng)
-            cots = tuple(jnp.ones(o.shape, o.dtype) for o in out_shapes)
+                out_structs, _aux_structs = jax.eval_shape(
+                    self._jit_fwd_train, values, rng)
+                self._cot_struct_cache[key] = out_structs
+            cots = tuple(jnp.ones(o.shape, o.dtype) for o in out_structs)
         else:
             if isinstance(out_grads, NDArray):
                 out_grads = [out_grads]
@@ -230,7 +254,8 @@ class Executor:
             new_aux[name] = old if tuple(old.shape) == tuple(shp) else \
                 nd_zeros(shp, ctx=self._ctx, dtype=old.dtype)
         return Executor(self._symbol, self._ctx, new_args, new_grads,
-                        self._grad_req, new_aux)
+                        self._grad_req, new_aux,
+                        remat_policy=self._remat_policy)
 
     def set_monitor_callback(self, callback, monitor_all=False):
         self.monitor_callback = callback

@@ -373,14 +373,20 @@ class CachedOp:
     """Compiled forward for a HybridBlock (parity: src/imperative/
     cached_op.cc via MXCreateCachedOpEx)."""
 
-    def __init__(self, block, static_alloc=False, static_shape=False):
+    def __init__(self, block, static_alloc=False, static_shape=False,
+                 remat_policy=None):
         import jax
+
+        from ..remat import resolve_policy
 
         self._block = block
         self._jits = {}  # is_train -> jitted fn
         self._param_list = None  # stable order, captured at first call
         self._aux_params = None  # params receiving moving-stat updates
         self._jax = jax
+        # fail fast on a typo'd policy; None defers to MXNET_REMAT_POLICY
+        resolve_policy(remat_policy)
+        self._remat_policy = remat_policy
 
     def _make_fn(self, is_train, n_inputs, n_params):
         block = self._block
@@ -457,7 +463,16 @@ class CachedOp:
                 meta["aux_params"] = aux_params
                 return outs, aux_vals
 
-            self._jits[key] = (jax.jit(pure), meta)
+            fn_for_jit = pure
+            if is_train:
+                # activation-remat policy (hybridize(remat_policy=...)
+                # or MXNET_REMAT_POLICY): the vjp taken in the grad path
+                # below recomputes activations per the policy instead of
+                # saving them — no-op when the policy is off
+                from ..remat import apply_remat
+
+                fn_for_jit = apply_remat(pure, self._remat_policy)
+            self._jits[key] = (jax.jit(fn_for_jit), meta)
         jit_fn, meta = self._jits[key]
         rng = _random.next_key()
         mode = "[train]" if is_train else "[eval]"

@@ -36,8 +36,10 @@ class DataParallelExecutorGroup:
     def __init__(self, symbol, contexts, workload, data_shapes, label_shapes,
                  param_names, for_training, inputs_need_grad,
                  shared_group=None, logger=None, fixed_param_names=None,
-                 grad_req="write", state_names=None, group2ctxs=None):
+                 grad_req="write", state_names=None, group2ctxs=None,
+                 remat_policy=None):
         self.symbol = symbol
+        self.remat_policy = remat_policy
         self.contexts = contexts
         self.workload = workload or [1] * len(contexts)
         self.for_training = for_training
@@ -90,7 +92,9 @@ class DataParallelExecutorGroup:
                     shapes[l.name] = (n,) + tuple(l.shape[1:])
             shared = shared_group.execs[i] if shared_group else None
             exe = self.symbol.simple_bind(ctx=ctx, grad_req=self.grad_req,
-                                          shared_exec=shared, **shapes)
+                                          shared_exec=shared,
+                                          remat_policy=self.remat_policy,
+                                          **shapes)
             self.execs.append(exe)
 
     # -- param flow ------------------------------------------------------

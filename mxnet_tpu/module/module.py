@@ -37,8 +37,10 @@ class Module(BaseModule):
     def __init__(self, symbol, data_names=("data",),
                  label_names=("softmax_label",), logger=logging, context=None,
                  work_load_list=None, fixed_param_names=None,
-                 state_names=None, group2ctxs=None, compression_params=None):
+                 state_names=None, group2ctxs=None, compression_params=None,
+                 remat_policy=None):
         super().__init__(logger=logger)
+        self._remat_policy = remat_policy
         ctxs = context if context is not None else cpu()
         if isinstance(ctxs, Context):
             ctxs = [ctxs]
@@ -208,7 +210,7 @@ class Module(BaseModule):
             self._data_shapes, self._label_shapes, self._param_names,
             for_training, inputs_need_grad, shared_group, self.logger,
             self._fixed_param_names, grad_req, self._state_names,
-            self._group2ctxs)
+            self._group2ctxs, remat_policy=self._remat_policy)
         self.binded = True
 
         if shared_module is not None and shared_module.params_initialized:

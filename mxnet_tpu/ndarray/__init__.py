@@ -11,6 +11,7 @@ from ..ops import control_flow as _ops_cf  # noqa: F401
 from ..ops import ssd_ops as _ops_ssd  # noqa: F401
 from ..ops import extended as _ops_ext  # noqa: F401
 from ..ops import deformable as _ops_def  # noqa: F401
+from ..ops import fused as _ops_fused  # noqa: F401
 
 from .ndarray import (  # noqa: F401
     NDArray, array, zeros, ones, empty, full, arange, concatenate, concat,

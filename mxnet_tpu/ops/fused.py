@@ -1,0 +1,87 @@
+"""Fused conv+BN+ReLU block op — the training-mode half of the
+graph-fusion pass (mxnet_tpu/symbol/fusion.py).
+
+Why a dedicated op when XLA already fuses elementwise chains: the MFU
+accounting (docs/perf_notes.md) shows the ResNet-50 step spends ~69 ms
+of a 121.8 ms step on HBM traffic, a large slice of which is the
+backward pass re-reading normalized activations.  Here the normalize+
+activate tail is wrapped in ``jax.checkpoint``, so its VJP *recomputes*
+the normalized activation from the conv output (one cheap elementwise
+pass over data already needed for the conv gradient) instead of
+streaming a second saved activation tensor from HBM — the
+FusionStitching recipe for memory-bound ops.
+
+Input order puts the optional conv bias LAST so the auxiliary-state
+positions (moving_mean, moving_var) are stable for graphs with and
+without bias:
+
+    data, weight, gamma, beta, moving_mean, moving_var[, bias]
+
+Outputs mirror BatchNorm: ``(out, mean, var)`` with one visible output;
+the executor threads the moving-stat updates exactly as it does for a
+plain BatchNorm node.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .registry import register
+from .utils import pbool, pint, pfloat, ptuple
+from .nn import _conv_dims, _dim_numbers
+
+
+@register("_contrib_conv_bn_relu", num_inputs=-1, num_outputs=3,
+          visible_outputs=1)
+def conv_bn_relu(data, weight, gamma, beta, moving_mean, moving_var,
+                 bias=None, kernel=None, stride=None, dilate=None, pad=None,
+                 num_filter=None, num_group=1, no_bias=True, layout=None,
+                 workspace=None, cudnn_tune=None, cudnn_off=None,
+                 eps=1e-3, momentum=0.9, fix_gamma=True,
+                 use_global_stats=False, act_type="relu", **kw):
+    # eps/fix_gamma defaults MUST match the standalone BatchNorm op
+    # (ops/nn.py) — the fusion pass copies only explicitly-set attrs
+    from .. import autograd
+
+    kernel = ptuple(kernel)
+    nd = _conv_dims(kernel)
+    stride = ptuple(stride, ndim=nd, default=(1,) * nd)
+    dilate = ptuple(dilate, ndim=nd, default=(1,) * nd)
+    pad = ptuple(pad, ndim=nd, default=(0,) * nd)
+    eps = pfloat(eps, 1e-3)
+    dn = lax.conv_dimension_numbers(data.shape, weight.shape,
+                                    _dim_numbers(nd))
+    y = lax.conv_general_dilated(
+        data, weight, window_strides=stride, padding=[(p, p) for p in pad],
+        rhs_dilation=dilate, dimension_numbers=dn,
+        feature_group_count=pint(num_group, 1),
+        preferred_element_type=jnp.float32
+        if data.dtype == jnp.float32 else None)
+    y = y.astype(data.dtype)
+    if not pbool(no_bias, True) and bias is not None:
+        y = y + bias.reshape((1, -1) + (1,) * nd)
+
+    red = (0,) + tuple(range(2, y.ndim))  # all but the channel axis
+    if pbool(use_global_stats) or not autograd.is_training():
+        mean, var = moving_mean, moving_var
+    else:
+        mean = jnp.mean(y, axis=red)
+        var = jnp.var(y, axis=red)
+    g = jnp.ones_like(gamma) if pbool(fix_gamma, True) else gamma
+    act = act_type or ""
+
+    def _norm_act(y_, mean_, var_, g_, b_):
+        shape = (1, -1) + (1,) * (y_.ndim - 2)
+        inv = lax.rsqrt(var_.astype(jnp.float32) + eps).astype(y_.dtype)
+        out_ = (y_ - mean_.reshape(shape)) * inv.reshape(shape) \
+            * g_.reshape(shape) + b_.reshape(shape)
+        if act == "relu":
+            out_ = jax.nn.relu(out_)
+        return out_
+
+    # jax.checkpoint saves only the inputs (conv output + per-channel
+    # stats/affine) and re-derives the normalized activation in the
+    # backward pass — no second activation tensor round-trips HBM
+    out = jax.checkpoint(_norm_act)(y, mean, var, g, beta)
+    return out, mean, var

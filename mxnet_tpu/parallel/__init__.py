@@ -6,7 +6,8 @@ psum; ps-lite multi-host -> jax.distributed; plus new capabilities the
 reference lacked (tensor parallelism, ring-attention sequence parallelism,
 microbatched pipeline parallelism).
 """
-from .mesh import make_mesh, local_mesh, init_distributed, MeshConfig  # noqa: F401
+from .mesh import (make_mesh, local_mesh, init_distributed, MeshConfig,  # noqa: F401
+                   shard_map)
 from .train import ShardedTrainer  # noqa: F401
 from .ring_attention import (ring_attention, ring_attention_sharded,  # noqa: F401
                              local_attention)

@@ -28,6 +28,19 @@ import functools
 __all__ = ["moe_ffn", "moe_ffn_sharded"]
 
 
+def _check_top_k(top_k, n_experts):
+    """Loud early validation (make_mesh convention): a bad ``top_k``
+    must not surface as an opaque lax.top_k shape error mid-trace."""
+    import numpy as np
+
+    if isinstance(top_k, bool) or \
+            not isinstance(top_k, (int, np.integer)) or \
+            top_k < 1 or top_k > n_experts:
+        raise ValueError(
+            "moe: top_k must be an int in [1, n_experts=%d], got %r"
+            % (n_experts, top_k))
+
+
 def moe_ffn(x, gate_w, w_in, w_out, axis_name="ep", capacity_factor=1.25,
             top_k=1):
     """Top-k switch FFN over experts sharded along `axis_name`.
@@ -46,6 +59,7 @@ def moe_ffn(x, gate_w, w_in, w_out, axis_name="ep", capacity_factor=1.25,
     import jax.numpy as jnp
     from jax import lax
 
+    _check_top_k(top_k, gate_w.shape[-1])
     n_exp = lax.psum(1, axis_name)
     T, D = x.shape
     capacity = max(1, int(capacity_factor * top_k * T / n_exp))
@@ -116,10 +130,12 @@ def moe_ffn_sharded(mesh, x, gate_w, w_in, w_out, axis_name="ep",
     w_in: (n_experts, d_model, d_hidden), w_out: (n_experts, d_hidden,
     d_model) — expert dim sharded; gate_w replicated.
     Returns ``(out, aux_loss)`` like :func:`moe_ffn`."""
-    import jax
     from jax.sharding import PartitionSpec as P
 
-    fn = jax.shard_map(
+    from .mesh import shard_map
+
+    _check_top_k(top_k, gate_w.shape[-1])
+    fn = shard_map(
         functools.partial(moe_ffn, axis_name=axis_name,
                           capacity_factor=capacity_factor, top_k=top_k),
         mesh=mesh,

@@ -80,6 +80,8 @@ def gpipe_loss(mesh, stage_fn, loss_fn, x, num_micro, axis_name="pp"):
         loss = jnp.where(stage == n_stage - 1, loss, 0.0)
         return lax.psum(loss, axis_name)
 
-    fn = jax.shard_map(inner, mesh=mesh, in_specs=P(),
-                       out_specs=P(), check_vma=False)
+    from .mesh import shard_map
+
+    fn = shard_map(inner, mesh=mesh, in_specs=P(),
+                   out_specs=P(), check_vma=False)
     return fn(x)

@@ -154,11 +154,12 @@ def local_attention(q, k, v, causal=False, scale=None):
 def ring_attention_sharded(mesh, q, k, v, axis_name="sp", causal=False):
     """Convenience wrapper: shard_map ring_attention over `mesh` with the
     sequence dim of q/k/v sharded along `axis_name`."""
-    import jax
     from jax.sharding import PartitionSpec as P
 
+    from .mesh import shard_map
+
     spec = P(None, axis_name, None, None)
-    fn = jax.shard_map(
+    fn = shard_map(
         functools.partial(ring_attention, axis_name=axis_name, causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)

@@ -95,12 +95,18 @@ class ShardedTrainer:
 
     def __init__(self, net, loss_fn, mesh=None, optimizer="sgd",
                  optimizer_params=None, batch_axis_spec="dp",
-                 param_spec_fn=None, dtype=None, donate=True):
+                 param_spec_fn=None, dtype=None, donate=True,
+                 remat_policy=None):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
+        from ..remat import resolve_policy
+
         self.net = net
         self.loss_fn = loss_fn
+        # fail fast on a typo'd policy; None defers to MXNET_REMAT_POLICY
+        resolve_policy(remat_policy)
+        self._remat_policy = remat_policy
         self.mesh = mesh
         self._params = [p for p in net.collect_params().values()]
         self._trainable = [p.grad_req != "null" for p in self._params]
@@ -263,17 +269,30 @@ class ShardedTrainer:
                 finally:
                     for d, old in saved:
                         d._data = old
-                aux = [(p, v._data if isinstance(v, NDArray) else v)
-                       for (p, v) in sink]
+                # aux params are static per model: record the Parameter
+                # objects out-of-band so the traced function takes and
+                # returns jax arrays only (a requirement for wrapping it
+                # in jax.checkpoint below)
+                aux_meta["params"] = [p for (p, _v) in sink]
+                aux_vals = tuple(v._data if isinstance(v, NDArray) else v
+                                 for (_p, v) in sink)
                 import jax.numpy as jnp
 
-                return jnp.mean(loss._data).astype(jnp.float32), aux
+                return jnp.mean(loss._data).astype(jnp.float32), aux_vals
             finally:
                 _block_mod._trace_state.active = False
                 _block_mod._aux_sink.sink = None
                 autograd.set_recording(prev_r)
                 autograd.set_training(prev_t)
                 _random.pop_trace_key()
+
+        aux_meta = {"params": []}
+        from ..remat import apply_remat
+
+        # activation-remat policy: the value_and_grad below recomputes
+        # activations per the policy instead of re-reading them from HBM
+        # (no-op when the policy is off)
+        forward_loss = apply_remat(forward_loss, self._remat_policy)
 
         opt_name = self._opt_name
         lr, wd, momentum = self._lr, self._wd, self._momentum
@@ -315,7 +334,7 @@ class ShardedTrainer:
             # moving-stat (aux) updates fused into the same program —
             # cast back to storage dtype inside the jit, so no per-aux
             # eager dispatch/compile happens on the host afterwards
-            for p, v in aux:
+            for p, v in zip(aux_meta["params"], aux):
                 i = pidx[id(p)]
                 new_params[i] = v.astype(new_params[i].dtype)
             return new_params, new_state, loss

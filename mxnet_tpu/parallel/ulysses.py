@@ -96,7 +96,9 @@ def _sharded_fn(mesh, axis_name, causal, use_flash):
     spec = P(None, axis_name)
     # check_vma=False: pallas_call outputs don't carry varying-mesh-axes
     # metadata (same reason ring_attention_sharded uses check_vma=False)
-    return jax.jit(jax.shard_map(
+    from .mesh import shard_map
+
+    return jax.jit(shard_map(
         _functools.partial(ulysses_attention, axis_name=axis_name,
                            causal=causal, use_flash=use_flash),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

@@ -1,0 +1,93 @@
+"""Activation-rematerialization policy control.
+
+The MFU accounting in docs/perf_notes.md pins the ResNet-50 train step
+to the HBM roofline: ~69 ms of the 121.8 ms step is activation traffic
+(BN/ReLU passes, bwd re-reads), not MXU work.  ``jax.checkpoint`` with a
+selectable ``jax.checkpoint_policies`` entry trades that traffic for
+recompute — XLA re-derives cheap elementwise activations in the backward
+pass instead of streaming them from HBM.
+
+One registry maps MXNet-flavoured policy names onto the jax policies so
+every entry point spells them the same way:
+
+* ``Executor``/``symbol.bind`` — ``remat_policy=`` kwarg
+* ``gluon.HybridBlock.hybridize(remat_policy=...)`` — via ``CachedOp``
+* ``Module(..., remat_policy=...)`` and
+  ``parallel.ShardedTrainer(..., remat_policy=...)``
+* ``MXNET_REMAT_POLICY`` env var (config.py) — the default for all of
+  the above when the kwarg is left unset.
+
+``tools/bench_remat_sweep.py`` runs the policy matrix against bench.py
+and commits the table to docs/perf_notes.md.
+"""
+from __future__ import annotations
+
+__all__ = ["list_policies", "resolve_policy", "apply_remat"]
+
+
+def _policies():
+    import jax
+
+    cp = jax.checkpoint_policies
+    table = {
+        # recompute everything in the backward pass (plain jax.checkpoint)
+        "full": None,
+        "nothing_saveable": cp.nothing_saveable,
+        # keep matmul/conv outputs, recompute elementwise chains — the
+        # sweet spot the TPU learned-cost-model literature points at
+        "dots_saveable": cp.dots_saveable,
+        "dots_with_no_batch_dims_saveable": cp.dots_with_no_batch_dims_saveable,
+        # save everything (the wrapper becomes a no-op remat barrier)
+        "everything_saveable": cp.everything_saveable,
+    }
+    if hasattr(cp, "offload_dot_with_no_batch_dims"):
+        # offload variant: dot outputs parked in pinned host memory
+        table["offload_dots"] = cp.offload_dot_with_no_batch_dims(
+            "device", "pinned_host")
+    return table
+
+
+def list_policies():
+    """Recognized ``remat_policy`` names (plus 'none')."""
+    return ["none"] + sorted(_policies())
+
+
+def resolve_policy(policy):
+    """Normalize a remat policy selector.
+
+    Returns ``(active, jax_policy)``: ``active`` False means "do not
+    wrap in jax.checkpoint at all"; ``jax_policy`` None with active True
+    means plain ``jax.checkpoint`` (recompute everything).
+
+    Accepts ``None``/''/'none' (off), a registered name (see
+    :func:`list_policies`), or a callable jax checkpoint policy.
+    """
+    if policy is None:
+        from . import config
+
+        policy = config.get("MXNET_REMAT_POLICY")
+    if policy in ("", "none", None, False):
+        return False, None
+    if callable(policy):
+        return True, policy
+    table = _policies()
+    if policy not in table:
+        raise ValueError(
+            "unknown remat_policy %r (recognized: %s; or pass a "
+            "jax.checkpoint_policies callable)" % (policy,
+                                                   list_policies()))
+    return True, table[policy]
+
+
+def apply_remat(fn, policy):
+    """Wrap ``fn`` in ``jax.checkpoint`` per ``policy`` (see
+    :func:`resolve_policy`); returns ``fn`` unchanged when the policy is
+    off.  ``fn`` must take and return jax-array pytrees only."""
+    active, jax_policy = resolve_policy(policy)
+    if not active:
+        return fn
+    import jax
+
+    if jax_policy is None:
+        return jax.checkpoint(fn)
+    return jax.checkpoint(fn, policy=jax_policy)

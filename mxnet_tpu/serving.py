@@ -174,18 +174,20 @@ class Predictor:
             b = np.asarray(b)
         if self._batch_shape is None:
             # the first observed batch fixes the compiled contract: every
-            # later batch may only shrink in the leading dim.  Make the
-            # implicit choice loud — a ragged *first* request would
-            # otherwise lock out every full-size batch (ADVICE r4);
-            # pass batch_shape=/batch_dtype= to set the contract up front.
-            import warnings
-            dt_note = "" if self._batch_dtype is not None \
-                else "/%s" % np.dtype(b.dtype)
-            warnings.warn(
-                "Predictor batch contract implicitly set to %s%s by the "
-                "first request; larger batches will be rejected — pass "
-                "batch_shape= to pin it explicitly"
-                % (tuple(b.shape), dt_note), stacklevel=3)
+            # later batch may only shrink in the leading dim.  Warn only
+            # when the dtype is ALSO unpinned — a fully implicit contract
+            # is where a ragged/garbage first request silently locks out
+            # every later batch (ADVICE r4); a Predictor constructed with
+            # batch_dtype= (the common programmatic path) has declared
+            # intent and stays quiet.
+            if self._batch_dtype is None:
+                import warnings
+
+                warnings.warn(
+                    "Predictor batch contract implicitly set to %s/%s by "
+                    "the first request; larger batches will be rejected — "
+                    "pass batch_shape=/batch_dtype= to pin it explicitly"
+                    % (tuple(b.shape), np.dtype(b.dtype)), stacklevel=3)
             self._batch_shape = tuple(b.shape)
         if self._batch_dtype is None:
             self._batch_dtype = np.dtype(b.dtype)

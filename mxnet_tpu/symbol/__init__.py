@@ -1,6 +1,8 @@
 """mx.sym namespace (reference parity: python/mxnet/symbol/__init__.py)."""
 from .symbol import (Symbol, var, Variable, Group, load, load_json,  # noqa: F401
                      zeros, ones, _invoke_sym)
+from . import fusion  # noqa: F401
+from .fusion import fold_batchnorm, fuse_conv_bn_relu  # noqa: F401
 from . import register as _register
 
 _register.populate(globals())

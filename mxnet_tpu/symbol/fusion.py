@@ -1,0 +1,312 @@
+"""Graph-level fusion passes over the Symbol DAG.
+
+The MFU accounting (docs/perf_notes.md) shows the ResNet-50 train step
+is HBM-bound: ~69 ms of a 121.8 ms step is BN/ReLU streaming and bwd
+re-reads, not MXU work.  These passes attack that traffic at the graph
+level, in the FusionStitching (arXiv:1811.05213) memory-bound-op sense:
+
+* :func:`fold_batchnorm` — inference: fold BatchNorm scale/shift
+  algebraically into the adjacent Convolution/FullyConnected weights;
+  the BN node disappears from the graph entirely (zero extra passes
+  over the activation at serving time).
+* :func:`fuse_conv_bn_relu` — training: collapse
+  Convolution -> BatchNorm [-> relu] chains into the fused
+  ``_contrib_conv_bn_relu`` block op (mxnet_tpu/ops/fused.py) whose
+  VJP *recomputes* the normalized activation instead of re-reading it
+  from HBM.
+* :func:`rewrite_graph` — the generic rebuild engine both passes (and
+  the int8 rewrite in contrib/quantization.py) run on, so future
+  passes hang off one piece of infrastructure.
+
+Both passes preserve parameter names wherever a node survives, so the
+original ``arg_params``/``aux_params`` dicts keep working; BN folding
+returns updated param dicts because it changes weight *values*.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..base import MXNetError
+from ..ops.utils import pbool, pint, pfloat
+from . import symbol as S
+
+__all__ = ["rewrite_graph", "fold_batchnorm", "fuse_conv_bn_relu",
+           "count_ops"]
+
+
+# ---------------------------------------------------------------------------
+# generic rewrite engine
+# ---------------------------------------------------------------------------
+
+
+def rewrite_graph(sym, emit):
+    """Rebuild ``sym`` bottom-up through ``emit``.
+
+    ``emit(node, ins, sub)`` is called for every op node in topological
+    order with ``ins`` = the rebuilt single-output input Symbols, and
+    ``sub`` = a function mapping any original ``(node, out_index)``
+    entry to its rebuilt Symbol output (for multi-node pattern fusion).
+    Return a Symbol to replace the node, or None to re-emit it
+    unchanged.  Variable nodes are reused as-is, so argument/aux names
+    are stable across the rewrite.
+    """
+    memo = {}
+
+    def rebuild(node):
+        if id(node) in memo:
+            return memo[id(node)]
+        if node.op is None:
+            out = S.Symbol([(node, 0)])
+            memo[id(node)] = out
+            return out
+        ins = [sub(entry) for entry in node.inputs]
+        out = emit(node, ins, sub)
+        if out is None:
+            out = S._invoke_sym(node.op, ins, dict(node.attrs),
+                                name=node.name)
+        memo[id(node)] = out
+        return out
+
+    def sub(entry):
+        node, i = entry
+        s = rebuild(node)
+        return s[i] if len(s) > 1 else s
+
+    outs = [sub(entry) for entry in sym._entries]
+    return S.Group(outs) if len(outs) > 1 else outs[0]
+
+
+def _consumer_map(nodes):
+    """id(node) -> list of (consumer_node, input_position)."""
+    out = {}
+    for node in nodes:
+        if node.op is None:
+            continue
+        for pos, (src, _i) in enumerate(node.inputs):
+            out.setdefault(id(src), []).append((node, pos))
+    return out
+
+
+def _entry_ids(sym):
+    return {id(node) for (node, _i) in sym._entries}
+
+
+def count_ops(sym, op_name):
+    """Number of ``op_name`` nodes in the graph (test/debug helper)."""
+    return sum(1 for n in sym._topo_nodes() if n.op == op_name)
+
+
+def _is_plain_var(node):
+    return node.op is None
+
+
+# ---------------------------------------------------------------------------
+# inference-mode BN folding
+# ---------------------------------------------------------------------------
+
+_FOLD_PRODUCERS = ("Convolution", "FullyConnected")
+
+
+def _bn_fold_plan(sym):
+    """Find BatchNorm nodes foldable into their producing conv/FC.
+
+    Conditions: the BN's data input is output 0 of a Convolution/
+    FullyConnected that (a) feeds only this BN, (b) is not itself a
+    graph output, (c) has a plain-variable weight (and bias) consumed
+    by no other node; the BN normalizes the channel axis the producer
+    fills (axis 1), exposes only its first output, and its
+    gamma/beta/moving inputs are plain variables.
+    """
+    nodes = sym._topo_nodes()
+    consumers = _consumer_map(nodes)
+    entries = _entry_ids(sym)
+    plan = {}  # id(bn_node) -> producer node
+    for bn in nodes:
+        if bn.op != "BatchNorm" or pbool(bn.attrs.get("output_mean_var")):
+            continue
+        if pint(bn.attrs.get("axis"), 1) != 1:
+            continue
+        src, oi = bn.inputs[0]
+        if oi != 0 or src.op not in _FOLD_PRODUCERS:
+            continue
+        if id(src) in entries or len(consumers.get(id(src), ())) != 1:
+            continue
+        # weight/bias vars must be exclusive to this producer
+        w_ok = all(_is_plain_var(n) and
+                   len(consumers.get(id(n), ())) == 1
+                   for (n, _i) in src.inputs[1:])
+        bn_ok = all(_is_plain_var(n) for (n, _i) in bn.inputs[1:])
+        if w_ok and bn_ok:
+            plan[id(bn)] = src
+    return plan
+
+
+def _np_of(params, name, fallback=None):
+    arr = params.get(name)
+    if arr is None and fallback is not None:
+        arr = fallback.get(name)
+    if arr is None:
+        raise MXNetError("fold_batchnorm: parameter %r not provided" % name)
+    return arr.asnumpy() if hasattr(arr, "asnumpy") else np.asarray(arr)
+
+
+def fold_batchnorm(sym, arg_params, aux_params):
+    """Fold inference-mode BatchNorm into adjacent conv/FC weights.
+
+    Returns ``(fused_sym, fused_arg_params, fused_aux_params)``.  For
+    every foldable ``producer -> BatchNorm`` pair the BN node vanishes
+    and the producer's weight/bias values absorb the normalization:
+
+        scale = gamma / sqrt(moving_var + eps)
+        W'    = W * scale            (per output channel)
+        b'    = (b - moving_mean) * scale + beta
+
+    The rewritten graph computes the *inference* BN semantics exactly,
+    so it must only be used for serving/eval (train-mode batch stats
+    are gone by construction — that path is :func:`fuse_conv_bn_relu`).
+    Producers keep their names and weight/bias parameter names; the
+    folded BN's gamma/beta/moving_mean/moving_var entries are dropped
+    from the returned param dicts.  A producer that had ``no_bias``
+    gains a ``<name>_bias`` argument carrying the shift.
+    """
+    from ..ndarray.ndarray import array as nd_array
+
+    plan = _bn_fold_plan(sym)
+    new_args = dict(arg_params)
+    new_aux = dict(aux_params)
+    if not plan:
+        return sym, new_args, new_aux
+
+    existing_names = set(sym.list_arguments()) | \
+        set(sym.list_auxiliary_states())
+
+    def emit(node, ins, sub):
+        if id(node) not in plan:
+            return None
+        producer = plan[id(node)]
+        bn = node
+        names = S._op_input_names(bn.op, len(bn.inputs))
+        bn_vars = {nm: src.name for (src, _i), nm
+                   in zip(bn.inputs, names) if src.op is None}
+        eps = pfloat(bn.attrs.get("eps"), 1e-3)
+        gamma = _np_of(new_args, bn_vars["gamma"], new_aux)
+        beta = _np_of(new_args, bn_vars["beta"], new_aux)
+        mean = _np_of(new_aux, bn_vars["moving_mean"], new_args)
+        var = _np_of(new_aux, bn_vars["moving_var"], new_args)
+        if pbool(bn.attrs.get("fix_gamma"), True):
+            gamma = np.ones_like(gamma)
+        scale = gamma / np.sqrt(var + eps)
+        shift = beta - mean * scale
+
+        w_name = producer.inputs[1][0].name
+        w = _np_of(new_args, w_name)
+        w_scale_shape = (scale.shape[0],) + (1,) * (w.ndim - 1)
+        new_args[w_name] = nd_array(
+            (w * scale.reshape(w_scale_shape)).astype(w.dtype))
+
+        attrs = dict(producer.attrs)
+        if len(producer.inputs) > 2:  # existing bias
+            b_name = producer.inputs[2][0].name
+            b = _np_of(new_args, b_name)
+        else:
+            b_name = producer.name + "_bias"
+            while b_name in existing_names:
+                b_name += "_folded"
+            b = np.zeros((scale.shape[0],), w.dtype)
+            attrs.pop("no_bias", None)
+        new_args[b_name] = nd_array((b * scale + shift).astype(w.dtype))
+        # gamma/beta/moving_* entries are dropped by the live-name filter
+        # below (not popped here: a var shared with another consumer must
+        # survive)
+
+        prod_ins = [sub(e) for e in producer.inputs[:2]]
+        bias_sym = S.var(b_name) if len(producer.inputs) <= 2 \
+            else sub(producer.inputs[2])
+        attrs["no_bias"] = False
+        return S._invoke_sym(producer.op, prod_ins + [bias_sym], attrs,
+                             name=producer.name)
+
+    fused = rewrite_graph(sym, emit)
+    # drop param entries for vars no longer referenced by the graph
+    live = set(fused.list_arguments()) | set(fused.list_auxiliary_states())
+    new_args = {k: v for k, v in new_args.items() if k in live}
+    new_aux = {k: v for k, v in new_aux.items() if k in live}
+    return fused, new_args, new_aux
+
+
+# ---------------------------------------------------------------------------
+# training-mode conv+BN+ReLU fusion
+# ---------------------------------------------------------------------------
+
+
+def _cbr_plan(sym):
+    """Match Convolution -> BatchNorm [-> Activation(relu)] chains.
+
+    Returns ``{id(head_node): (conv, bn, has_act)}`` where head is the
+    relu when present, else the BN.  Inner nodes must have exactly one
+    consumer and not be graph outputs, so collapsing them is safe.
+    """
+    nodes = sym._topo_nodes()
+    consumers = _consumer_map(nodes)
+    entries = _entry_ids(sym)
+    plan = {}
+    for bn in nodes:
+        if bn.op != "BatchNorm" or pbool(bn.attrs.get("output_mean_var")):
+            continue
+        if pint(bn.attrs.get("axis"), 1) != 1:
+            continue
+        src, oi = bn.inputs[0]
+        if oi != 0 or src.op != "Convolution":
+            continue
+        if id(src) in entries or len(consumers.get(id(src), ())) != 1:
+            continue
+        if not all(_is_plain_var(n) for (n, _i) in bn.inputs[1:]):
+            continue
+        cons = consumers.get(id(bn), ())
+        head, has_act = bn, False
+        if id(bn) not in entries and len(cons) == 1:
+            act, pos = cons[0]
+            if act.op == "Activation" and pos == 0 and \
+                    act.attrs.get("act_type", "relu") == "relu":
+                head, has_act = act, True
+        plan[id(head)] = (src, bn, has_act)
+    return plan
+
+
+def fuse_conv_bn_relu(sym):
+    """Collapse conv->BN[->relu] chains into ``_contrib_conv_bn_relu``.
+
+    The fused op keeps BatchNorm's train/eval semantics (batch stats +
+    moving-average updates in train mode, moving stats in eval) and its
+    backward recomputes the normalized activation (jax.checkpoint
+    inside the op) instead of saving it — the HBM claw-back.  All
+    parameter and aux names are preserved: the fused node consumes the
+    very same variable nodes, so existing ``arg_params``/``aux_params``
+    bind unchanged.
+    """
+    plan = _cbr_plan(sym)
+    if not plan:
+        return sym
+
+    def emit(node, ins, sub):
+        chain = plan.get(id(node))
+        if chain is None:
+            return None
+        conv, bn, has_act = chain
+        data_s = sub(conv.inputs[0])
+        weight_s = sub(conv.inputs[1])
+        bias = [sub(conv.inputs[2])] if len(conv.inputs) > 2 else []
+        bn_ins = [sub(e) for e in bn.inputs[1:]]  # gamma..moving_var
+        attrs = {k: v for k, v in conv.attrs.items()
+                 if k not in ("no_bias",)}
+        attrs["no_bias"] = not bias
+        for k in ("eps", "momentum", "fix_gamma", "use_global_stats"):
+            if k in bn.attrs:
+                attrs[k] = bn.attrs[k]
+        attrs["act_type"] = "relu" if has_act else ""
+        return S._invoke_sym(
+            "_contrib_conv_bn_relu",
+            [data_s, weight_s] + bn_ins + bias, attrs,
+            name=conv.name + "_bn_act")
+
+    return rewrite_graph(sym, emit)

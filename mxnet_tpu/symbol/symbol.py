@@ -49,7 +49,12 @@ class _Node:
 AUX_INPUTS = {
     "BatchNorm": ("moving_mean", "moving_var"),
     "SyncBatchNorm": ("moving_mean", "moving_var"),
+    "_contrib_conv_bn_relu": ("moving_mean", "moving_var"),
 }
+
+# ops that return (out, batch_mean, batch_var) and whose bound moving
+# stats receive the momentum update in train mode (_build_fn)
+_MOVING_STAT_OPS = ("BatchNorm", "SyncBatchNorm", "_contrib_conv_bn_relu")
 
 # canonical input names per op for auto-created variables
 _INPUT_NAMES = {
@@ -57,6 +62,9 @@ _INPUT_NAMES = {
     "Convolution": ("data", "weight", "bias"),
     "Deconvolution": ("data", "weight", "bias"),
     "BatchNorm": ("data", "gamma", "beta", "moving_mean", "moving_var"),
+    # conv bias LAST so the aux positions are bias-independent
+    "_contrib_conv_bn_relu": ("data", "weight", "gamma", "beta",
+                              "moving_mean", "moving_var", "bias"),
     "LayerNorm": ("data", "gamma", "beta"),
     "InstanceNorm": ("data", "gamma", "beta"),
     "Embedding": ("data", "weight"),
@@ -326,7 +334,7 @@ class Symbol:
                 out = info.fn(*ins, **node.attrs)
                 out = out if isinstance(out, tuple) else (out,)
                 results[id(node)] = out
-                if node.op in ("BatchNorm", "SyncBatchNorm") and is_train \
+                if node.op in _MOVING_STAT_OPS and is_train \
                         and not pbool(node.attrs.get("use_global_stats")):
                     names = _op_input_names(node.op, len(node.inputs))
                     mom = float(node.attrs.get("momentum", 0.9))
@@ -456,7 +464,8 @@ class Symbol:
     # -- binding ---------------------------------------------------------
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
                     stype_dict=None, group2ctx=None, shared_arg_names=None,
-                    shared_exec=None, shared_buffer=None, **kwargs):
+                    shared_exec=None, shared_buffer=None, remat_policy=None,
+                    **kwargs):
         from ..executor import Executor
         from ..ndarray.ndarray import zeros as nd_zeros
         from ..context import current_context
@@ -477,10 +486,11 @@ class Symbol:
         aux = {n: nd_zeros(s, ctx=ctx)
                for n, s in zip(self.list_auxiliary_states(), aux_shapes)}
         return Executor(self, ctx, args, args_grad, grad_req, aux,
-                        shared_exec=shared_exec)
+                        shared_exec=shared_exec, remat_policy=remat_policy)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
-             aux_states=None, group2ctx=None, shared_exec=None):
+             aux_states=None, group2ctx=None, shared_exec=None,
+             remat_policy=None):
         from ..executor import Executor
 
         arg_names = self.list_arguments()
@@ -492,7 +502,8 @@ class Symbol:
         if isinstance(aux_states, (list, tuple)):
             aux_states = dict(zip(aux_names, aux_states))
         return Executor(self, ctx, args or {}, args_grad or {}, grad_req,
-                        aux_states or {}, shared_exec=shared_exec)
+                        aux_states or {}, shared_exec=shared_exec,
+                        remat_policy=remat_policy)
 
     # gradient: returns symbolic grad graph — TPU-native answer is vjp at
     # executor level; provided for API parity on simple cases.
@@ -606,6 +617,15 @@ def _deduce_param_shape(node, pos, input_name, node_out_shapes, shapes):
         ax = pint(attrs.get("axis"), 1)
         c = data_shape[ax]
         return (c,)
+    elif op == "_contrib_conv_bn_relu":
+        k = ptuple(attrs.get("kernel"))
+        nf = pint(attrs.get("num_filter"))
+        ng = pint(attrs.get("num_group"), 1)
+        if input_name == "weight":
+            return (nf, data_shape[1] // ng) + k
+        if input_name in ("gamma", "beta", "moving_mean", "moving_var",
+                          "bias"):
+            return (nf,)
     elif op in ("LayerNorm",):
         ax = pint(attrs.get("axis"), -1)
         return (data_shape[ax],)
@@ -753,6 +773,8 @@ def _expected_inputs(op_name, attrs):
     parameters (drives auto-var creation)."""
     if op_name in ("FullyConnected", "Convolution", "Deconvolution"):
         return 2 if pbool(attrs.get("no_bias")) else 3
+    if op_name == "_contrib_conv_bn_relu":
+        return 6 if pbool(attrs.get("no_bias"), True) else 7
     if op_name == "LeakyReLU":
         return 2 if attrs.get("act_type") == "prelu" else 1
     if op_name == "RNN":

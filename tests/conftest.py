@@ -42,6 +42,11 @@ else:
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 `-m 'not slow'` run")
+
 # modules whose tests need the multi-device CPU mesh (sharding/collectives
 # over 8 virtual devices) or CPU-pinned subprocesses; meaningless or
 # unrunnable against the single real chip

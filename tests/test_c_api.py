@@ -59,3 +59,44 @@ def test_c_client_trains_mlp_end_to_end(tmp_path):
     first = float(final.split("first=")[1].split()[0])
     last = float(final.split("last=")[1])
     assert last < first / 10.0, final
+
+
+def test_list_arguments_zero_arg_symbol():
+    """A symbol with no arguments must list cleanly: the trailing NUL
+    write in MXTPUSymbolListArguments was unchecked when n == 0, a
+    1-byte OOB write for cap == 0."""
+    import ctypes
+
+    _build()
+    lib = ctypes.CDLL(os.path.join(CPP, "libmxtpu_runtime.so"))
+    lib.MXTPUSessionCreate.argtypes = [ctypes.POINTER(ctypes.c_void_p)]
+    lib.MXTPUSessionFree.argtypes = [ctypes.c_void_p]
+    lib.MXTPUSymbolFromJSON.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_uint64)]
+    lib.MXTPUSymbolListArguments.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_size_t]
+    lib.mxtpu_api_last_error.restype = ctypes.c_char_p
+
+    os.environ.setdefault("MXTPU_PYTHON", sys.executable)
+    os.environ.setdefault("MXTPU_API_CPU", "1")
+    sess = ctypes.c_void_p()
+    if lib.MXTPUSessionCreate(ctypes.byref(sess)) != 0:
+        pytest.skip("api worker unavailable: %s"
+                    % lib.mxtpu_api_last_error())
+    try:
+        sym = mx.sym.zeros((2, 2))
+        assert sym.list_arguments() == []
+        h = ctypes.c_uint64()
+        assert lib.MXTPUSymbolFromJSON(
+            sess, sym.tojson().encode(), ctypes.byref(h)) == 0, \
+            lib.mxtpu_api_last_error()
+        buf = ctypes.create_string_buffer(16)
+        assert lib.MXTPUSymbolListArguments(sess, h.value, buf, 16) == 0, \
+            lib.mxtpu_api_last_error()
+        assert buf.value == b""
+        # cap == 0 has no room for the terminator: must fail loudly,
+        # never write
+        assert lib.MXTPUSymbolListArguments(sess, h.value, buf, 0) == -1
+    finally:
+        lib.MXTPUSessionFree(sess)

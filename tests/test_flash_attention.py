@@ -77,10 +77,10 @@ def test_flash_rejects_ragged_seq():
 
 def test_ring_attention_flash_engine():
     from jax.sharding import Mesh
-    from jax.experimental.shard_map import shard_map
     from jax.sharding import PartitionSpec as P
     import functools
 
+    from mxnet_tpu.parallel import shard_map
     from mxnet_tpu.parallel.ring_attention import ring_attention
 
     devs = jax.devices()[:4]
@@ -91,8 +91,33 @@ def test_ring_attention_flash_engine():
     fn = shard_map(functools.partial(ring_attention, axis_name="sp",
                                      use_flash=True),
                    mesh=mesh, in_specs=(spec, spec, spec),
-                   out_specs=spec, check_rep=False)
+                   out_specs=spec, check_vma=False)
     with jax.default_matmul_precision("float32"):
         out = fn(q, k, v)
         ref = local_attention(q, k, v)
     assert float(jnp.abs(out - ref).max()) < 5e-5
+
+
+def test_shard_map_shim_no_deprecation_warnings():
+    """The whole package routes shard_map through the version-portable
+    shim (parallel.mesh.shard_map); constructing and running a sharded
+    program must emit zero DeprecationWarnings from any shard_map
+    module (VERDICT r5 #8)."""
+    import warnings
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from mxnet_tpu.parallel import shard_map
+
+    mesh = Mesh(np.array(jax.devices()[:2]), ("sp",))
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        fn = shard_map(lambda a: a * 2, mesh=mesh,
+                       in_specs=(P("sp"),), out_specs=P("sp"),
+                       check_vma=False)
+        out = fn(jnp.arange(8, dtype=jnp.float32))
+    assert float(jnp.abs(out - 2 * jnp.arange(8)).max()) == 0.0
+    deps = [w for w in caught
+            if issubclass(w.category, DeprecationWarning)
+            and "shard_map" in str(getattr(w, "filename", ""))
+            + str(w.message)]
+    assert not deps, "shard_map DeprecationWarnings: %s" % deps

@@ -438,6 +438,9 @@ ZERO_GRAD = ["ceil", "floor", "round", "rint", "fix", "trunc", "sign"]
 
 # --- differentiable ops whose gradients live in dedicated suites ------
 COVERED = {
+    "_contrib_conv_bn_relu": "tests/test_graph_fusion.py (fused-vs-"
+                             "unfused conv/BN/relu grads + moving-stat "
+                             "parity)",
     "_image_to_tensor": "test_image_op_gradients in this file",
     "_image_normalize": "test_image_op_gradients in this file",
     "SoftmaxOutput": "test_loss_head_gradients_analytic in this file",

@@ -1,0 +1,326 @@
+"""Graph-level fusion passes (symbol/fusion.py) + remat policy control.
+
+Covers the HBM-roofline claw-back work: BN folding (inference), the
+fused conv+BN+ReLU training op, the shared rewrite engine, and the
+activation-remat policy knobs on Executor / CachedOp / ShardedTrainer.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.symbol.fusion import (fold_batchnorm, fuse_conv_bn_relu,
+                                     count_ops)
+
+_R = np.random.RandomState(7)
+
+
+def _conv_bn_relu_sym(no_bias=True, with_act=True, fix_gamma=False):
+    data = mx.sym.var("data")
+    c = mx.sym.Convolution(data, kernel=(3, 3), num_filter=4, pad=(1, 1),
+                           no_bias=no_bias, name="conv0")
+    b = mx.sym.BatchNorm(c, fix_gamma=fix_gamma, name="bn0")
+    if with_act:
+        b = mx.sym.Activation(b, act_type="relu", name="relu0")
+    return b
+
+
+def _bind_with(sym, x, vals=None, grad_req="null"):
+    exe = sym.simple_bind(ctx=mx.cpu(), grad_req=grad_req, data=x.shape)
+    vals = vals or {}
+    for n, a in exe.arg_dict.items():
+        if n == "data":
+            a._rebind(mx.nd.array(x)._data)
+        elif n in vals:
+            a._rebind(mx.nd.array(vals[n])._data)
+        else:
+            vals[n] = _R.rand(*a.shape).astype(np.float32)
+            a._rebind(mx.nd.array(vals[n])._data)
+    for n, a in exe.aux_dict.items():
+        if n in vals:
+            a._rebind(mx.nd.array(vals[n])._data)
+        else:
+            # non-trivial moving stats so folding is actually exercised
+            vals[n] = (np.abs(_R.rand(*a.shape)) + 0.5).astype(np.float32)
+            a._rebind(mx.nd.array(vals[n])._data)
+    return exe, vals
+
+
+# ---------------------------------------------------------------------------
+# BN folding (inference)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("no_bias", [True, False])
+def test_fold_batchnorm_conv_numerics(no_bias):
+    sym = _conv_bn_relu_sym(no_bias=no_bias)
+    x = _R.rand(2, 3, 8, 8).astype(np.float32)
+    exe, vals = _bind_with(sym, x)
+    ref = exe.forward(is_train=False)[0].asnumpy()
+
+    arg_params = {n: mx.nd.array(v) for n, v in vals.items()
+                  if n in sym.list_arguments() and n != "data"}
+    aux_params = {n: mx.nd.array(vals[n])
+                  for n in sym.list_auxiliary_states()}
+    fsym, fargs, faux = fold_batchnorm(sym, arg_params, aux_params)
+    assert count_ops(fsym, "BatchNorm") == 0
+    assert not faux and not fsym.list_auxiliary_states()
+    fexe = fsym.simple_bind(ctx=mx.cpu(), grad_req="null", data=x.shape)
+    fexe.copy_params_from(fargs, faux)
+    fexe.arg_dict["data"]._rebind(mx.nd.array(x)._data)
+    out = fexe.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(out, ref, atol=1e-5, rtol=1e-5)
+
+
+def test_fold_batchnorm_fully_connected():
+    data = mx.sym.var("data")
+    fc = mx.sym.FullyConnected(data, num_hidden=5, name="fc0")
+    bn = mx.sym.BatchNorm(fc, fix_gamma=False, name="bn0")
+    x = _R.rand(3, 4).astype(np.float32)
+    exe, vals = _bind_with(bn, x)
+    ref = exe.forward(is_train=False)[0].asnumpy()
+    arg_params = {n: mx.nd.array(v) for n, v in vals.items()
+                  if n in bn.list_arguments() and n != "data"}
+    aux_params = {n: mx.nd.array(vals[n])
+                  for n in bn.list_auxiliary_states()}
+    fsym, fargs, faux = fold_batchnorm(bn, arg_params, aux_params)
+    assert count_ops(fsym, "BatchNorm") == 0
+    fexe = fsym.simple_bind(ctx=mx.cpu(), grad_req="null", data=x.shape)
+    fexe.copy_params_from(fargs, faux)
+    fexe.arg_dict["data"]._rebind(mx.nd.array(x)._data)
+    np.testing.assert_allclose(fexe.forward()[0].asnumpy(), ref,
+                               atol=1e-5, rtol=1e-5)
+
+
+def test_fold_batchnorm_skips_shared_producer():
+    """A conv output consumed by BN *and* a second op must not fold —
+    the rewritten weights would corrupt the other consumer."""
+    data = mx.sym.var("data")
+    c = mx.sym.Convolution(data, kernel=(1, 1), num_filter=2, name="conv0")
+    b = mx.sym.BatchNorm(c, name="bn0")
+    g = mx.sym.Group([b, mx.sym.sum(c)])
+    fsym, _, _ = fold_batchnorm(g, {}, {})
+    assert count_ops(fsym, "BatchNorm") == 1
+
+
+def _model_zoo_fold_check(net_fn, in_shape, tol=1e-5):
+    net = net_fn()
+    net.initialize(mx.init.Xavier())
+    # one abstract pass finishes deferred param shapes without device
+    # compute, so collect_params().data() works below
+    from mxnet_tpu.gluon.block import _abstract_eval_forward
+
+    with mx.autograd.pause():
+        _abstract_eval_forward(
+            net, [mx.nd.array(np.zeros(in_shape, np.float32))])
+    sym = net(mx.sym.var("data"))
+    n_bn = count_ops(sym, "BatchNorm")
+    assert n_bn > 0
+    params = {k: p.data() for k, p in net.collect_params().items()}
+    aux_names = set(sym.list_auxiliary_states())
+    arg_params = {k: v for k, v in params.items() if k not in aux_names}
+    aux_params = {k: v for k, v in params.items() if k in aux_names}
+
+    x = _R.rand(*in_shape).astype(np.float32)
+    exe = sym.simple_bind(ctx=mx.cpu(), grad_req="null", data=in_shape)
+    exe.copy_params_from(arg_params, aux_params, allow_extra_params=True)
+    exe.arg_dict["data"]._rebind(mx.nd.array(x)._data)
+    ref = exe.forward(is_train=False)[0].asnumpy()
+
+    fsym, fargs, faux = fold_batchnorm(sym, arg_params, aux_params)
+    assert count_ops(fsym, "BatchNorm") == 0, \
+        "BN nodes survived the fold"
+    fexe = fsym.simple_bind(ctx=mx.cpu(), grad_req="null", data=in_shape)
+    fexe.copy_params_from(fargs, faux, allow_extra_params=True)
+    fexe.arg_dict["data"]._rebind(mx.nd.array(x)._data)
+    out = fexe.forward(is_train=False)[0].asnumpy()
+    assert np.abs(out - ref).max() <= tol, \
+        "fused/unfused diverge: %g" % np.abs(out - ref).max()
+
+
+def test_fold_batchnorm_model_zoo_resnet():
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    _model_zoo_fold_check(lambda: vision.resnet18_v1(classes=10),
+                          (2, 3, 32, 32))
+
+
+@pytest.mark.slow
+def test_fold_batchnorm_model_zoo_inception():
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    _model_zoo_fold_check(lambda: vision.inception_v3(classes=10),
+                          (1, 3, 299, 299))
+
+
+# ---------------------------------------------------------------------------
+# fused conv+BN+ReLU (training)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("with_act", [True, False])
+def test_fuse_conv_bn_relu_train_parity(with_act):
+    sym = mx.sym.sum(_conv_bn_relu_sym(with_act=with_act), name="loss")
+    fsym = fuse_conv_bn_relu(sym)
+    assert count_ops(fsym, "_contrib_conv_bn_relu") == 1
+    assert count_ops(fsym, "BatchNorm") == 0
+    assert count_ops(fsym, "Convolution") == 0
+    # arg/aux names preserved: params bind unchanged
+    assert fsym.list_arguments() == sym.list_arguments()
+    assert fsym.list_auxiliary_states() == sym.list_auxiliary_states()
+
+    x = _R.rand(2, 3, 8, 8).astype(np.float32)
+    exe, vals = _bind_with(sym, x, grad_req="write")
+    fexe, _ = _bind_with(fsym, x, vals=vals, grad_req="write")
+    for e in (exe, fexe):
+        e.forward(is_train=True)
+        e.backward()
+    np.testing.assert_allclose(fexe.outputs[0].asnumpy(),
+                               exe.outputs[0].asnumpy(), atol=1e-5)
+    for n in exe.grad_dict:
+        np.testing.assert_allclose(fexe.grad_dict[n].asnumpy(),
+                                   exe.grad_dict[n].asnumpy(), atol=1e-5,
+                                   err_msg="grad %s" % n)
+    for n in exe.aux_dict:  # moving-stat updates flow identically
+        np.testing.assert_allclose(fexe.aux_dict[n].asnumpy(),
+                                   exe.aux_dict[n].asnumpy(), atol=1e-6,
+                                   err_msg="aux %s" % n)
+    # eval after the train step uses the updated moving stats
+    for e in (exe, fexe):
+        e.forward(is_train=False)
+    np.testing.assert_allclose(fexe.outputs[0].asnumpy(),
+                               exe.outputs[0].asnumpy(), atol=1e-5)
+
+
+def test_fuse_conv_bn_relu_model_zoo_resnet():
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.resnet18_v1(classes=10)
+    net.initialize(mx.init.Xavier())
+    sym = net(mx.sym.var("data"))
+    fsym = fuse_conv_bn_relu(sym)
+    assert count_ops(fsym, "BatchNorm") == 0
+    assert count_ops(fsym, "Convolution") == 0
+    assert count_ops(fsym, "_contrib_conv_bn_relu") == \
+        count_ops(sym, "Convolution")
+
+
+def test_fuse_skips_non_relu_activation():
+    data = mx.sym.var("data")
+    c = mx.sym.Convolution(data, kernel=(1, 1), num_filter=2, name="conv0")
+    b = mx.sym.BatchNorm(c, name="bn0")
+    t = mx.sym.Activation(b, act_type="tanh", name="tanh0")
+    fsym = fuse_conv_bn_relu(t)
+    # conv+BN still fuse; the tanh stays a separate node
+    assert count_ops(fsym, "_contrib_conv_bn_relu") == 1
+    assert count_ops(fsym, "Activation") == 1
+
+
+# ---------------------------------------------------------------------------
+# remat policy plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_remat_policy_names():
+    from mxnet_tpu.remat import list_policies, resolve_policy
+
+    names = list_policies()
+    assert "none" in names and "dots_with_no_batch_dims_saveable" in names
+    assert resolve_policy("none") == (False, None)
+    active, pol = resolve_policy("dots_saveable")
+    assert active and callable(pol)
+    with pytest.raises(ValueError, match="unknown remat_policy"):
+        resolve_policy("not_a_policy")
+
+
+def test_executor_remat_policy_matches_baseline():
+    sym = mx.sym.sum(_conv_bn_relu_sym(), name="loss")
+    x = _R.rand(2, 3, 8, 8).astype(np.float32)
+    exe, vals = _bind_with(sym, x, grad_req="write")
+    exe.forward(is_train=True)
+    exe.backward()
+    ref_grads = {n: g.asnumpy() for n, g in exe.grad_dict.items()}
+
+    rexe = sym.simple_bind(ctx=mx.cpu(), grad_req="write", data=x.shape,
+                           remat_policy="nothing_saveable")
+    rexe.copy_params_from(
+        {n: mx.nd.array(v) for n, v in vals.items() if n != "data"},
+        allow_extra_params=True)
+    rexe.arg_dict["data"]._rebind(mx.nd.array(x)._data)
+    rexe.forward(is_train=True)
+    rexe.backward()
+    for n, g in ref_grads.items():
+        np.testing.assert_allclose(rexe.grad_dict[n].asnumpy(), g,
+                                   atol=1e-5, err_msg=n)
+
+
+def test_executor_rejects_bad_remat_policy():
+    sym = mx.sym.sum(_conv_bn_relu_sym(), name="loss")
+    with pytest.raises(ValueError, match="unknown remat_policy"):
+        sym.simple_bind(ctx=mx.cpu(), grad_req="write",
+                        data=(2, 3, 8, 8), remat_policy="typo")
+
+
+def test_hybridize_remat_policy():
+    from mxnet_tpu import gluon, autograd
+
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(8, activation="relu"),
+                gluon.nn.Dense(4))
+    net.initialize()
+    x = mx.nd.array(_R.rand(2, 6).astype(np.float32))
+    ref = net(x).asnumpy()
+
+    net.hybridize(remat_policy="dots_saveable")
+    out = net(x)
+    np.testing.assert_allclose(out.asnumpy(), ref, atol=1e-5)
+    with autograd.record():
+        loss = net(x).sum()
+    loss.backward()
+    g = net.collect_params()[
+        list(net.collect_params().keys())[0]].grad().asnumpy()
+    assert np.isfinite(g).all()
+
+
+def test_sharded_trainer_remat_policy_trains():
+    from mxnet_tpu import gluon, parallel
+
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(16, activation="relu"),
+                gluon.nn.Dense(4))
+    net.initialize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = parallel.ShardedTrainer(
+        net, lambda o, l: loss_fn(o, l), optimizer="sgd",
+        optimizer_params={"learning_rate": 0.1},
+        remat_policy="nothing_saveable")
+    x = mx.nd.array(_R.rand(8, 6).astype(np.float32))
+    y = mx.nd.array(_R.randint(0, 4, 8).astype(np.float32))
+    losses = [float(trainer.step([x], y)) for _ in range(8)]
+    assert losses[-1] < losses[0], losses
+
+
+def test_executor_cotangent_struct_cache():
+    """backward() with default head grads must abstract-trace once, not
+    once per step (ADVICE r5)."""
+    import jax
+
+    sym = mx.sym.sum(_conv_bn_relu_sym(), name="loss")
+    x = _R.rand(2, 3, 8, 8).astype(np.float32)
+    exe, _ = _bind_with(sym, x, grad_req="write")
+    calls = {"n": 0}
+    real = jax.eval_shape
+
+    def counting(*a, **k):
+        calls["n"] += 1
+        return real(*a, **k)
+
+    jax.eval_shape = counting
+    try:
+        for _ in range(3):
+            exe.forward(is_train=True)
+            exe.backward()
+    finally:
+        jax.eval_shape = real
+    assert calls["n"] == 1, "eval_shape re-ran per step: %d" % calls["n"]

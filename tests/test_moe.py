@@ -142,3 +142,28 @@ def test_moe_grads_flow_through_router():
 
     g = jax.grad(loss)(gate_w)
     assert float(jnp.abs(g).sum()) > 0
+
+
+def test_moe_top_k_validated_early():
+    """A bad top_k must raise a loud ValueError up front (make_mesh
+    convention), not an opaque lax.top_k shape error mid-trace."""
+    rng = np.random.RandomState(3)
+    n_dev, T, D, H = 8, 8, 6, 10
+    x = rng.randn(n_dev * T, D).astype(np.float32)
+    gate_w = rng.randn(D, n_dev).astype(np.float32)
+    w_in = rng.randn(n_dev, D, H).astype(np.float32)
+    w_out = rng.randn(n_dev, H, D).astype(np.float32)
+    mesh = parallel.make_mesh({"ep": n_dev})
+    for bad in (0, -1, n_dev + 1, "2"):
+        with pytest.raises(ValueError, match="top_k"):
+            parallel.moe_ffn_sharded(mesh, x, gate_w, w_in, w_out,
+                                     top_k=bad)
+
+
+def test_moe_top_k_accepts_numpy_ints_rejects_bool():
+    from mxnet_tpu.parallel.moe import _check_top_k
+
+    _check_top_k(np.int64(2), 8)   # numpy ints worked before validation
+    _check_top_k(2, 8)
+    with pytest.raises(ValueError, match="top_k"):
+        _check_top_k(True, 8)      # bool is not a top_k

@@ -156,3 +156,28 @@ def test_predictor_batch_shape_without_dtype_defaults_on_first_batch():
     b = np.ones((4, 3), np.float32)
     out = list(pred.predict([b]))
     np.testing.assert_allclose(out[0], b * 2.0)
+
+
+def test_predictor_implicit_contract_warns_only_when_dtype_unpinned():
+    """Predictor without batch_shape= but WITH batch_dtype= (the common
+    programmatic path) must construct and run silently; only a fully
+    implicit contract (neither pinned) warns on the first batch."""
+    import warnings
+
+    import numpy as np
+
+    from mxnet_tpu.serving import Predictor
+
+    b = np.ones((4, 3), np.float32)
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        pred = Predictor(lambda x, params: x + 1.0, [],
+                         batch_dtype=np.float32)
+        list(pred.predict([b]))
+    assert not [x for x in w if "batch contract" in str(x.message)], w
+
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        pred = Predictor(lambda x, params: x + 1.0, [])
+        list(pred.predict([b]))
+    assert [x for x in w if "batch contract" in str(x.message)]

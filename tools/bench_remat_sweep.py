@@ -1,0 +1,130 @@
+"""Activation-remat policy sweep over the benchmark-of-record step.
+
+Runs bench.build_trainer (the exact ResNet-50 program bench.py's
+headline number comes from) once per remat policy and reports img/s,
+peak live HBM (from XLA's cost analysis where available) and the delta
+vs the no-remat baseline.  VERDICT r5 #6's done-bar: either a >=5%
+img/s win lands as the new default, or the measured no-win table is
+committed to docs/perf_notes.md.
+
+Usage:
+    python tools/bench_remat_sweep.py [--policies a,b,c] [--steps N]
+        [--batch B] [--json out.json]
+
+On a CPU-only box this still runs (small batch, few steps) so the
+sweep machinery is testable anywhere; the committed numbers must come
+from the TPU chip.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _policies(arg):
+    if arg:
+        return arg.split(",")
+    from mxnet_tpu.remat import list_policies
+
+    # offload needs pinned-host support; include only on TPU
+    import jax
+
+    names = [n for n in list_policies() if not n.startswith("offload")]
+    if any(d.platform == "tpu" for d in jax.devices()):
+        names += [n for n in list_policies() if n.startswith("offload")]
+    # 'none' first: it is the baseline every delta is computed against
+    names.sort(key=lambda n: (n != "none", n))
+    return names
+
+
+def run_policy(policy, steps, warmup, batch):
+    import jax
+
+    import bench
+
+    # pass 'none' through verbatim: None would fall back to the
+    # MXNET_REMAT_POLICY env default and silently remat the baseline
+    trainer, x, y, _batch, on_tpu = bench.build_trainer(
+        batch=batch, remat_policy=policy)
+    for i in range(warmup):
+        loss = trainer.step([x], y)
+        jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = trainer.step([x], y)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    ips = _batch * steps / dt
+    # live-memory estimate from the compiled step (bytes accessed is the
+    # roofline-relevant number; TPU runtimes also expose peak bytes)
+    stats = {}
+    try:
+        lowered = trainer._step_fn.lower(
+            trainer.param_arrays, trainer.opt_state,
+            tuple(a._data if hasattr(a, "_data") else a for a in [x]),
+            y._data if hasattr(y, "_data") else y,
+            jax.random.PRNGKey(0))
+        cost = lowered.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        for k in ("bytes accessed", "flops"):
+            if k in cost:
+                stats[k] = float(cost[k])
+    except Exception:
+        pass
+    return {"policy": policy, "img_per_sec": round(ips, 2),
+            "batch": _batch, "steps": steps, "on_tpu": on_tpu,
+            "loss": float(loss), **stats}
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--policies", default="")
+    ap.add_argument("--steps", type=int,
+                    default=int(os.environ.get("BENCH_STEPS", "40")))
+    ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--batch", type=int, default=None)
+    ap.add_argument("--json", default="")
+    args = ap.parse_args()
+
+    import jax
+
+    on_tpu = any(d.platform != "cpu" for d in jax.devices())
+    steps = args.steps if on_tpu else min(args.steps, 3)
+    warmup = args.warmup if on_tpu else 1
+
+    rows = []
+    for pol in _policies(args.policies):
+        print("[sweep] %s ..." % pol, file=sys.stderr, flush=True)
+        try:
+            rows.append(run_policy(pol, steps, warmup, args.batch))
+        except Exception as e:
+            rows.append({"policy": pol, "error": str(e)[:200]})
+        print("[sweep] %s -> %s" % (pol, rows[-1]), file=sys.stderr,
+              flush=True)
+
+    base = next((r for r in rows if r["policy"] == "none"
+                 and "img_per_sec" in r), None)
+    lines = ["| policy | img/s | vs none |", "|---|---|---|"]
+    for r in rows:
+        if "error" in r:
+            lines.append("| %s | error: %s | — |"
+                         % (r["policy"], r["error"]))
+            continue
+        rel = "%.1f%%" % (100.0 * (r["img_per_sec"] / base["img_per_sec"]
+                                   - 1.0)) if base else "—"
+        lines.append("| %s | %s | %s |" % (r["policy"], r["img_per_sec"],
+                                           rel))
+    table = "\n".join(lines)
+    print(table)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump({"rows": rows, "table": table}, f, indent=2)
+
+
+if __name__ == "__main__":
+    main()

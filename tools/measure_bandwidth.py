@@ -85,12 +85,14 @@ def measure_psum(shapes, num_batches):
         def ar(tensors):
             return [jax.lax.psum(t, "dp") for t in tensors]
 
+        from mxnet_tpu.parallel import shard_map
+
         # args structure is the single list-typed parameter: the specs
         # pytree must be a 1-tuple wrapping the per-tensor list
         allreduce = jax.jit(
-            jax.shard_map(ar, mesh=mesh,
-                          in_specs=([P()] * len(shapes),),
-                          out_specs=[P()] * len(shapes)))
+            shard_map(ar, mesh=mesh,
+                      in_specs=([P()] * len(shapes),),
+                      out_specs=[P()] * len(shapes)))
         mesh_arrays = [jax.device_put(a, NamedSharding(mesh, P()))
                        for a in mesh_arrays]
 
