@@ -79,6 +79,8 @@ from . import operator  # noqa: F401
 from . import util  # noqa: F401
 
 from . import remat  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .checkpoint import CheckpointManager  # noqa: F401
 
 __version__ = "2.0.0.tpu1"
 

@@ -1,0 +1,619 @@
+"""Fault-tolerant checkpointing and step-guard layer.
+
+The reference framework writes checkpoints with a bare in-place binary
+``open`` (python/mxnet/model.py:394, gluon/trainer.py save_states): a
+preemption mid-write leaves a truncated pickle that loads as garbage or
+not at all.  TPU fleets are routinely preemptible, so this module makes
+persistence crash-safe and training loss-spike-safe:
+
+* :func:`atomic_write` / :func:`atomic_writer` — temp file in the target
+  directory + flush + ``fsync`` + ``os.replace``.  A crash at any point
+  leaves either the old complete file or the new complete file, never a
+  torn one.
+* :class:`CheckpointManager` — step-indexed checkpoints (one ``.npz``
+  data file + one sidecar JSON manifest carrying per-array SHA-256
+  digests and user metadata).  The manifest is written *after* the data
+  file, so manifest-present == checkpoint-complete.  Loads verify every
+  digest and fall back to the newest *intact* checkpoint with a loud
+  warning when the latest is corrupt.  Retention keeps the last N.
+  ``async_save=True`` snapshots device arrays to host synchronously and
+  serializes in a background thread so the train step is not blocked on
+  disk; ``wait()`` is the barrier.
+* :meth:`CheckpointManager.install_preemption_handler` — SIGTERM/SIGINT
+  flush a final checkpoint (after draining any in-flight async save)
+  and set ``manager.preempted`` so training loops can exit cleanly.
+* Non-finite step guards — :func:`nonfinite_policy` resolves the
+  ``"warn" | "skip" | "raise" | "off"`` policy (env default
+  ``MXNET_NONFINITE_POLICY``); ``"skip"`` lets a front-end discard a
+  NaN/Inf update and keep the previous params/optimizer state, the
+  building block for loss-scale backoff.
+* :func:`retry` — bounded-retry-with-backoff helper shared by the
+  model-zoo download path and the serving host->device upload path.
+
+Only stdlib + numpy at import time: every persistence front-end
+(ndarray.save, Module, gluon.Trainer, ShardedTrainer) can depend on this
+module without import cycles.
+"""
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import logging
+import os
+import random as _pyrandom
+import signal
+import tempfile
+import threading
+import time
+import warnings
+
+import numpy as np
+
+from .base import MXNetError
+
+__all__ = ["AtomicWriteError", "CheckpointCorruptError", "NonfiniteError",
+           "atomic_write", "atomic_writer", "retry", "CheckpointManager",
+           "Checkpoint", "nonfinite_policy", "check_finite",
+           "NONFINITE_POLICIES"]
+
+MANIFEST_FORMAT = 1
+
+_ARRAY_KEY = "array:"
+_BLOB_KEY = "blob:"
+
+
+class AtomicWriteError(MXNetError):
+    """An atomic write could not be completed (the target is untouched)."""
+
+
+class CheckpointCorruptError(MXNetError):
+    """A checkpoint failed digest/structure verification."""
+
+
+class NonfiniteError(MXNetError):
+    """A guarded value (loss/gradient norm) was NaN or Inf under the
+    ``"raise"`` non-finite policy."""
+
+
+# ---------------------------------------------------------------------------
+# atomic writes
+# ---------------------------------------------------------------------------
+
+def _fsync_dir(dirname):
+    """fsync the directory so the rename itself is durable (best-effort:
+    some filesystems refuse O_RDONLY dir fsync)."""
+    try:
+        fd = os.open(dirname, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+@contextlib.contextmanager
+def atomic_writer(path, mode="wb"):
+    """Context manager yielding a file object whose contents appear at
+    ``path`` atomically on clean exit.
+
+    The temp file lives in the target directory (``os.replace`` must not
+    cross filesystems) and is fsync'd before the rename; on any error the
+    temp file is removed and ``path`` is untouched.
+    """
+    if mode not in ("wb", "w"):
+        raise AtomicWriteError("atomic_writer supports 'wb'/'w', got %r"
+                               % (mode,))
+    path = os.fspath(path)
+    dirname = os.path.dirname(os.path.abspath(path))
+    fd, tmp = tempfile.mkstemp(dir=dirname,
+                               prefix=os.path.basename(path) + ".",
+                               suffix=".tmp")
+    f = os.fdopen(fd, mode)
+    try:
+        yield f
+        f.flush()
+        os.fsync(f.fileno())
+        f.close()
+        os.replace(tmp, path)
+        _fsync_dir(dirname)
+    except BaseException:
+        try:
+            f.close()
+        except Exception:
+            pass
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+
+
+def atomic_write(path, data):
+    """Write ``data`` (bytes or str) to ``path`` atomically."""
+    mode = "w" if isinstance(data, str) else "wb"
+    with atomic_writer(path, mode=mode) as f:
+        f.write(data)
+
+
+# ---------------------------------------------------------------------------
+# bounded retry
+# ---------------------------------------------------------------------------
+
+def retry(fn, retries=3, backoff=0.05, jitter=0.5, exceptions=(OSError,),
+          logger=None):
+    """Wrap ``fn`` with bounded retries + exponential backoff + jitter.
+
+    ``retries`` is the number of *re*-attempts after the first call (so
+    the function runs at most ``retries + 1`` times).  Backoff doubles
+    per attempt; jitter adds a uniform fraction of the current delay so
+    a fleet of workers retrying a shared endpoint does not stampede in
+    lockstep.  Only ``exceptions`` are retried — anything else
+    propagates immediately.
+    """
+    if retries < 0:
+        raise ValueError("retries must be >= 0, got %r" % (retries,))
+
+    def wrapped(*args, **kwargs):
+        delay = backoff
+        for attempt in range(retries + 1):
+            try:
+                return fn(*args, **kwargs)
+            except exceptions as e:
+                if attempt == retries:
+                    raise
+                sleep = delay * (1.0 + jitter * _pyrandom.random())
+                (logger or logging).warning(
+                    "retry %d/%d after %s: %s (sleeping %.3fs)",
+                    attempt + 1, retries, getattr(fn, "__name__", fn), e,
+                    sleep)
+                time.sleep(sleep)
+                delay *= 2
+        raise AssertionError("unreachable")
+
+    wrapped.__name__ = "retry(%s)" % getattr(fn, "__name__", "fn")
+    return wrapped
+
+
+# ---------------------------------------------------------------------------
+# non-finite step-guard policy
+# ---------------------------------------------------------------------------
+
+NONFINITE_POLICIES = ("off", "warn", "skip", "raise")
+
+
+def nonfinite_policy(policy=None):
+    """Resolve a non-finite policy: explicit arg wins, else the
+    ``MXNET_NONFINITE_POLICY`` env flag (default ``"warn"``)."""
+    if policy is None:
+        from . import config as _config
+
+        policy = _config.get("MXNET_NONFINITE_POLICY") or "warn"
+    if policy not in NONFINITE_POLICIES:
+        raise MXNetError("unknown non-finite policy %r (choose from %s or "
+                         "None for the MXNET_NONFINITE_POLICY default)"
+                         % (policy, "/".join(NONFINITE_POLICIES)))
+    return policy
+
+
+def check_finite(values, policy, what="loss", logger=None):
+    """Apply ``policy`` to host value(s); returns whether the pending
+    update should be APPLIED.
+
+    ``True``  — values finite, or policy is ``off``/``warn`` (the warn
+    policy reports but does not discard).  ``False`` — values non-finite
+    under ``skip``: the caller must discard the update and keep the
+    previous params/optimizer state.  Raises :class:`NonfiniteError`
+    under ``raise``.
+    """
+    if policy == "off":
+        return True
+    if not isinstance(values, (list, tuple)):
+        values = [values]
+    finite = True
+    for v in values:
+        a = np.asarray(v)
+        if a.dtype.kind in "fc" and not bool(np.all(np.isfinite(a))):
+            finite = False
+            break
+    if finite:
+        return True
+    msg = ("non-finite %s detected (policy=%s)" % (what, policy))
+    if policy == "raise":
+        raise NonfiniteError(msg)
+    if policy == "skip":
+        (logger or logging).warning("%s: discarding this update, keeping "
+                                    "previous params/optimizer state", msg)
+        return False
+    warnings.warn(msg + ": continuing; results will be undefined",
+                  stacklevel=2)
+    return True
+
+
+# ---------------------------------------------------------------------------
+# checkpoint manager
+# ---------------------------------------------------------------------------
+
+def _digest(arr):
+    arr = np.ascontiguousarray(arr)
+    return hashlib.sha256(arr.tobytes()).hexdigest()
+
+
+def _to_host(v):
+    """Snapshot any array-like (NDArray / jax array / numpy / scalar) to
+    a host numpy array — the synchronous part of an async save.
+
+    Always a COPY: ``np.asarray`` can return a view (of a caller-owned
+    numpy array, or zero-copy of a jax CPU buffer that the next train
+    step will donate/delete), and the async writer thread must never
+    read memory the training loop is about to reuse."""
+    if hasattr(v, "asnumpy"):
+        v = v.asnumpy()
+    return np.array(v, copy=True)
+
+
+class Checkpoint:
+    """One loaded checkpoint: ``step``, ``arrays`` (name -> numpy),
+    ``blobs`` (name -> bytes), ``meta`` (the user dict), ``path``."""
+
+    def __init__(self, step, arrays, blobs, meta, path):
+        self.step = step
+        self.arrays = arrays
+        self.blobs = blobs
+        self.meta = meta
+        self.path = path
+
+    def __repr__(self):
+        return ("Checkpoint(step=%d, arrays=%d, blobs=%d, path=%r)"
+                % (self.step, len(self.arrays), len(self.blobs), self.path))
+
+
+class CheckpointManager:
+    """Atomic, digest-verified, optionally-async checkpoint store.
+
+    Layout under ``directory`` (one pair per step)::
+
+        {prefix}-{step:08d}.npz    # arrays + blobs (written first)
+        {prefix}-{step:08d}.json   # manifest (written last = commit mark)
+
+    The manifest carries per-array SHA-256 digests, shapes/dtypes, blob
+    digests, wall-clock time, and arbitrary user ``meta``.  ``load()``
+    verifies every digest and, when the newest checkpoint fails, warns
+    loudly and falls back to the newest intact one.
+    """
+
+    def __init__(self, directory, prefix="ckpt", keep_last=None,
+                 async_save=None, logger=None):
+        from . import config as _config
+
+        self.directory = os.fspath(directory)
+        if not prefix or any(c in prefix for c in "/\\"):
+            raise MXNetError("invalid checkpoint prefix %r" % (prefix,))
+        self.prefix = prefix
+        self.keep_last = (_config.get("MXNET_CHECKPOINT_KEEP")
+                          if keep_last is None else int(keep_last))
+        if self.keep_last < 1:
+            raise MXNetError("keep_last must be >= 1, got %r"
+                             % (self.keep_last,))
+        self.async_save = (_config.get("MXNET_CHECKPOINT_ASYNC")
+                           if async_save is None else bool(async_save))
+        self.logger = logger or logging.getLogger("mxnet_tpu.checkpoint")
+        self.preempted = False
+        os.makedirs(self.directory, exist_ok=True)
+        self._thread = None
+        self._pending_error = None
+        self._lock = threading.Lock()
+        self._prev_handlers = {}
+
+    # -- paths -----------------------------------------------------------
+    def _base(self, step):
+        return os.path.join(self.directory,
+                            "%s-%08d" % (self.prefix, int(step)))
+
+    def data_path(self, step):
+        return self._base(step) + ".npz"
+
+    def manifest_path(self, step):
+        return self._base(step) + ".json"
+
+    def steps(self):
+        """Steps with a committed manifest, ascending (no verification)."""
+        out = []
+        pre = self.prefix + "-"
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return out
+        for n in names:
+            if n.startswith(pre) and n.endswith(".json"):
+                stem = n[len(pre):-len(".json")]
+                if stem.isdigit():
+                    out.append(int(stem))
+        return sorted(out)
+
+    def latest_step(self):
+        """Newest committed step, or None (manifest presence only — use
+        ``load()`` for digest-verified access)."""
+        steps = self.steps()
+        return steps[-1] if steps else None
+
+    # -- save ------------------------------------------------------------
+    def save(self, step, arrays, blobs=None, meta=None, block=None):
+        """Checkpoint ``arrays`` (+ optional ``blobs``/``meta``) as
+        ``step``.
+
+        Device arrays are snapshot to host *synchronously* (so the
+        caller may mutate/donate them immediately after); serialization,
+        digesting, fsync and retention run in a background thread when
+        async is on.  ``block=True`` forces a synchronous save.  Errors
+        from a previous async save re-raise here or at :meth:`wait`.
+        """
+        step = int(step)
+        if block is None:
+            block = not self.async_save
+        host = {}
+        for name, v in arrays.items():
+            if name.startswith(_BLOB_KEY) or name.startswith(_ARRAY_KEY):
+                raise MXNetError("array name %r collides with the "
+                                 "checkpoint key namespace" % (name,))
+            host[name] = _to_host(v)
+        blobs = dict(blobs or {})
+        for name, b in blobs.items():
+            if not isinstance(b, (bytes, bytearray)):
+                raise MXNetError("blob %r must be bytes, got %s"
+                                 % (name, type(b).__name__))
+        meta = dict(meta or {})
+        # one in-flight async save at a time: overlapping saves serialize
+        # (the async-overlap contract — order preserved, none dropped)
+        self.wait()
+        if block:
+            self._write(step, host, blobs, meta)
+            return
+        t = threading.Thread(target=self._write_guarded,
+                             args=(step, host, blobs, meta),
+                             name="ckpt-save-%d" % step, daemon=True)
+        with self._lock:
+            self._thread = t
+        t.start()
+
+    def _write_guarded(self, step, host, blobs, meta):
+        try:
+            self._write(step, host, blobs, meta)
+        except BaseException as e:  # surfaced on wait()/next save
+            with self._lock:
+                self._pending_error = e
+
+    def _write(self, step, host, blobs, meta):
+        payload = {_ARRAY_KEY + k: v for k, v in host.items()}
+        payload.update({_BLOB_KEY + k: np.frombuffer(bytes(b), np.uint8)
+                        for k, b in blobs.items()})
+        data_path = self.data_path(step)
+        with atomic_writer(data_path) as f:
+            np.savez(f, **payload)
+        manifest = {
+            "format_version": MANIFEST_FORMAT,
+            "prefix": self.prefix,
+            "step": step,
+            "time": time.time(),
+            "data_file": os.path.basename(data_path),
+            "data_size": os.path.getsize(data_path),
+            "arrays": {k: {"sha256": _digest(v),
+                           "shape": list(v.shape),
+                           "dtype": str(v.dtype)}
+                       for k, v in host.items()},
+            "blobs": {k: {"sha256": hashlib.sha256(bytes(b)).hexdigest(),
+                          "size": len(b)}
+                      for k, b in blobs.items()},
+            "meta": meta,
+        }
+        # the manifest is the commit record: readers ignore any .npz
+        # without one, so a crash between the two writes is invisible
+        atomic_write(self.manifest_path(step),
+                     json.dumps(manifest, indent=1, sort_keys=True,
+                                default=str))
+        self.logger.info("saved checkpoint step %d -> %s", step, data_path)
+        self._retain()
+
+    def _retain(self):
+        steps = self.steps()
+        for s in steps[:-self.keep_last] if len(steps) > self.keep_last \
+                else []:
+            # manifest first: a half-deleted checkpoint must not look
+            # committed
+            for p in (self.manifest_path(s), self.data_path(s)):
+                try:
+                    os.unlink(p)
+                except OSError:
+                    pass
+
+    def wait(self):
+        """Barrier: block until the in-flight async save (if any) has
+        committed; re-raise its error if it failed."""
+        with self._lock:
+            t = self._thread
+        if t is not None:
+            t.join()
+            with self._lock:
+                if self._thread is t:
+                    self._thread = None
+        with self._lock:
+            err, self._pending_error = self._pending_error, None
+        if err is not None:
+            raise err
+
+    # -- load ------------------------------------------------------------
+    def _load_one(self, step, verify=True):
+        mpath = self.manifest_path(step)
+        try:
+            with open(mpath, "r") as f:
+                manifest = json.load(f)
+        except (OSError, ValueError) as e:
+            raise CheckpointCorruptError(
+                "checkpoint step %d: unreadable manifest %s (%s)"
+                % (step, mpath, e))
+        if manifest.get("format_version") != MANIFEST_FORMAT:
+            raise CheckpointCorruptError(
+                "checkpoint step %d: unsupported manifest format %r"
+                % (step, manifest.get("format_version")))
+        dpath = self.data_path(step)
+        try:
+            with np.load(dpath, allow_pickle=False) as f:
+                raw = {k: f[k] for k in f.keys()}
+        except Exception as e:
+            raise CheckpointCorruptError(
+                "checkpoint step %d: unreadable data file %s (%s)"
+                % (step, dpath, e))
+        arrays, blobs = {}, {}
+        for k, v in raw.items():
+            if k.startswith(_ARRAY_KEY):
+                arrays[k[len(_ARRAY_KEY):]] = v
+            elif k.startswith(_BLOB_KEY):
+                blobs[k[len(_BLOB_KEY):]] = v.tobytes()
+        if verify:
+            want_a = manifest.get("arrays", {})
+            if set(want_a) != set(arrays):
+                raise CheckpointCorruptError(
+                    "checkpoint step %d: array set mismatch (manifest %d, "
+                    "file %d)" % (step, len(want_a), len(arrays)))
+            for k, info in want_a.items():
+                got = _digest(arrays[k])
+                if got != info["sha256"]:
+                    raise CheckpointCorruptError(
+                        "checkpoint step %d: array %r digest mismatch "
+                        "(manifest %s..., file %s...)"
+                        % (step, k, info["sha256"][:12], got[:12]))
+            want_b = manifest.get("blobs", {})
+            if set(want_b) != set(blobs):
+                raise CheckpointCorruptError(
+                    "checkpoint step %d: blob set mismatch" % step)
+            for k, info in want_b.items():
+                got = hashlib.sha256(blobs[k]).hexdigest()
+                if got != info["sha256"]:
+                    raise CheckpointCorruptError(
+                        "checkpoint step %d: blob %r digest mismatch"
+                        % (step, k))
+        return Checkpoint(step, arrays, blobs, manifest.get("meta", {}),
+                          dpath)
+
+    def load(self, step=None, verify=True, fallback=True):
+        """Load (and digest-verify) a checkpoint.
+
+        ``step=None`` loads the newest intact checkpoint: corrupt ones
+        are skipped with a LOUD warning (``fallback=False`` raises on
+        the first corrupt candidate instead).  Returns a
+        :class:`Checkpoint`, or None when nothing intact exists.
+        """
+        self.wait()
+        if step is not None:
+            return self._load_one(int(step), verify=verify)
+        candidates = self.steps()
+        for s in reversed(candidates):
+            try:
+                return self._load_one(s, verify=verify)
+            except CheckpointCorruptError as e:
+                if not fallback:
+                    raise
+                warnings.warn(
+                    "CORRUPT CHECKPOINT at step %d: %s — falling back to "
+                    "the next newest intact checkpoint" % (s, e),
+                    stacklevel=2)
+                self.logger.error("corrupt checkpoint skipped: %s", e)
+        return None
+
+    # -- preemption ------------------------------------------------------
+    def install_preemption_handler(self, state_fn,
+                                   signals=(signal.SIGTERM, signal.SIGINT),
+                                   exit_code=None):
+        """Flush a final checkpoint on SIGTERM/SIGINT (preemption).
+
+        ``state_fn() -> (step, arrays, blobs, meta)`` must return a
+        consistent snapshot (front-ends publish one atomically after
+        each step).  The handler drains any in-flight async save, writes
+        the final checkpoint synchronously, sets ``self.preempted`` so
+        cooperative training loops can exit, then chains to the previous
+        handler; ``exit_code`` forces an immediate ``os._exit`` instead
+        (for plain scripts with no loop check).  Main thread only.
+        """
+        def _handler(signum, frame):
+            self.logger.warning(
+                "signal %d: flushing final checkpoint before preemption",
+                signum)
+            try:
+                try:
+                    self.wait()
+                except Exception as e:
+                    self.logger.error("in-flight save failed during "
+                                      "preemption flush: %s", e)
+                state = state_fn()
+                if state is not None:
+                    step, arrays, blobs, meta = state
+                    meta = dict(meta or {})
+                    meta.setdefault("preempted", True)
+                    self.save(step, arrays, blobs=blobs, meta=meta,
+                              block=True)
+            except Exception:
+                # a failed flush must not throw into whatever bytecode
+                # the signal interrupted — log it; the loop still exits
+                # via self.preempted and older checkpoints remain intact
+                self.logger.exception("preemption flush failed")
+            finally:
+                self.preempted = True
+                if exit_code is not None:
+                    os._exit(exit_code)
+            prev = self._prev_handlers.get(signum)
+            if callable(prev):
+                prev(signum, frame)
+
+        for sig in signals:
+            self._prev_handlers[sig] = signal.getsignal(sig)
+            signal.signal(sig, _handler)
+        return _handler
+
+    def uninstall_preemption_handler(self):
+        """Restore the signal handlers replaced by
+        :meth:`install_preemption_handler`."""
+        for sig, prev in self._prev_handlers.items():
+            signal.signal(sig, prev)
+        self._prev_handlers.clear()
+
+
+# ---------------------------------------------------------------------------
+# Module-front-end payload helpers (numpy-only: no module import cycle)
+# ---------------------------------------------------------------------------
+
+_ARG_PREFIX = "arg:"
+_AUX_PREFIX = "aux:"
+_OPT_BLOB = "optimizer_states"
+
+
+def module_payload(epoch, arg_params, aux_params, opt_states=None,
+                   meta=None):
+    """Build a (step, arrays, blobs, meta) tuple from Module-style param
+    dicts (values: NDArray or numpy) for :meth:`CheckpointManager.save`."""
+    arrays = {_ARG_PREFIX + k: v for k, v in (arg_params or {}).items()}
+    arrays.update({_AUX_PREFIX + k: v
+                   for k, v in (aux_params or {}).items()})
+    blobs = {}
+    if opt_states is not None:
+        blobs[_OPT_BLOB] = opt_states
+    meta = dict(meta or {})
+    meta.setdefault("kind", "module")
+    meta["epoch"] = int(epoch)
+    return int(epoch), arrays, blobs, meta
+
+
+def split_module_payload(ckpt):
+    """Inverse of :func:`module_payload` over a loaded
+    :class:`Checkpoint`: returns (epoch, arg numpy dict, aux numpy dict,
+    optimizer-state bytes or None)."""
+    arg, aux = {}, {}
+    for k, v in ckpt.arrays.items():
+        if k.startswith(_ARG_PREFIX):
+            arg[k[len(_ARG_PREFIX):]] = v
+        elif k.startswith(_AUX_PREFIX):
+            aux[k[len(_AUX_PREFIX):]] = v
+    epoch = int(ckpt.meta.get("epoch", ckpt.step))
+    return epoch, arg, aux, ckpt.blobs.get(_OPT_BLOB)

@@ -88,6 +88,23 @@ FLAGS = {
                      "xla"),
         str, "honored",
         "directory backing the persistent compilation cache"),
+    "MXNET_NONFINITE_POLICY": (
+        "warn", str, "honored",
+        "default step-guard policy for NaN/Inf losses & gradient norms: "
+        "off|warn|skip|raise — 'skip' discards the update and keeps the "
+        "previous params/optimizer state (checkpoint.nonfinite_policy)"),
+    "MXNET_CHECKPOINT_KEEP": (
+        "3", _pint, "honored",
+        "CheckpointManager keep-last-N retention default"),
+    "MXNET_CHECKPOINT_ASYNC": (
+        "1", _pbool, "honored",
+        "CheckpointManager default save mode: snapshot to host, then "
+        "serialize/fsync in a background thread (wait() is the barrier)"),
+    "MXNET_GLUON_REPO": (
+        "", str, "honored",
+        "base URL for gluon model_zoo weight downloads (file:// works "
+        "for air-gapped mirrors); '' disables downloads "
+        "(model_store.get_model_file)"),
     "DMLC_ROLE": ("worker", str, "honored", "dist kvstore role"),
     "DMLC_PS_ROOT_URI": ("", str, "honored", "dist kvstore server host"),
     "DMLC_PS_ROOT_PORT": ("9091", _pint, "honored",

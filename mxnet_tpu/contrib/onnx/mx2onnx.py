@@ -876,8 +876,9 @@ def export_model(sym, params, input_shape, input_type=np.float32,
         "opset_import": [{"domain": "", "version": _OPSET}],
         "graph": graph,
     }
-    with open(onnx_file_path, "wb") as f:
-        f.write(P.encode(model, "ModelProto"))
+    from ...checkpoint import atomic_write
+
+    atomic_write(onnx_file_path, P.encode(model, "ModelProto"))
     if verbose:
         print("exported %d nodes -> %s" % (len(ex.nodes), onnx_file_path))
     return onnx_file_path

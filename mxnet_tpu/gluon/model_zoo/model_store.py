@@ -1,7 +1,12 @@
 """Model weight store (reference parity: gluon/model_zoo/model_store.py —
-sha1-verified pretrained weight cache).  No network in this environment:
-weights must be placed locally under `root`; get_model_file resolves and
-sha1-checks them."""
+sha1-verified pretrained weight cache).
+
+Resolution order: a locally-placed ``{root}/{name}.params`` wins; else,
+when ``MXNET_GLUON_REPO`` names a base URL, the file is fetched through
+``gluon.utils.download`` — bounded retries with backoff/jitter
+(``checkpoint.retry``) and an atomic final write, so a flaky or
+preempted fetch never leaves a torn .params in the cache.  ``file://``
+URLs serve as air-gapped mirrors (no network in this environment)."""
 from __future__ import annotations
 
 import os
@@ -20,15 +25,29 @@ def short_hash(name):
     return _model_sha1[name][:8]
 
 
+def _repo_url():
+    from ... import config as _config
+
+    return _config.get("MXNET_GLUON_REPO")
+
+
 def get_model_file(name, root=os.path.join("~", ".mxnet", "models")):
     root = os.path.expanduser(root or os.path.join("~", ".mxnet", "models"))
-    for cand in (os.path.join(root, "%s.params" % name),):
-        if os.path.exists(cand):
-            return cand
+    fname = os.path.join(root, "%s.params" % name)
+    sha1 = _model_sha1.get(name)
+    from ..utils import check_sha1, download
+
+    if os.path.exists(fname) and (sha1 is None or check_sha1(fname, sha1)):
+        return fname
+    repo = _repo_url()
+    if repo:
+        url = "%s/%s.params" % (repo.rstrip("/"), name)
+        return download(url, path=fname, overwrite=True, sha1_hash=sha1)
     raise MXNetError(
-        "Pretrained weights for %s not found under %s; network downloads are "
-        "unavailable in this environment — place the .params file there "
-        "manually." % (name, root))
+        "Pretrained weights for %s not found under %s and no download "
+        "mirror is configured — place the .params file there manually or "
+        "set MXNET_GLUON_REPO (file:// mirrors work offline)."
+        % (name, root))
 
 
 def purge(root=os.path.join("~", ".mxnet", "models")):

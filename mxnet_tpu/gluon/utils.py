@@ -42,7 +42,16 @@ def split_and_load(data, ctx_list, batch_axis=0, even_split=True):
     return [i.as_in_context(ctx) for i, ctx in zip(slices, ctx_list)]
 
 
-def clip_global_norm(arrays, max_norm, check_isfinite=True):
+def clip_global_norm(arrays, max_norm, check_isfinite=True,
+                     on_nonfinite=None):
+    """Rescale ``arrays`` so their global L2 norm is at most ``max_norm``.
+
+    A NaN/Inf norm is routed through the non-finite policy
+    (``on_nonfinite``; None = MXNET_NONFINITE_POLICY): ``"warn"`` keeps
+    the reference behaviour (warn, then clip anyway — results
+    undefined), ``"skip"`` leaves the arrays untouched so garbage is
+    not propagated into the update, ``"raise"`` aborts.
+    """
     def _norm(arr):
         return (arr * arr).sum()
 
@@ -53,8 +62,16 @@ def clip_global_norm(arrays, max_norm, check_isfinite=True):
     if check_isfinite and not math.isfinite(total_norm):
         import warnings
 
+        from ..checkpoint import nonfinite_policy, NonfiniteError
+
+        policy = nonfinite_policy(on_nonfinite)
+        if policy == "raise":
+            raise NonfiniteError(
+                "global gradient norm is %r (policy=raise)" % total_norm)
         warnings.warn("nan or inf is detected. Clipping results will be "
                       "undefined.", stacklevel=2)
+        if policy == "skip":
+            return total_norm
     scale = max_norm / (total_norm + 1e-8)
     if scale < 1.0:
         for arr in arrays:
@@ -75,8 +92,63 @@ def check_sha1(filename, sha1_hash):
 
 def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
              verify_ssl=True):
-    raise MXNetError("network access is unavailable in this environment; "
-                     "place files locally instead")
+    """Fetch ``url`` to ``path`` with bounded retries and an atomic
+    final write.
+
+    Built on ``checkpoint.retry`` (exponential backoff + jitter) and
+    ``checkpoint.atomic_writer`` — a crashed or failed attempt never
+    leaves a truncated file at the destination, and the sha1 check runs
+    *before* the file appears there, so a corrupt mirror response is
+    retried instead of cached.  ``file://`` URLs work for air-gapped
+    mirrors (this environment has no network).
+    """
+    import os
+
+    from ..checkpoint import atomic_writer, retry
+
+    if path is None:
+        fname = url.split("/")[-1]
+        if not fname:
+            raise MXNetError("cannot derive a file name from url %r" % url)
+    elif os.path.isdir(path):
+        fname = os.path.join(path, url.split("/")[-1])
+    else:
+        fname = path
+    if os.path.exists(fname) and not overwrite and \
+            (sha1_hash is None or check_sha1(fname, sha1_hash)):
+        return fname
+    dirname = os.path.dirname(os.path.abspath(fname))
+    os.makedirs(dirname, exist_ok=True)
+
+    def _fetch():
+        from urllib.request import urlopen
+
+        kwargs = {}
+        if not verify_ssl and url.lower().startswith("https"):
+            import ssl
+
+            kwargs["context"] = ssl._create_unverified_context()
+        sha1 = hashlib.sha1()
+        with urlopen(url, **kwargs) as resp:
+            with atomic_writer(fname) as f:
+                while True:
+                    chunk = resp.read(1048576)
+                    if not chunk:
+                        break
+                    sha1.update(chunk)
+                    f.write(chunk)
+                if sha1_hash is not None and \
+                        sha1.hexdigest() != sha1_hash:
+                    # raising inside the atomic writer discards the temp
+                    # file — the bad payload never reaches fname, and
+                    # the retry wrapper refetches
+                    raise OSError(
+                        "sha1 mismatch for %s: got %s, want %s"
+                        % (url, sha1.hexdigest(), sha1_hash))
+        return fname
+
+    return retry(_fetch, retries=retries, backoff=0.5, jitter=0.5,
+                 exceptions=(OSError,))()
 
 
 def shape_is_known(shape):

@@ -231,8 +231,9 @@ class KVServer:
                 with self._cv:
                     stats = dict(self._prof_stats)
                     path = self._prof_file
-                with open(path, "w") as f:
-                    json.dump(stats, f)
+                from .checkpoint import atomic_write
+
+                atomic_write(path, json.dumps(stats))
                 return {"ok": True, "path": path}
             return {"ok": True}   # unknown heads accepted, like the ref
         except Exception as e:

@@ -17,7 +17,12 @@ from .module.base_module import BatchEndParam  # noqa: E402
 
 def save_checkpoint(prefix, epoch, symbol, arg_params, aux_params,
                     remove_amp_cast=True):
-    """Checkpoint = symbol json + params blob (parity: model.py:394)."""
+    """Checkpoint = symbol json + params blob (parity: model.py:394).
+
+    Both files are written atomically (temp + fsync + rename via
+    ``mxnet_tpu.checkpoint``): a crash mid-save leaves the previous
+    checkpoint intact instead of a torn file that ``load_checkpoint``
+    would happily deserialize."""
     if symbol is not None:
         symbol.save("%s-symbol.json" % prefix)
     save_dict = {("arg:%s" % k): v for k, v in arg_params.items()}
@@ -78,7 +83,9 @@ class FeedForward:
     def fit(self, X, y=None, eval_data=None, eval_metric="acc",
             epoch_end_callback=None, batch_end_callback=None, kvstore="local",
             logger=None, work_load_list=None, monitor=None,
-            eval_end_callback=None, eval_batch_end_callback=None):
+            eval_end_callback=None, eval_batch_end_callback=None,
+            on_nonfinite=None, checkpoint_manager=None,
+            checkpoint_period=1):
         self._module = self._make_module(X)
         self._module.fit(X, eval_data=eval_data, eval_metric=eval_metric,
                          epoch_end_callback=epoch_end_callback,
@@ -90,7 +97,10 @@ class FeedForward:
                          arg_params=self.arg_params,
                          aux_params=self.aux_params,
                          allow_missing=True, num_epoch=self.num_epoch,
-                         begin_epoch=self.begin_epoch, monitor=monitor)
+                         begin_epoch=self.begin_epoch, monitor=monitor,
+                         on_nonfinite=on_nonfinite,
+                         checkpoint_manager=checkpoint_manager,
+                         checkpoint_period=checkpoint_period)
         self.arg_params, self.aux_params = self._module.get_params()
 
     def predict(self, X, num_batch=None, return_data=False, reset=True):

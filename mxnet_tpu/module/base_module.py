@@ -168,13 +168,23 @@ class BaseModule:
     # Training
     # ------------------------------------------------------------------
     def _fit_epoch(self, train_data, epoch, eval_metric, batch_end_callback,
-                   monitor, sparse_row_id_fn):
+                   monitor, sparse_row_id_fn, on_nonfinite="off",
+                   checkpoint_manager=None):
         """One pass over ``train_data``; returns final metric pairs.
 
         The next batch is pulled only AFTER forward_backward/update on the
         current one — iterators are allowed to recycle their batch buffer
         once next() is called (the reference C++-iterator contract).
+
+        ``on_nonfinite`` guards each step: under ``"skip"`` a batch whose
+        outputs contain NaN/Inf is discarded BEFORE update() so params
+        and optimizer state keep their previous values; ``"warn"``
+        reports and proceeds, ``"raise"`` aborts.  When
+        ``checkpoint_manager.preempted`` flips (SIGTERM flush), the
+        epoch exits at the next batch boundary.
         """
+        from .. import checkpoint as _ckpt
+
         final_pairs = []
         it = iter(train_data)
         try:
@@ -183,10 +193,23 @@ class BaseModule:
             return final_pairs
         nbatch = 0
         while batch is not None:
+            if checkpoint_manager is not None and \
+                    checkpoint_manager.preempted:
+                self.logger.warning("Epoch[%d] preempted at batch %d; "
+                                    "leaving epoch loop", epoch, nbatch)
+                break
             if monitor is not None:
                 monitor.tic()
             self.forward_backward(batch)
-            self.update()
+            apply_update = True
+            if on_nonfinite != "off":
+                outs = [o.asnumpy() for o in self.get_outputs()]
+                apply_update = _ckpt.check_finite(
+                    outs, on_nonfinite,
+                    what="outputs (epoch %d batch %d)" % (epoch, nbatch),
+                    logger=self.logger)
+            if apply_update:
+                self.update()
             try:
                 upcoming = next(it)
                 self.prepare(upcoming, sparse_row_id_fn=sparse_row_id_fn)
@@ -212,16 +235,52 @@ class BaseModule:
             initializer=None, arg_params=None, aux_params=None,
             allow_missing=False, force_rebind=False, force_init=False,
             begin_epoch=0, num_epoch=None, validation_metric=None,
-            monitor=None, sparse_row_id_fn=None):
+            monitor=None, sparse_row_id_fn=None, on_nonfinite=None,
+            checkpoint_manager=None, checkpoint_period=1):
         """Train over ``train_data`` for ``num_epoch`` epochs.
 
         Parity: reference ``base_module.py:409`` — same knobs, same
-        callback firing points, same logging shape.
+        callback firing points, same logging shape.  Fault-tolerance
+        extensions (mxnet_tpu.checkpoint):
+
+        * ``on_nonfinite``: NaN/Inf step-guard policy
+          (off/warn/skip/raise; None = MXNET_NONFINITE_POLICY).
+        * ``checkpoint_manager``: a CheckpointManager — fit auto-resumes
+          from the newest intact checkpoint (params, optimizer state,
+          epoch; corrupt checkpoints are skipped with a loud warning),
+          saves every ``checkpoint_period`` epochs, installs a
+          SIGTERM/SIGINT handler that flushes a final checkpoint, and
+          exits the epoch loop cleanly once preempted.
         """
+        from .. import checkpoint as _ckpt
+
         assert num_epoch is not None, "please specify number of epochs"
+        on_nonfinite = _ckpt.nonfinite_policy(on_nonfinite)
         if initializer is None:
             from .. import initializer as _init
             initializer = _init.Uniform(0.01)
+
+        resume_opt_states = None
+        if checkpoint_manager is not None:
+            ckpt = checkpoint_manager.load()
+            if ckpt is not None and ckpt.meta.get("kind") != "module":
+                raise ValueError(
+                    "checkpoint step %d in %r was not written by "
+                    "Module.fit (kind=%r) — use a separate checkpoint "
+                    "directory per training front-end"
+                    % (ckpt.step, checkpoint_manager.directory,
+                       ckpt.meta.get("kind")))
+            if ckpt is not None:
+                epoch_done, arg_np, aux_np, resume_opt_states = \
+                    _ckpt.split_module_payload(ckpt)
+                arg_params = {k: ndarray.array(v) for k, v in arg_np.items()}
+                aux_params = {k: ndarray.array(v) for k, v in aux_np.items()}
+                begin_epoch = max(begin_epoch, epoch_done + 1)
+                force_init = True
+                allow_missing = False
+                self.logger.info(
+                    "auto-resume from checkpoint step %d -> begin_epoch %d",
+                    ckpt.step, begin_epoch)
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
@@ -233,37 +292,80 @@ class BaseModule:
                          force_init=force_init)
         self.init_optimizer(kvstore=kvstore, optimizer=optimizer,
                             optimizer_params=optimizer_params)
+        if resume_opt_states is not None and \
+                hasattr(self, "set_optimizer_states_bytes"):
+            self.set_optimizer_states_bytes(resume_opt_states)
 
         eval_metric = _coerce_metric(eval_metric)
         if validation_metric is None:
             validation_metric = eval_metric
 
-        for epoch in range(begin_epoch, num_epoch):
-            start = time.time()
-            eval_metric.reset()
-            train_pairs = self._fit_epoch(
-                train_data, epoch, eval_metric, batch_end_callback, monitor,
-                sparse_row_id_fn)
-            for name, val in train_pairs:
-                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
-                             time.time() - start)
+        def _ckpt_state():
+            # preemption-flush snapshot: mid-epoch params saved under the
+            # CURRENT epoch's step index with meta epoch = last COMPLETED
+            # epoch, so resume re-enters the interrupted epoch from the
+            # flushed params
+            arg_p, aux_p = self.get_params()
+            opt = self.get_optimizer_states_bytes() \
+                if hasattr(self, "get_optimizer_states_bytes") and \
+                self.optimizer_initialized else None
+            ep = self._fit_current_epoch
+            _, arrays, blobs, meta = _ckpt.module_payload(
+                ep - 1, arg_p, aux_p, opt_states=opt,
+                meta={"partial": True})
+            return max(ep, 0), arrays, blobs, meta
 
-            arg_params, aux_params = self.get_params()
-            self.set_params(arg_params, aux_params)
-            if epoch_end_callback is not None:
-                for cb in _as_list(epoch_end_callback):
-                    cb(epoch, self.symbol, arg_params, aux_params)
+        self._fit_current_epoch = begin_epoch
+        if checkpoint_manager is not None:
+            checkpoint_manager.install_preemption_handler(_ckpt_state)
+        try:
+            for epoch in range(begin_epoch, num_epoch):
+                self._fit_current_epoch = epoch
+                if checkpoint_manager is not None and \
+                        checkpoint_manager.preempted:
+                    break
+                start = time.time()
+                eval_metric.reset()
+                train_pairs = self._fit_epoch(
+                    train_data, epoch, eval_metric, batch_end_callback,
+                    monitor, sparse_row_id_fn, on_nonfinite=on_nonfinite,
+                    checkpoint_manager=checkpoint_manager)
+                for name, val in train_pairs:
+                    self.logger.info("Epoch[%d] Train-%s=%f", epoch, name,
+                                     val)
+                self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                                 time.time() - start)
 
-            if eval_data is not None:
-                pairs = self.score(eval_data, validation_metric,
-                                   score_end_callback=eval_end_callback,
-                                   batch_end_callback=eval_batch_end_callback,
-                                   epoch=epoch)
-                for name, val in pairs:
-                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
-                                     name, val)
-            train_data.reset()
+                arg_params, aux_params = self.get_params()
+                self.set_params(arg_params, aux_params)
+                if checkpoint_manager is not None and \
+                        not checkpoint_manager.preempted and \
+                        (epoch + 1 - begin_epoch) % checkpoint_period == 0:
+                    opt = self.get_optimizer_states_bytes() \
+                        if hasattr(self, "get_optimizer_states_bytes") \
+                        else None
+                    step, arrays, blobs, meta = _ckpt.module_payload(
+                        epoch, arg_params, aux_params, opt_states=opt)
+                    checkpoint_manager.save(step, arrays, blobs=blobs,
+                                            meta=meta)
+                if epoch_end_callback is not None:
+                    for cb in _as_list(epoch_end_callback):
+                        cb(epoch, self.symbol, arg_params, aux_params)
+
+                if eval_data is not None:
+                    pairs = self.score(
+                        eval_data, validation_metric,
+                        score_end_callback=eval_end_callback,
+                        batch_end_callback=eval_batch_end_callback,
+                        epoch=epoch)
+                    for name, val in pairs:
+                        self.logger.info("Epoch[%d] Validation-%s=%f",
+                                         epoch, name, val)
+                train_data.reset()
+        finally:
+            if checkpoint_manager is not None:
+                checkpoint_manager.wait()
+                checkpoint_manager.uninstall_preemption_handler()
 
     # ------------------------------------------------------------------
     # Parameter persistence

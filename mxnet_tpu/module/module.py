@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import logging
 
+from ..base import MXNetError
 from ..context import cpu, Context
 from ..ndarray.ndarray import zeros
 from .. import optimizer as opt
@@ -404,8 +405,25 @@ class Module(BaseModule):
         if self._update_on_kvstore:
             self._kvstore.save_optimizer_states(fname)
         else:
-            with open(fname, "wb") as f:
-                f.write(self._updater.get_states())
+            from ..checkpoint import atomic_write
+
+            atomic_write(fname, self._updater.get_states())
+
+    def get_optimizer_states_bytes(self):
+        """Serialized optimizer state, or None when it lives on a dist
+        kvstore (CheckpointManager blob source)."""
+        assert self.optimizer_initialized, "optimizer not initialized"
+        if self._update_on_kvstore:
+            return None
+        return self._updater.get_states()
+
+    def set_optimizer_states_bytes(self, states):
+        """Restore optimizer state from bytes (CheckpointManager blob)."""
+        assert self.optimizer_initialized, "optimizer not initialized"
+        if self._update_on_kvstore:
+            raise MXNetError("cannot restore optimizer-state bytes when "
+                             "updates run on a dist kvstore")
+        self._updater.set_states(states)
 
     def load_optimizer_states(self, fname):
         assert self.optimizer_initialized, "optimizer not initialized"

@@ -161,5 +161,6 @@ def save_binary(fname, arrays, names=()):
         b = name.encode("utf-8")
         out.append(struct.pack("<Q", len(b)))
         out.append(b)
-    with open(fname, "wb") as f:
-        f.write(b"".join(out))
+    from ..checkpoint import atomic_write
+
+    atomic_write(fname, b"".join(out))

@@ -922,12 +922,13 @@ def save(fname, data, format="npz"):
             arrays["%sdict:%s" % (_SAVE_PREFIX, k)] = a.asnumpy()
     else:
         raise MXNetError("save expects NDArray, list or dict")
-    np.savez(fname if fname.endswith(".npz") else fname, **arrays)
-    # np.savez appends .npz; rename back for exact-path parity
-    import os
+    # atomic: np.savez into a temp file + fsync + os.replace, so a crash
+    # mid-save never leaves a torn .params at the final path (and the
+    # file-object form keeps numpy from appending .npz to the name)
+    from ..checkpoint import atomic_writer
 
-    if not fname.endswith(".npz") and os.path.exists(fname + ".npz"):
-        os.replace(fname + ".npz", fname)
+    with atomic_writer(fname) as f:
+        np.savez(f, **arrays)
 
 
 def load(fname):

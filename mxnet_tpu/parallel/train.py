@@ -15,6 +15,7 @@ back to the Parameter objects on demand.
 from __future__ import annotations
 
 import functools
+import signal as _signal
 
 import numpy as np
 
@@ -96,17 +97,29 @@ class ShardedTrainer:
     def __init__(self, net, loss_fn, mesh=None, optimizer="sgd",
                  optimizer_params=None, batch_axis_spec="dp",
                  param_spec_fn=None, dtype=None, donate=True,
-                 remat_policy=None):
+                 remat_policy=None, on_nonfinite=None):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from ..remat import resolve_policy
+        from ..checkpoint import nonfinite_policy
 
         self.net = net
         self.loss_fn = loss_fn
         # fail fast on a typo'd policy; None defers to MXNET_REMAT_POLICY
         resolve_policy(remat_policy)
         self._remat_policy = remat_policy
+        # NaN/Inf step guard (None defers to MXNET_NONFINITE_POLICY):
+        # "skip" compiles a select into the step so a non-finite loss
+        # discards the whole update (params, optimizer state, moving
+        # stats) and keeps the previous state
+        self._on_nonfinite = nonfinite_policy(on_nonfinite)
+        self.global_step = 0
+        self.skipped_steps = 0
+        self._committed = None   # (params, opt_state, step, rng) snapshot
+        self._ckpt_manager = None
+        self._ckpt_period = 0
+        self._pending_restore = None
         self.mesh = mesh
         self._params = [p for p in net.collect_params().values()]
         self._trainable = [p.grad_req != "null" for p in self._params]
@@ -179,6 +192,10 @@ class ShardedTrainer:
             dev = jax.devices()[0]
             self.opt_state = jax.tree_util.tree_map(
                 lambda a: jax.device_put(a, dev), self.opt_state)
+        if self._pending_restore is not None:
+            # checkpoint attached before shapes were known: apply now
+            ckpt, self._pending_restore = self._pending_restore, None
+            self._apply_restore(ckpt)
 
     # -- sharding placement ----------------------------------------------
     def _param_sharding(self, P, NamedSharding, p, arr):
@@ -298,6 +315,7 @@ class ShardedTrainer:
         lr, wd, momentum = self._lr, self._wd, self._momentum
         beta1, beta2, eps = self._beta1, self._beta2, self._eps
         pidx = self._param_index
+        guard_skip = self._on_nonfinite == "skip"
 
         def step(param_arrays, opt_state, inputs, label, rng):
             def lf(train_params):
@@ -337,6 +355,21 @@ class ShardedTrainer:
             for p, v in zip(aux_meta["params"], aux):
                 i = pidx[id(p)]
                 new_params[i] = v.astype(new_params[i].dtype)
+            if guard_skip:
+                import jax.numpy as jnp
+
+                # non-finite guard fused into the step: a NaN/Inf loss
+                # selects the PREVIOUS params/opt-state/moving-stats, so
+                # one poisoned batch cannot corrupt training state (the
+                # building block for loss-scale backoff) — no extra host
+                # sync, just a per-buffer select XLA folds into the
+                # update
+                keep = jnp.isfinite(loss)
+                new_params = [jnp.where(keep, n, o)
+                              for n, o in zip(new_params, param_arrays)]
+                new_state = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(keep, n, o), new_state,
+                    opt_state)
             return new_params, new_state, loss
 
         donate = (0, 1) if self._donate else ()
@@ -356,11 +389,189 @@ class ShardedTrainer:
         rng = _random.next_key()
         from .. import profiler as _profiler
 
-        self.param_arrays, self.opt_state, loss = _profiler.timed_call(
-            "ShardedTrainer.step", self._step_fn,
-            (self.param_arrays, self.opt_state, tuple(raw_in), raw_label,
-             rng))
+        # With a checkpoint manager attached, SIGTERM/SIGINT are masked
+        # across dispatch+commit: donation invalidates the previous
+        # committed snapshot's buffers the moment the jitted step is
+        # called, so a preemption flush landing inside this window would
+        # read deleted arrays.  The pending signal is delivered at
+        # unmask, when the new snapshot is consistent.
+        mask = self._ckpt_manager is not None and \
+            hasattr(_signal, "pthread_sigmask")
+        if mask:
+            _signal.pthread_sigmask(
+                _signal.SIG_BLOCK, {_signal.SIGTERM, _signal.SIGINT})
+        try:
+            new_params, new_state, loss = _profiler.timed_call(
+                "ShardedTrainer.step", self._step_fn,
+                (self.param_arrays, self.opt_state, tuple(raw_in),
+                 raw_label, rng))
+            next_step = self.global_step + 1
+            # single-assignment snapshot: the preemption handler may fire
+            # between any two bytecodes, and must never observe params
+            # from step N next to optimizer state from step N-1.  The
+            # PRNG stream state rides in the snapshot too — reading it
+            # live at flush time would leak a key consumed by a step
+            # that never committed, breaking bit-for-bit resume.
+            self._committed = (new_params, new_state, next_step,
+                               _random.get_key_data())
+            self.param_arrays = new_params
+            self.opt_state = new_state
+            self.global_step = next_step
+        finally:
+            if mask:
+                _signal.pthread_sigmask(
+                    _signal.SIG_UNBLOCK,
+                    {_signal.SIGTERM, _signal.SIGINT})
+        if self._on_nonfinite != "off":
+            from .. import checkpoint as _ckpt
+
+            # host check (syncs on the loss, which callers consume per
+            # step anyway); under "skip" the compiled select already
+            # discarded the update — this only reports and counts
+            if not _ckpt.check_finite(
+                    np.asarray(loss), self._on_nonfinite,
+                    what="loss (step %d)" % next_step):
+                self.skipped_steps += 1
+        m = self._ckpt_manager
+        if m is not None and self._ckpt_period and not m.preempted and \
+                next_step % self._ckpt_period == 0:
+            self.save_checkpoint(m, step=next_step)
         return loss
+
+    # -- fault tolerance -------------------------------------------------
+    def attach_checkpoint_manager(self, manager, period=0,
+                                  auto_resume=True,
+                                  install_signal_handler=True):
+        """Wire a :class:`mxnet_tpu.checkpoint.CheckpointManager` into
+        the step loop.
+
+        * ``auto_resume``: load the newest *intact* checkpoint (params,
+          optimizer state, PRNG stream, global_step) if one exists —
+          corrupt ones are skipped with a loud warning.  With the PRNG
+          stream restored, the resumed loss trajectory is bit-for-bit
+          identical to an uninterrupted run.
+        * ``period``: save every N steps (async per the manager's
+          config); 0 = only explicit/preemption saves.
+        * ``install_signal_handler``: SIGTERM/SIGINT flush a final
+          checkpoint from the last committed step snapshot and set
+          ``manager.preempted`` so the training loop can exit.
+
+        Returns the resumed ``global_step`` (0 for a fresh start).
+        """
+        self._ckpt_manager = manager
+        self._ckpt_period = int(period)
+        if auto_resume:
+            ckpt = manager.load()
+            if ckpt is not None:
+                self.restore_checkpoint(ckpt)
+        if install_signal_handler:
+            manager.install_preemption_handler(self._checkpoint_payload)
+        return self.global_step
+
+    def _checkpoint_payload(self, step=None):
+        """(step, arrays, blobs, meta) from the last committed snapshot."""
+        if self._committed is not None:
+            params, opt_state, gstep, key_data = self._committed
+        elif self.param_arrays is not None:
+            params, opt_state, gstep, key_data = (
+                self.param_arrays, self.opt_state, self.global_step,
+                _random.get_key_data())
+        else:
+            return None  # nothing initialized yet — nothing to flush
+        import jax
+
+        arrays = {}
+        # index-keyed: gluon auto-names (dense0_...) depend on process-
+        # global counters and would spuriously mismatch across restarts;
+        # the manifest meta keeps the names for human debugging
+        for i, a in enumerate(params):
+            arrays["param:%04d" % i] = a
+        for i, leaf in enumerate(jax.tree_util.tree_leaves(opt_state)):
+            arrays["opt:%04d" % i] = leaf
+        arrays["rng"] = key_data
+        meta = {"kind": "sharded_trainer", "step": int(gstep),
+                "optimizer": self._opt_name,
+                "param_names": [p.name for p in self._params]}
+        return (int(gstep) if step is None else int(step)), arrays, {}, meta
+
+    def save_checkpoint(self, manager, step=None, block=None):
+        """Snapshot params + optimizer state + PRNG stream to
+        ``manager`` (async by default; ``manager.wait()`` is the
+        barrier)."""
+        payload = self._checkpoint_payload(step)
+        if payload is None:
+            raise MXNetError("ShardedTrainer has no state to checkpoint "
+                             "yet (run a step or initialize params first)")
+        s, arrays, blobs, meta = payload
+        manager.save(s, arrays, blobs=blobs, meta=meta, block=block)
+        return s
+
+    def restore_checkpoint(self, ckpt):
+        """Restore from a loaded :class:`Checkpoint` (params, optimizer
+        state, PRNG stream, global_step), re-placing arrays onto the
+        trainer's mesh/device sharding.  With deferred-shape params the
+        restore is applied when shapes materialize on the first step."""
+        if ckpt.meta.get("kind") != "sharded_trainer":
+            raise MXNetError("checkpoint step %d was not written by "
+                             "ShardedTrainer (kind=%r)"
+                             % (ckpt.step, ckpt.meta.get("kind")))
+        self.global_step = int(ckpt.meta.get("step", ckpt.step))
+        if "rng" in ckpt.arrays:
+            _random.set_key_data(ckpt.arrays["rng"])
+        self._committed = None
+        if self.param_arrays is None:
+            self._pending_restore = ckpt
+            return
+        self._apply_restore(ckpt)
+
+    def _put_like(self, jax, val, old):
+        """Place a host array like an existing trainer array (same
+        sharding/device; multi-process meshes go through the global-put
+        path)."""
+        val = np.asarray(val)
+        old_dtype = np.dtype(old.dtype)
+        if val.dtype != old_dtype:
+            val = val.astype(old_dtype)
+        sh = getattr(old, "sharding", None)
+        if sh is None:
+            return jax.device_put(val)
+        if jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(sh, val)
+        return jax.device_put(val, sh)
+
+    def _apply_restore(self, ckpt):
+        import jax
+
+        n_ckpt = sum(1 for k in ckpt.arrays if k.startswith("param:"))
+        if n_ckpt != len(self.param_arrays):
+            raise MXNetError(
+                "checkpoint step %d holds %d params, model has %d — was "
+                "it written by a different model? (checkpoint names: %s)"
+                % (ckpt.step, n_ckpt, len(self.param_arrays),
+                   ckpt.meta.get("param_names")))
+        new_arrays = []
+        for i, (p, old) in enumerate(zip(self._params, self.param_arrays)):
+            key = "param:%04d" % i
+            val = ckpt.arrays[key]
+            if tuple(val.shape) != tuple(old.shape):
+                raise MXNetError(
+                    "checkpoint step %d: %r (%s) shape %s != model shape "
+                    "%s" % (ckpt.step, key, p.name, tuple(val.shape),
+                            tuple(old.shape)))
+            new_arrays.append(self._put_like(jax, val, old))
+        flat, treedef = jax.tree_util.tree_flatten(self.opt_state)
+        new_flat = []
+        for i, old in enumerate(flat):
+            key = "opt:%04d" % i
+            if key not in ckpt.arrays:
+                raise MXNetError(
+                    "checkpoint step %d is missing optimizer leaf %r "
+                    "(optimizer %r vs checkpoint %r)"
+                    % (ckpt.step, key, self._opt_name,
+                       ckpt.meta.get("optimizer")))
+            new_flat.append(self._put_like(jax, ckpt.arrays[key], old))
+        self.param_arrays = new_arrays
+        self.opt_state = jax.tree_util.tree_unflatten(treedef, new_flat)
 
     def sync_to_net(self):
         """Write the pytree back into the gluon Parameters (gathered to a

@@ -100,8 +100,9 @@ def dump(finished=True, profile_process="worker"):
                "displayTimeUnit": "ms",
                "otherData": {"xla_costs": _xla_costs,
                              "device_memory": device_memory_stats()}}
-    with open(path, "w") as f:
-        json.dump(payload, f)
+    from .checkpoint import atomic_write
+
+    atomic_write(path, json.dumps(payload))
     return path
 
 

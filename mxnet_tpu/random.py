@@ -81,6 +81,30 @@ def pop_trace_key():
     return _get_state().trace_keys.pop()
 
 
+def get_key_data():
+    """Host snapshot of the PRNG stream state (checkpointable).
+
+    Returns the raw key array as numpy — restoring it with
+    :func:`set_key_data` resumes the split sequence exactly, which is
+    what makes a preempted-and-resumed run's loss trajectory bit-for-bit
+    identical to an uninterrupted one."""
+    import numpy as _np
+
+    return _np.asarray(_get_state().key)
+
+
+def set_key_data(data):
+    """Restore the PRNG stream from :func:`get_key_data` output."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as _np
+
+    st = _get_state()
+    data = _np.asarray(data)
+    st.key = jnp.asarray(data, dtype=st.key.dtype) \
+        if hasattr(st.key, "dtype") else jax.numpy.asarray(data)
+
+
 # ---- user-facing samplers (return NDArray), parity with mx.random.* -----
 
 def _sample(op_name, shape=None, ctx=None, out=None, dtype="float32", **attrs):

@@ -209,7 +209,16 @@ class Predictor:
             pad = np.zeros((self._batch_shape[0] - n_valid,)
                            + tuple(b.shape[1:]), b.dtype)
             b = np.concatenate([b, pad], axis=0)
-        return jax.device_put(b, self._dev), n_valid
+        from .checkpoint import retry
+
+        # the host->device upload is the serving path's only I/O edge:
+        # retry transient transfer failures (tunnel hiccups, transient
+        # OOM while an old chunk drains) with backoff instead of
+        # dropping the request.  Contract violations raise above and are
+        # never retried.
+        put = retry(jax.device_put, retries=2, backoff=0.05,
+                    exceptions=(OSError, RuntimeError))
+        return put(b, self._dev), n_valid
 
     def predict(self, batches):
         """Yield one output (numpy) per input batch, in order.

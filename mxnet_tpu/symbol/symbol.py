@@ -531,8 +531,9 @@ class Symbol:
                                      "mxtpu": ["int", 1]}}, indent=2)
 
     def save(self, fname):
-        with open(fname, "w") as f:
-            f.write(self.tojson())
+        from ..checkpoint import atomic_write
+
+        atomic_write(fname, self.tojson())
 
     def __deepcopy__(self, memo):
         return load_json(self.tojson())

@@ -1,0 +1,4 @@
+"""Test-support subpackage: fault injection for the fault-tolerance
+layer (``mxnet_tpu.testing.faults``).  Nothing here is imported by
+production code paths."""
+from . import faults  # noqa: F401

@@ -1,0 +1,144 @@
+"""Fault injection for checkpoint/fault-tolerance tests.
+
+Everything the fault-tolerance layer promises is only credible if a test
+can make the failure actually happen.  This module provides the failure
+modes the checkpoint tests drive:
+
+* :class:`FailingWriter` / :func:`failing_open` — a file object (or an
+  ``open`` patch) that raises ``OSError`` after N bytes, simulating a
+  crash/disk-full mid-write.
+* :func:`truncate_file` — chop a file's tail (torn write that *did*
+  reach the final path — e.g. a pre-atomic-writer artifact).
+* :func:`flip_bit` / :func:`corrupt_file` — silent bit-rot.
+* :func:`send_preemption` — deliver SIGTERM (or any signal) to a
+  process after an optional delay, from a daemon thread — the simulated
+  TPU-fleet eviction notice.
+* :class:`FlakyCallable` — fails the first N calls then succeeds
+  (drives the ``retry`` helper and download paths).
+"""
+from __future__ import annotations
+
+import os
+import signal as _signal
+import threading
+import time
+
+__all__ = ["FailingWriter", "failing_open", "truncate_file", "flip_bit",
+           "corrupt_file", "send_preemption", "FlakyCallable"]
+
+
+class FailingWriter:
+    """File-like wrapper that raises ``OSError`` once ``fail_after``
+    bytes have been written — a crash mid-write."""
+
+    def __init__(self, f, fail_after):
+        self._f = f
+        self._budget = int(fail_after)
+
+    def write(self, data):
+        if len(data) > self._budget:
+            part = data[:self._budget]
+            if part:
+                self._f.write(part)
+            self._f.flush()
+            raise OSError("injected write failure (budget exhausted)")
+        self._budget -= len(data)
+        return self._f.write(data)
+
+    def __getattr__(self, name):
+        return getattr(self._f, name)
+
+
+def failing_open(fail_after, only_suffix=None, _open=open):
+    """An ``open()`` replacement whose writable handles fail after
+    ``fail_after`` bytes.  ``only_suffix`` limits injection to matching
+    paths (e.g. ``".npz"``); other opens pass through untouched."""
+    def opener(path, mode="r", *args, **kwargs):
+        f = _open(path, mode, *args, **kwargs)
+        if "w" in mode or "a" in mode or "+" in mode:
+            if only_suffix is None or str(path).endswith(only_suffix):
+                return FailingWriter(f, fail_after)
+        return f
+
+    return opener
+
+
+def truncate_file(path, keep_bytes=None, drop_bytes=None):
+    """Truncate ``path``: keep the first ``keep_bytes``, or drop the
+    last ``drop_bytes`` (default: drop half)."""
+    size = os.path.getsize(path)
+    if keep_bytes is None:
+        keep_bytes = size - (drop_bytes if drop_bytes is not None
+                             else size // 2)
+    keep_bytes = max(0, int(keep_bytes))
+    with open(path, "rb+") as f:
+        f.truncate(keep_bytes)
+    return keep_bytes
+
+
+def flip_bit(path, offset=None, bit=0):
+    """Flip one bit in ``path`` (default: middle of the file) — silent
+    bit-rot that only a digest can catch."""
+    size = os.path.getsize(path)
+    if size == 0:
+        raise ValueError("cannot corrupt empty file %r" % (path,))
+    if offset is None:
+        offset = size // 2
+    offset = int(offset) % size
+    with open(path, "rb+") as f:
+        f.seek(offset)
+        b = f.read(1)
+        f.seek(offset)
+        f.write(bytes([b[0] ^ (1 << (bit % 8))]))
+    return offset
+
+
+def corrupt_file(path, payload=b"\x00garbage\x00"):
+    """Overwrite the head of ``path`` with garbage (structural damage —
+    the file no longer parses at all)."""
+    with open(path, "rb+") as f:
+        f.write(payload)
+
+
+def send_preemption(pid=None, sig=_signal.SIGTERM, delay=0.0):
+    """Deliver ``sig`` (default SIGTERM — the preemption notice) to
+    ``pid`` (default: this process) after ``delay`` seconds.
+
+    With a delay the signal is sent from a daemon thread and the thread
+    object is returned (join it for determinism); ``delay=0`` sends
+    inline.
+    """
+    pid = os.getpid() if pid is None else int(pid)
+    if delay <= 0:
+        os.kill(pid, sig)
+        return None
+
+    def _fire():
+        time.sleep(delay)
+        os.kill(pid, sig)
+
+    t = threading.Thread(target=_fire, name="preemption-sender",
+                         daemon=True)
+    t.start()
+    return t
+
+
+class FlakyCallable:
+    """Callable that raises ``exc`` for the first ``failures`` calls,
+    then delegates to ``fn`` (default: return ``value``)."""
+
+    def __init__(self, failures, fn=None, value=None,
+                 exc=OSError("injected transient failure")):
+        self.failures = int(failures)
+        self.calls = 0
+        self._fn = fn
+        self._value = value
+        self._exc = exc
+
+    def __call__(self, *args, **kwargs):
+        self.calls += 1
+        if self.calls <= self.failures:
+            raise self._exc
+        if self._fn is not None:
+            return self._fn(*args, **kwargs)
+        return self._value

@@ -368,6 +368,48 @@ def test_gemm_weight_shared_with_matmul_not_corrupted(tmp_path):
     np.testing.assert_allclose(outs[1], x @ w, rtol=1e-5, atol=1e-5)
 
 
+def test_gemm_weight_shared_with_add_not_corrupted(tmp_path):
+    """The elementwise-consumer variant of the Gemm-share hazard: the
+    same (K, N) initializer feeds a transB=0 Gemm and a broadcast Add.
+    An in-place transpose for the Gemm would silently flip the Add's
+    operand layout; the fresh-name copy must leave it untouched
+    (r5 residual audit)."""
+    from mxnet_tpu.contrib.onnx import _proto as P
+    from mxnet_tpu.contrib.onnx.mx2onnx import _tensor, _vinfo
+    from mxnet_tpu.contrib.onnx.onnx2mx import import_model
+
+    w = _RNG.rand(4, 3).astype(np.float32)   # (K, N) layout, transB=0
+    x = _RNG.rand(2, 4).astype(np.float32)   # Gemm: x @ w
+    z = _RNG.rand(4, 3).astype(np.float32)   # Add: z + w (same layout)
+    nodes = [
+        {"op_type": "Gemm", "input": ["x", "w"], "output": ["y0"],
+         "name": "g0", "attribute": []},                       # x @ w
+        {"op_type": "Add", "input": ["z", "w"], "output": ["y1"],
+         "name": "a0", "attribute": []},                       # z + w
+    ]
+    graph = {"name": "gemm_add_share", "node": nodes,
+             "initializer": [_tensor("w", w)],
+             "input": [_vinfo("x", x.shape), _vinfo("z", z.shape)],
+             "output": [_vinfo("y0", (2, 3)), _vinfo("y1", (4, 3))]}
+    model = {"ir_version": 7, "producer_name": "test",
+             "opset_import": [{"domain": "", "version": 13}],
+             "graph": graph}
+    path = str(tmp_path / "gemm_add_share.onnx")
+    with open(path, "wb") as f:
+        f.write(P.encode(model, "ModelProto"))
+
+    sym, arg_params, aux_params = import_model(path)
+    # the shared initializer keeps the original (K, N) layout
+    np.testing.assert_array_equal(arg_params["w"].asnumpy(), w)
+    args = dict(arg_params)
+    args["x"] = mx.nd.array(x)
+    args["z"] = mx.nd.array(z)
+    exe = sym.bind(ctx=mx.cpu(), args=args, grad_req="null")
+    outs = [o.asnumpy() for o in exe.forward(is_train=False)]
+    np.testing.assert_allclose(outs[0], x @ w, rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(outs[1], z + w, rtol=1e-5, atol=1e-5)
+
+
 def test_gemm_shared_weight_mixed_transb(tmp_path):
     """Legal ONNX: one initializer shared by Gemm nodes with differing
     transB — the importer materializes a transposed copy under a fresh

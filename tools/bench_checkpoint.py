@@ -1,0 +1,113 @@
+"""Blocking vs async checkpoint overhead per train step.
+
+Trains a small MLP with ShardedTrainer for N steps three ways — no
+checkpointing, blocking saves every step, async saves every step — and
+reports per-step wall time plus the derived per-save overhead.  The
+async path should hide (de)serialization and fsync behind the next
+step's compute; what remains visible is the synchronous host snapshot.
+
+CPU numbers are committed in docs/fault_tolerance.md; rerun on TPU with:
+
+    python tools/bench_checkpoint.py --params-mb 64 --steps 50
+"""
+import argparse
+import json
+import os
+import shutil
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import nd  # noqa: E402
+from mxnet_tpu import checkpoint as ck  # noqa: E402
+from mxnet_tpu import parallel  # noqa: E402
+import mxnet_tpu.gluon as gluon  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+
+
+def make_trainer(hidden, n_layers, seed=7):
+    mx.random.seed(seed)
+    net = nn.HybridSequential()
+    for _ in range(n_layers):
+        net.add(nn.Dense(hidden, activation="relu"))
+    net.add(nn.Dense(1))
+    net.initialize()
+    loss_fn = gluon.loss.L2Loss()
+    return parallel.ShardedTrainer(
+        net, lambda o, l: loss_fn(o, l), optimizer="adam",
+        optimizer_params={"learning_rate": 1e-3})
+
+
+def run(trainer, steps, batch, label, manager=None, period=1):
+    if manager is not None:
+        trainer.attach_checkpoint_manager(manager, period=period,
+                                          auto_resume=False,
+                                          install_signal_handler=False)
+    # warm-up compiles the step and materializes params
+    float(np.asarray(trainer.step([batch], label)))
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        trainer.step([batch], label)
+    if manager is not None:
+        manager.wait()
+    import jax
+
+    jax.block_until_ready(trainer.param_arrays)
+    dt = time.perf_counter() - t0
+    trainer._ckpt_manager = None
+    return dt / steps * 1e3  # ms/step
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--params-mb", type=float, default=8.0,
+                    help="approximate total parameter size")
+    ap.add_argument("--steps", type=int, default=30)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--period", type=int, default=1,
+                    help="save every N steps")
+    ap.add_argument("--out", default=None, help="write JSON here")
+    args = ap.parse_args()
+
+    # hidden x hidden fp32 layers: pick hidden so 4 layers ≈ params_mb
+    n_layers = 4
+    hidden = max(32, int((args.params_mb * 1e6 / 4 / n_layers) ** 0.5))
+    rng = np.random.RandomState(0)
+    X = nd.array(rng.rand(args.batch, hidden).astype(np.float32))
+    Y = nd.array(rng.rand(args.batch, 1).astype(np.float32))
+
+    results = {"params_mb": args.params_mb, "hidden": hidden,
+               "n_layers": n_layers, "steps": args.steps,
+               "period": args.period,
+               "platform": os.environ.get("JAX_PLATFORMS", "default")}
+
+    tr = make_trainer(hidden, n_layers)
+    results["baseline_ms"] = run(tr, args.steps, X, Y)
+
+    for mode, async_save in (("blocking", False), ("async", True)):
+        d = tempfile.mkdtemp(prefix="bench_ckpt_")
+        try:
+            m = ck.CheckpointManager(d, keep_last=2, async_save=async_save)
+            tr = make_trainer(hidden, n_layers)
+            results["%s_ms" % mode] = run(tr, args.steps, X, Y, manager=m,
+                                          period=args.period)
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+
+    for mode in ("blocking", "async"):
+        results["%s_overhead_ms_per_save" % mode] = (
+            (results["%s_ms" % mode] - results["baseline_ms"])
+            * args.period)
+
+    print(json.dumps(results, indent=2))
+    if args.out:
+        ck.atomic_write(args.out, json.dumps(results, indent=2))
+
+
+if __name__ == "__main__":
+    main()
