@@ -79,6 +79,7 @@ from . import operator  # noqa: F401
 from . import util  # noqa: F401
 
 from . import remat  # noqa: F401
+from . import telemetry  # noqa: F401  (MXNET_TELEMETRY enables at import)
 from . import checkpoint  # noqa: F401
 from .checkpoint import CheckpointManager  # noqa: F401
 

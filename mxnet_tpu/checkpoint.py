@@ -30,9 +30,9 @@ persistence crash-safe and training loss-spike-safe:
 * :func:`retry` — bounded-retry-with-backoff helper shared by the
   model-zoo download path and the serving host->device upload path.
 
-Only stdlib + numpy at import time: every persistence front-end
-(ndarray.save, Module, gluon.Trainer, ShardedTrainer) can depend on this
-module without import cycles.
+Only stdlib + numpy (+ the import-light telemetry registry) at import
+time: every persistence front-end (ndarray.save, Module, gluon.Trainer,
+ShardedTrainer) can depend on this module without import cycles.
 """
 from __future__ import annotations
 
@@ -50,6 +50,7 @@ import warnings
 
 import numpy as np
 
+from . import telemetry as _telemetry
 from .base import MXNetError
 
 __all__ = ["AtomicWriteError", "CheckpointCorruptError", "NonfiniteError",
@@ -370,21 +371,36 @@ class CheckpointManager:
         # (the async-overlap contract — order preserved, none dropped)
         self.wait()
         if block:
-            self._write(step, host, blobs, meta)
+            with _telemetry.span("CheckpointManager.save",
+                                 _telemetry.CHECKPOINT_SAVE_SECONDS,
+                                 mode="sync"):
+                self._write(step, host, blobs, meta)
             return
         t = threading.Thread(target=self._write_guarded,
                              args=(step, host, blobs, meta),
                              name="ckpt-save-%d" % step, daemon=True)
         with self._lock:
             self._thread = t
-        t.start()
+        _telemetry.CHECKPOINT_QUEUE_DEPTH.inc()
+        try:
+            t.start()
+        except BaseException:
+            _telemetry.CHECKPOINT_QUEUE_DEPTH.dec()
+            with self._lock:
+                self._thread = None
+            raise
 
     def _write_guarded(self, step, host, blobs, meta):
         try:
-            self._write(step, host, blobs, meta)
+            with _telemetry.span("CheckpointManager.save",
+                                 _telemetry.CHECKPOINT_SAVE_SECONDS,
+                                 mode="async"):
+                self._write(step, host, blobs, meta)
         except BaseException as e:  # surfaced on wait()/next save
             with self._lock:
                 self._pending_error = e
+        finally:
+            _telemetry.CHECKPOINT_QUEUE_DEPTH.dec()
 
     def _write(self, step, host, blobs, meta):
         payload = {_ARRAY_KEY + k: v for k, v in host.items()}
@@ -498,6 +514,18 @@ class CheckpointManager:
         return Checkpoint(step, arrays, blobs, manifest.get("meta", {}),
                           dpath)
 
+    def _load_timed(self, step, verify=True):
+        """_load_one + telemetry: load latency on success (the span
+        skips failed scopes), a digest-failure count on any
+        verification/structure rejection."""
+        try:
+            with _telemetry.span("CheckpointManager.load",
+                                 _telemetry.CHECKPOINT_LOAD_SECONDS):
+                return self._load_one(step, verify=verify)
+        except CheckpointCorruptError:
+            _telemetry.CHECKPOINT_DIGEST_FAILURES.inc()
+            raise
+
     def load(self, step=None, verify=True, fallback=True):
         """Load (and digest-verify) a checkpoint.
 
@@ -508,11 +536,11 @@ class CheckpointManager:
         """
         self.wait()
         if step is not None:
-            return self._load_one(int(step), verify=verify)
+            return self._load_timed(int(step), verify=verify)
         candidates = self.steps()
         for s in reversed(candidates):
             try:
-                return self._load_one(s, verify=verify)
+                return self._load_timed(s, verify=verify)
             except CheckpointCorruptError as e:
                 if not fallback:
                     raise

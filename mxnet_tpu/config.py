@@ -23,6 +23,10 @@ def _pbool(v):
     return str(v).lower() in ("1", "true", "yes", "on")
 
 
+def _pfloat(v):
+    return float(v)
+
+
 # name -> (default, parser, disposition, note)
 FLAGS = {
     "MXNET_ENGINE_TYPE": (
@@ -88,6 +92,18 @@ FLAGS = {
                      "xla"),
         str, "honored",
         "directory backing the persistent compilation cache"),
+    "MXNET_TELEMETRY": (
+        "0", _pbool, "honored",
+        "runtime metrics registry (telemetry.py): step/serving/"
+        "checkpoint/compile series, Prometheus scrape() + JSON dump(); "
+        "off = one flag-check per call site"),
+    "MXNET_TELEMETRY_INTERVAL": (
+        "30", _pfloat, "honored",
+        "TelemetryReporter default snapshot interval in seconds"),
+    "MXNET_PEAK_TFLOPS": (
+        "", str, "honored",
+        "accelerator peak TFLOP/s for the MFU gauge (overrides the "
+        "docs/mfu_probe.json ceiling; '' = probe artifact or no MFU)"),
     "MXNET_NONFINITE_POLICY": (
         "warn", str, "honored",
         "default step-guard policy for NaN/Inf losses & gradient norms: "
@@ -170,6 +186,17 @@ def compile_cache_safe():
                 except (IndexError, ValueError):
                     return False
     return True
+
+
+def enable_telemetry(on=True):
+    """Toggle the runtime metrics registry (same switch as the
+    ``MXNET_TELEMETRY`` env flag, callable after import)."""
+    from . import telemetry
+
+    if on:
+        telemetry.enable()
+    else:
+        telemetry.disable()
 
 
 def enable_compile_cache(cache_dir=None, min_compile_time_secs=None):

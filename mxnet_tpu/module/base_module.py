@@ -17,6 +17,7 @@ import time
 
 from .. import metric as _metric
 from .. import ndarray
+from .. import telemetry as _telemetry
 from ..context import cpu
 
 __all__ = ["BaseModule", "_check_input_names", "_as_list"]
@@ -192,12 +193,14 @@ class BaseModule:
         except StopIteration:
             return final_pairs
         nbatch = 0
+        tel = _telemetry.enabled()
         while batch is not None:
             if checkpoint_manager is not None and \
                     checkpoint_manager.preempted:
                 self.logger.warning("Epoch[%d] preempted at batch %d; "
                                     "leaving epoch loop", epoch, nbatch)
                 break
+            t_batch0 = time.perf_counter() if tel else None
             if monitor is not None:
                 monitor.tic()
             self.forward_backward(batch)
@@ -210,6 +213,8 @@ class BaseModule:
                     logger=self.logger)
             if apply_update:
                 self.update()
+            else:
+                _telemetry.TRAIN_SKIPPED_STEPS.inc(loop="module")
             try:
                 upcoming = next(it)
                 self.prepare(upcoming, sparse_row_id_fn=sparse_row_id_fn)
@@ -224,6 +229,16 @@ class BaseModule:
             _fire(batch_end_callback,
                   BatchEndParam(epoch=epoch, nbatch=nbatch,
                                 eval_metric=eval_metric, locals=locals()))
+            if tel:
+                dt = time.perf_counter() - t_batch0
+                _telemetry.TRAIN_STEP_SECONDS.observe(dt, loop="module")
+                _telemetry.TRAIN_STEPS.inc(loop="module")
+                data = getattr(batch, "data", None)
+                if data and dt > 0:
+                    shp = getattr(data[0], "shape", None)
+                    if shp:
+                        _telemetry.TRAIN_SAMPLES_PER_SEC.set(
+                            int(shp[0]) / dt)
             batch = upcoming
             nbatch += 1
         return final_pairs
@@ -281,6 +296,7 @@ class BaseModule:
                 self.logger.info(
                     "auto-resume from checkpoint step %d -> begin_epoch %d",
                     ckpt.step, begin_epoch)
+                _telemetry.TRAIN_RESUMES.inc()
 
         self.bind(data_shapes=train_data.provide_data,
                   label_shapes=train_data.provide_label,
@@ -335,6 +351,7 @@ class BaseModule:
                                      val)
                 self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
                                  time.time() - start)
+                _telemetry.TRAIN_EPOCHS.inc()
 
                 arg_params, aux_params = self.get_params()
                 self.set_params(arg_params, aux_params)

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import functools
 import signal as _signal
+import time as _time
 
 import numpy as np
 
@@ -23,6 +24,7 @@ from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from .. import autograd
 from .. import random as _random
+from .. import telemetry as _telemetry
 from ..gluon import block as _block_mod
 
 __all__ = ["ShardedTrainer", "sgd_init", "adam_init"]
@@ -116,6 +118,7 @@ class ShardedTrainer:
         self._on_nonfinite = nonfinite_policy(on_nonfinite)
         self.global_step = 0
         self.skipped_steps = 0
+        self._step_flops = None  # one-time XLA cost attribution (telemetry)
         self._committed = None   # (params, opt_state, step, rng) snapshot
         self._ckpt_manager = None
         self._ckpt_period = 0
@@ -389,6 +392,8 @@ class ShardedTrainer:
         rng = _random.next_key()
         from .. import profiler as _profiler
 
+        tel = _telemetry.enabled()
+        t_step0 = _time.perf_counter() if tel else None
         # With a checkpoint manager attached, SIGTERM/SIGINT are masked
         # across dispatch+commit: donation invalidates the previous
         # committed snapshot's buffers the moment the jitted step is
@@ -428,15 +433,71 @@ class ShardedTrainer:
             # host check (syncs on the loss, which callers consume per
             # step anyway); under "skip" the compiled select already
             # discarded the update — this only reports and counts
+            loss_host = np.asarray(loss)
             if not _ckpt.check_finite(
-                    np.asarray(loss), self._on_nonfinite,
+                    loss_host, self._on_nonfinite,
                     what="loss (step %d)" % next_step):
                 self.skipped_steps += 1
+                _telemetry.TRAIN_SKIPPED_STEPS.inc(loop="sharded")
+            if tel and loss_host.size == 1:
+                _telemetry.TRAIN_LOSS.set(float(loss_host.reshape(())))
+        if tel:
+            # measured here so that under any loss-syncing policy (the
+            # default) the window covers device execution, not just the
+            # async dispatch; with policy "off" steady-state steps still
+            # converge to true step time via dispatch-queue backpressure
+            dt = _time.perf_counter() - t_step0
+            _telemetry.TRAIN_STEP_SECONDS.observe(dt, loop="sharded")
+            _telemetry.TRAIN_STEPS.inc(loop="sharded")
+            bs = 0
+            for a in (raw_label,) + tuple(raw_in):
+                shp = getattr(a, "shape", None)
+                if shp:
+                    bs = int(shp[0])
+                    break
+            if bs and dt > 0:
+                _telemetry.TRAIN_SAMPLES_PER_SEC.set(bs / dt)
+            self._record_step_cost(raw_in, raw_label, rng)
+            if self._step_flops:
+                _telemetry.TRAIN_STEP_FLOPS.set(self._step_flops)
+                peak = _telemetry.peak_flops()
+                if peak and dt > 0:
+                    _telemetry.TRAIN_MFU.set(self._step_flops / dt / peak)
         m = self._ckpt_manager
         if m is not None and self._ckpt_period and not m.preempted and \
                 next_step % self._ckpt_period == 0:
             self.save_checkpoint(m, step=next_step)
         return loss
+
+    def _record_step_cost(self, raw_in, raw_label, rng):
+        """One-time XLA cost attribution for the compiled step.
+
+        ``Lowered.cost_analysis`` reads the HLO without a second backend
+        compile (same trick as the CachedOp hook); the flops feed the
+        telemetry MFU gauge and ``profiler._xla_costs`` so ``dumps()``
+        shows the train step next to the compiled-program cost table.
+        Costs one extra host-side trace, paid once per process and only
+        when telemetry is on.
+        """
+        if self._step_flops is not None:
+            return
+        self._step_flops = 0.0
+        try:
+            lowered = self._step_fn.lower(
+                self.param_arrays, self.opt_state, tuple(raw_in),
+                raw_label, rng)
+            cost = lowered.cost_analysis()
+            if isinstance(cost, (list, tuple)):
+                cost = cost[0] if cost else {}
+            if cost:
+                from .. import profiler as _profiler
+
+                _profiler.record_xla_cost("ShardedTrainer.step", cost)
+                flops = float(cost.get("flops", 0.0) or 0.0)
+                if flops > 0:
+                    self._step_flops = flops
+        except Exception:
+            pass  # cost analysis is best-effort; never fail a step
 
     # -- fault tolerance -------------------------------------------------
     def attach_checkpoint_manager(self, manager, period=0,
@@ -464,6 +525,7 @@ class ShardedTrainer:
             ckpt = manager.load()
             if ckpt is not None:
                 self.restore_checkpoint(ckpt)
+                _telemetry.TRAIN_RESUMES.inc()
         if install_signal_handler:
             manager.install_preemption_handler(self._checkpoint_payload)
         return self.global_step

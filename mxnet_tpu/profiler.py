@@ -11,8 +11,11 @@ op stats are kept by this facade for `dumps()` parity.
 """
 from __future__ import annotations
 
+import collections
 import os
 import time
+
+from . import telemetry as _telemetry
 
 __all__ = ["set_config", "profiler_set_config", "start", "stop", "pause",
            "resume", "dump", "dumps", "set_state", "profiler_set_state",
@@ -24,9 +27,13 @@ _state = {"running": False, "dir": None}
 _records = []
 _op_stats = {}  # name -> [total_s, count, min_s, max_s]
 # bounded timeline log feeding the chrome-trace dump(); entries are
-# (name, start_s, dur_s) in perf_counter time
-_events = []
+# (name, start_s, dur_s) in perf_counter time.  At the cap the OLDEST
+# event is evicted (the tail of a long run is what a post-mortem wants)
+# and the drop is counted — silently freezing the timeline, as the old
+# newest-dropped behavior did, made a saturated trace look complete.
 _EVENT_CAP = 65536
+_events = collections.deque(maxlen=_EVENT_CAP)
+_dropped_events = 0
 # per-compiled-program XLA cost analysis (flops / bytes accessed),
 # attributed once per compile by the jit-path hooks
 _xla_costs = {}
@@ -99,6 +106,7 @@ def dump(finished=True, profile_process="worker"):
     payload = {"traceEvents": trace_events,
                "displayTimeUnit": "ms",
                "otherData": {"xla_costs": _xla_costs,
+                             "dropped_events": _dropped_events,
                              "device_memory": device_memory_stats()}}
     from .checkpoint import atomic_write
 
@@ -132,10 +140,14 @@ def record_op_time(name, dur_s, start_s=None):
             st[2] = dur_s
         if dur_s > st[3]:
             st[3] = dur_s
-    if len(_events) < _EVENT_CAP:
-        if start_s is None:
-            start_s = time.perf_counter() - dur_s
-        _events.append((name, start_s, dur_s))
+    if start_s is None:
+        start_s = time.perf_counter() - dur_s
+    if _events.maxlen is not None and len(_events) == _events.maxlen:
+        global _dropped_events
+
+        _dropped_events += 1
+        _telemetry.PROFILER_EVENTS_DROPPED.inc()
+    _events.append((name, start_s, dur_s))
 
 
 def timed_call(name, fn, args):
@@ -199,8 +211,12 @@ def dumps(reset=False):
                "Name", "Calls", "Total(ms)", "Min(ms)", "Max(ms)",
                "Avg(ms)")]
     for name, (tot, cnt, mn, mx) in sorted(agg.items()):
+        # count=0 placeholder rows (a registered name that never fired)
+        # must render as zeros, not divide by zero
+        avg = tot / cnt * 1e3 if cnt else 0.0
+        mn = 0.0 if mn == float("inf") else mn
         out.append("%-32s %10d %12.4f %12.4f %12.4f %12.4f" % (
-            name, cnt, tot * 1e3, mn * 1e3, mx * 1e3, tot / cnt * 1e3))
+            name, cnt, tot * 1e3, mn * 1e3, mx * 1e3, avg))
     if _xla_costs:
         out.append("")
         out.append("XLA cost analysis (per compiled program):")
@@ -217,10 +233,16 @@ def dumps(reset=False):
             peak = st.get("peak_bytes_in_use", 0)
             out.append("%-32s in_use %12d  peak %12d" % (dev, used, peak))
     if reset:
+        global _dropped_events
+
         _records.clear()
         _op_stats.clear()
         _events.clear()
         _xla_costs.clear()
+        # the drop count describes the cleared timeline; a fresh window
+        # must not inherit it (the cumulative telemetry counter is the
+        # process-lifetime view)
+        _dropped_events = 0
     return "\n".join(out)
 
 

@@ -25,7 +25,11 @@ pipeline is compute-bound at the device-resident numbers.
 """
 from __future__ import annotations
 
+import time as _time
+
 import numpy as np
+
+from . import telemetry as _telemetry
 
 __all__ = ["Predictor", "uint8_normalizer"]
 
@@ -166,6 +170,18 @@ class Predictor:
         Pads a ragged final batch up to the compiled batch size on the
         host (cheap: raw bytes, no arithmetic) so no second XLA program
         is ever compiled; returns (device_array, valid_rows)."""
+        try:
+            return self._upload_impl(b)
+        except (TypeError, ValueError):
+            # batch-contract violations (shape/dtype) — caller bug
+            _telemetry.SERVING_ERRORS.inc(kind="contract")
+            raise
+        except Exception:
+            # retry-exhausted host->device transfer and anything else
+            _telemetry.SERVING_ERRORS.inc(kind="transfer")
+            raise
+
+    def _upload_impl(self, b):
         import jax
 
         if not isinstance(b, (np.ndarray, jax.Array)):
@@ -187,7 +203,7 @@ class Predictor:
                     "Predictor batch contract implicitly set to %s/%s by "
                     "the first request; larger batches will be rejected — "
                     "pass batch_shape=/batch_dtype= to pin it explicitly"
-                    % (tuple(b.shape), np.dtype(b.dtype)), stacklevel=3)
+                    % (tuple(b.shape), np.dtype(b.dtype)), stacklevel=4)
             self._batch_shape = tuple(b.shape)
         if self._batch_dtype is None:
             self._batch_dtype = np.dtype(b.dtype)
@@ -227,12 +243,14 @@ class Predictor:
         (async) as soon as it is pulled from ``batches``; chunks of
         ``chain`` device-resident batches run as single dispatches; while
         chunk i's outputs are fetched, chunk i+1 is already executing."""
-        chunk = []            # [(device_array, n_valid)]
-        pending = None        # (stacked device outputs, [n_valid...])
+        chunk = []            # [(device_array, n_valid, t_submit)]
+        pending = None        # (stacked device outputs, [(n_valid, t)..])
+        tel = _telemetry.enabled()
+        outstanding = [0]     # uploads not yet drained (gauge bookkeeping)
 
         def dispatch(items):
-            arrs = [a for a, _ in items]
-            valid = [n for _, n in items]
+            arrs = [a for a, _n, _t in items]
+            valid = [(n, t) for _a, n, t in items]
             if len(arrs) == 1 and self._chain == 1:
                 out = self._jit_one(arrs[0], self._params)
                 return out[None], valid
@@ -248,21 +266,42 @@ class Predictor:
             # would pay a tunnel round-trip per batch
             host = np.asarray(out)
             bs = self._batch_shape[0]
-            for i, n in enumerate(valid):
+            for i, (n, t0) in enumerate(valid):
+                if t0 is not None:
+                    # latency = upload submission -> output on host
+                    _telemetry.SERVING_REQUEST_SECONDS.observe(
+                        _time.perf_counter() - t0)
+                    _telemetry.SERVING_IN_FLIGHT.dec()
+                    outstanding[0] -= 1
                 yield host[i] if n == bs else host[i, :n]
 
-        for b in batches:
-            chunk.append(self._upload(b))
-            if len(chunk) == self._chain:
+        try:
+            for b in batches:
+                t0 = _time.perf_counter() if tel else None
+                arr, n_valid = self._upload(b)
+                if tel:
+                    _telemetry.SERVING_REQUESTS.inc()
+                    _telemetry.SERVING_BATCH_SIZE.observe(n_valid)
+                    _telemetry.SERVING_IN_FLIGHT.inc()
+                    outstanding[0] += 1
+                chunk.append((arr, n_valid, t0))
+                if len(chunk) == self._chain:
+                    out_n = dispatch(chunk)
+                    chunk = []
+                    if pending is not None:
+                        yield from drain(pending)
+                    pending = out_n
+            if chunk:
                 out_n = dispatch(chunk)
-                chunk = []
                 if pending is not None:
                     yield from drain(pending)
                 pending = out_n
-        if chunk:
-            out_n = dispatch(chunk)
             if pending is not None:
                 yield from drain(pending)
-            pending = out_n
-        if pending is not None:
-            yield from drain(pending)
+        finally:
+            # a stream abandoned early (consumer break / GeneratorExit)
+            # or killed by a contract error must not leave phantom
+            # requests on the in-flight gauge forever
+            if outstanding[0]:
+                _telemetry.SERVING_IN_FLIGHT.dec(outstanding[0])
+                outstanding[0] = 0

@@ -1,0 +1,742 @@
+"""Unified runtime telemetry: process-wide metrics registry + exporters.
+
+The reference stack answers "how fast is a step and is anything
+degrading" through its profiler subsystem (src/profiler/); this module
+is the runtime counterpart for a serving/training fleet: a single
+process-wide registry of Counter / Gauge / Histogram series that every
+layer (ShardedTrainer, Module.fit, CheckpointManager, serving.Predictor,
+profiler, XLA compile path) reports into, exported as
+
+* :func:`scrape` — Prometheus text exposition (``/metrics`` body),
+* :func:`dump` — atomic JSON snapshot (via ``checkpoint.atomic_write``),
+* :class:`TelemetryReporter` — opt-in background thread that snapshots
+  at a fixed interval and drives ``monitor.start_heartbeat``.
+
+Collection is OFF by default: every mutator starts with one module-flag
+check (``if not _enabled: return``), so an un-enabled process pays a
+single attribute load + branch per call site.  Turn it on with
+``MXNET_TELEMETRY=1`` (read at import) or :func:`enable`.
+
+Metric names follow Prometheus conventions (``mxnet_tpu_`` prefix,
+base-unit ``_seconds``/``_total`` suffixes); the full catalog is
+declared at import time below so a guard test can lint every name.
+
+Import-light by design (stdlib + ``config`` only): ``checkpoint`` and
+``profiler`` import this module at top level, so it must never import
+them back except lazily inside functions.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+from . import config as _config
+
+__all__ = ["Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
+           "enabled", "enable", "disable", "counter", "gauge", "histogram",
+           "span", "scrape", "dump", "collect", "reset",
+           "TelemetryReporter", "set_peak_flops", "peak_flops",
+           "DEFAULT_TIME_BUCKETS", "BATCH_SIZE_BUCKETS"]
+
+_enabled = False
+
+# latency buckets (seconds): 0.5 ms .. 2 min, roughly 2-2.5x apart —
+# covers serving dispatch (~ms) through cold XLA compiles (~100 s)
+DEFAULT_TIME_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                        0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+                        120.0)
+# power-of-two batch sizes, the only ones the serving path compiles for
+BATCH_SIZE_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0, 256.0,
+                      512.0, 1024.0)
+
+_INF = float("inf")
+
+
+def enabled():
+    """Whether metric collection is on (one branch on the hot path)."""
+    return _enabled
+
+
+def enable():
+    """Turn collection on and install the jax compile-event bridge."""
+    global _enabled
+    _enabled = True
+    _install_jax_bridge()
+
+
+def disable():
+    """Turn collection off (registered series keep their values)."""
+    global _enabled
+    _enabled = False
+
+
+def _fmt(v):
+    """Prometheus sample-value / bucket-bound formatting."""
+    if v != v:
+        return "NaN"
+    if v == _INF:
+        return "+Inf"
+    if v == -_INF:
+        return "-Inf"
+    f = float(v)
+    if f == int(f) and abs(f) < 1e15:
+        return "%d" % int(f)
+    return repr(f)
+
+
+def _escape_label(v):
+    return str(v).replace("\\", "\\\\").replace('"', '\\"') \
+        .replace("\n", "\\n")
+
+
+def _escape_help(v):
+    return str(v).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _json_num(v):
+    """JSON-portable number: RFC 8259 has no Infinity/NaN tokens, so
+    non-finite values ship as strings (``float()`` round-trips them)."""
+    if v != v:
+        return "NaN"
+    if v == _INF:
+        return "Infinity"
+    if v == -_INF:
+        return "-Infinity"
+    return v
+
+
+class _Metric:
+    """Shared label plumbing for the three metric kinds.
+
+    A metric owns a dict of *series* keyed by the tuple of label values
+    (in declared ``label_names`` order).  An unlabeled metric has
+    exactly one series, created eagerly so it is always exported (a
+    counter that has never fired still scrapes as ``0`` — absence and
+    zero are different signals).
+    """
+
+    kind = None
+
+    def __init__(self, name, help, label_names=()):
+        self.name = name
+        self.help = help
+        self.label_names = tuple(label_names)
+        self._lock = threading.Lock()
+        self._series = {}
+        if not self.label_names:
+            self._series[()] = self._new_series()
+
+    def _new_series(self):
+        raise NotImplementedError
+
+    def _key(self, labels):
+        if set(labels) != set(self.label_names):
+            raise ValueError(
+                "metric %s takes labels %r, got %r"
+                % (self.name, self.label_names, tuple(sorted(labels))))
+        return tuple(str(labels[k]) for k in self.label_names)
+
+    def _get_series(self, labels):
+        key = self._key(labels)
+        s = self._series.get(key)
+        if s is None:
+            with self._lock:
+                s = self._series.setdefault(key, self._new_series())
+        return s
+
+    def series_labels(self):
+        """Label dicts of every live series (scrape order)."""
+        with self._lock:
+            keys = sorted(self._series)
+        return [dict(zip(self.label_names, k)) for k in keys]
+
+    def clear(self):
+        with self._lock:
+            self._series.clear()
+            if not self.label_names:
+                self._series[()] = self._new_series()
+
+
+class Counter(_Metric):
+    """Monotonically increasing count (name should end ``_total``)."""
+
+    kind = "counter"
+
+    def _new_series(self):
+        return [0.0]
+
+    def inc(self, amount=1, **labels):
+        if not _enabled:
+            return
+        if amount < 0:
+            raise ValueError("counter %s cannot decrease" % self.name)
+        s = self._get_series(labels)
+        with self._lock:
+            s[0] += amount
+
+    def value(self, **labels):
+        s = self._series.get(self._key(labels))
+        return s[0] if s is not None else 0.0
+
+
+class Gauge(_Metric):
+    """Point-in-time value (may go up and down)."""
+
+    kind = "gauge"
+
+    def _new_series(self):
+        return [0.0]
+
+    def set(self, value, **labels):
+        if not _enabled:
+            return
+        s = self._get_series(labels)
+        with self._lock:
+            s[0] = float(value)
+
+    def inc(self, amount=1, **labels):
+        if not _enabled:
+            return
+        s = self._get_series(labels)
+        with self._lock:
+            s[0] += amount
+
+    def dec(self, amount=1, **labels):
+        self.inc(-amount, **labels)
+
+    def value(self, **labels):
+        s = self._series.get(self._key(labels))
+        return s[0] if s is not None else 0.0
+
+
+class Histogram(_Metric):
+    """Fixed-boundary histogram with Prometheus bucket semantics.
+
+    Per-series state is ``[per-bucket counts..., +Inf count, sum]``;
+    exposition emits *cumulative* ``_bucket{le=...}`` counts plus
+    ``_sum``/``_count`` like prometheus-client.
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name, help, label_names=(),
+                 buckets=DEFAULT_TIME_BUCKETS):
+        b = tuple(sorted(float(x) for x in buckets))
+        if not b or any(b[i] >= b[i + 1] for i in range(len(b) - 1)):
+            raise ValueError("histogram %s needs strictly increasing "
+                             "buckets, got %r" % (name, buckets))
+        if b[-1] == _INF:
+            b = b[:-1]
+        self.buckets = b
+        super().__init__(name, help, label_names)
+
+    def _new_series(self):
+        return [0] * (len(self.buckets) + 1) + [0.0]
+
+    def observe(self, value, **labels):
+        if not _enabled:
+            return
+        value = float(value)
+        s = self._get_series(labels)
+        i = 0
+        n = len(self.buckets)
+        while i < n and value > self.buckets[i]:
+            i += 1
+        with self._lock:
+            s[i] += 1
+            s[-1] += value
+
+    def count(self, **labels):
+        s = self._series.get(self._key(labels))
+        return sum(s[:-1]) if s is not None else 0
+
+    def sum(self, **labels):
+        s = self._series.get(self._key(labels))
+        return s[-1] if s is not None else 0.0
+
+    def cumulative(self, **labels):
+        """[(upper_bound, cumulative_count)] including (+Inf, total)."""
+        s = self._series.get(self._key(labels))
+        if s is None:
+            s = self._new_series()
+        out, running = [], 0
+        for i, ub in enumerate(self.buckets + (_INF,)):
+            running += s[i]
+            out.append((ub, running))
+        return out
+
+    def quantile(self, q, **labels):
+        """Bucket-interpolated quantile estimate (like Prometheus'
+        ``histogram_quantile``); None when the series is empty."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError("quantile must be in [0, 1], got %r" % (q,))
+        cum = self.cumulative(**labels)
+        total = cum[-1][1]
+        if total == 0:
+            return None
+        rank = q * total
+        prev_ub, prev_c = 0.0, 0
+        for ub, c in cum:
+            if c >= rank:
+                if ub == _INF:
+                    # open-ended top bucket: best estimate is its lower
+                    # edge (Prometheus returns the same)
+                    return prev_ub if self.buckets else 0.0
+                if c == prev_c:
+                    return ub
+                return prev_ub + (ub - prev_ub) * (rank - prev_c) \
+                    / (c - prev_c)
+            prev_ub, prev_c = ub, c
+        return cum[-1][0]
+
+
+class Registry:
+    """Named-metric store; ``REGISTRY`` below is the process-wide one."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = {}
+
+    def _register(self, cls, name, help, label_names, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if type(m) is not cls or \
+                        m.label_names != tuple(label_names):
+                    raise ValueError(
+                        "metric %r already registered as %s%r"
+                        % (name, m.kind, m.label_names))
+                return m
+            m = cls(name, help, label_names, **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name, help, label_names=()):
+        return self._register(Counter, name, help, label_names)
+
+    def gauge(self, name, help, label_names=()):
+        return self._register(Gauge, name, help, label_names)
+
+    def histogram(self, name, help, label_names=(),
+                  buckets=DEFAULT_TIME_BUCKETS):
+        return self._register(Histogram, name, help, label_names,
+                              buckets=buckets)
+
+    def metrics(self):
+        with self._lock:
+            return [self._metrics[n] for n in sorted(self._metrics)]
+
+    def get(self, name):
+        return self._metrics.get(name)
+
+    def reset(self):
+        """Zero every series (registrations survive) — test hook."""
+        for m in self.metrics():
+            m.clear()
+
+    # -- exporters -------------------------------------------------------
+    def collect(self):
+        """JSON-able snapshot: name -> {type, help, series: [...]}."""
+        out = {}
+        for m in self.metrics():
+            series = []
+            for labels in m.series_labels():
+                if m.kind == "histogram":
+                    series.append({
+                        "labels": labels,
+                        "buckets": [[_json_num(ub), c]
+                                    for ub, c in m.cumulative(**labels)],
+                        "sum": m.sum(**labels),
+                        "count": m.count(**labels)})
+                else:
+                    series.append({"labels": labels,
+                                   "value": _json_num(m.value(**labels))})
+            out[m.name] = {"type": m.kind, "help": m.help,
+                           "label_names": list(m.label_names),
+                           "series": series}
+        return out
+
+    def scrape(self):
+        """Prometheus text exposition (format 0.0.4)."""
+        lines = []
+        for m in self.metrics():
+            lines.append("# HELP %s %s" % (m.name, _escape_help(m.help)))
+            lines.append("# TYPE %s %s" % (m.name, m.kind))
+            for labels in m.series_labels():
+                if m.kind == "histogram":
+                    for ub, c in m.cumulative(**labels):
+                        lines.append("%s_bucket%s %s" % (
+                            m.name,
+                            _label_str(labels, extra=[("le", _fmt(ub))]),
+                            _fmt(c)))
+                    lines.append("%s_sum%s %s" % (
+                        m.name, _label_str(labels), _fmt(m.sum(**labels))))
+                    lines.append("%s_count%s %s" % (
+                        m.name, _label_str(labels),
+                        _fmt(m.count(**labels))))
+                else:
+                    lines.append("%s%s %s" % (
+                        m.name, _label_str(labels),
+                        _fmt(m.value(**labels))))
+        return "\n".join(lines) + "\n"
+
+    def dump(self, path):
+        """Atomic JSON snapshot at ``path`` (crash-safe: old or new file,
+        never a torn one)."""
+        from .checkpoint import atomic_write
+
+        payload = {"format_version": 1, "time": time.time(),
+                   "metrics": self.collect()}
+        # allow_nan=False: a non-finite value leaking past _json_num
+        # must fail here, not emit a bare Infinity/NaN token only
+        # Python's lenient parser would accept
+        atomic_write(os.fspath(path),
+                     json.dumps(payload, indent=1, sort_keys=True,
+                                allow_nan=False))
+        return path
+
+
+def _label_str(labels, extra=()):
+    pairs = [(k, _escape_label(v)) for k, v in labels.items()]
+    pairs += list(extra)
+    if not pairs:
+        return ""
+    return "{%s}" % ",".join('%s="%s"' % kv for kv in pairs)
+
+
+REGISTRY = Registry()
+
+
+def counter(name, help, label_names=()):
+    """Get-or-register a :class:`Counter` on the default registry."""
+    return REGISTRY.counter(name, help, label_names)
+
+
+def gauge(name, help, label_names=()):
+    return REGISTRY.gauge(name, help, label_names)
+
+
+def histogram(name, help, label_names=(), buckets=DEFAULT_TIME_BUCKETS):
+    return REGISTRY.histogram(name, help, label_names, buckets=buckets)
+
+
+def collect():
+    return REGISTRY.collect()
+
+
+def scrape():
+    return REGISTRY.scrape()
+
+
+def dump(path):
+    return REGISTRY.dump(path)
+
+
+def reset():
+    REGISTRY.reset()
+
+
+# ---------------------------------------------------------------------------
+# span events
+# ---------------------------------------------------------------------------
+
+class span:
+    """Timed scope: observes its duration into ``hist`` (when telemetry
+    is on) and into the profiler timeline/aggregate-stats table (when
+    ``profiler.set_config(aggregate_stats=True)`` is on) — one context
+    manager feeds both subsystems so dashboards and chrome-traces agree.
+    A scope that exits via an exception records NOTHING: latency series
+    describe completed operations (failures get their own counters).
+    """
+
+    __slots__ = ("name", "hist", "labels", "_t0")
+
+    def __init__(self, name, hist=None, **labels):
+        self.name = name
+        self.hist = hist
+        self.labels = labels
+        self._t0 = None
+
+    def __enter__(self):
+        from . import profiler as _profiler
+
+        if _enabled or _profiler.aggregate_enabled():
+            self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._t0 is None or exc_type is not None:
+            return
+        dur = time.perf_counter() - self._t0
+        if _enabled and self.hist is not None:
+            self.hist.observe(dur, **self.labels)
+        from . import profiler as _profiler
+
+        if _profiler.aggregate_enabled():
+            _profiler.record_op_time(self.name, dur, self._t0)
+
+
+# ---------------------------------------------------------------------------
+# metric catalog (import-time: the name-lint guard test walks REGISTRY)
+# ---------------------------------------------------------------------------
+
+# training (label loop: "sharded" = ShardedTrainer, "module" = Module.fit)
+TRAIN_STEP_SECONDS = histogram(
+    "mxnet_tpu_train_step_seconds",
+    "Train-step wall time (dispatch+commit; includes device execution "
+    "whenever the non-finite guard syncs on the loss).", ("loop",))
+TRAIN_STEPS = counter(
+    "mxnet_tpu_train_steps_total", "Train steps completed.", ("loop",))
+TRAIN_SKIPPED_STEPS = counter(
+    "mxnet_tpu_train_skipped_steps_total",
+    "Updates discarded by the non-finite step guard.", ("loop",))
+TRAIN_RESUMES = counter(
+    "mxnet_tpu_train_resumes_total",
+    "Auto-resumes from a checkpoint at training start.")
+TRAIN_EPOCHS = counter(
+    "mxnet_tpu_train_epochs_total", "Epochs completed by Module.fit.")
+TRAIN_SAMPLES_PER_SEC = gauge(
+    "mxnet_tpu_train_samples_per_second",
+    "Throughput of the most recent train step.")
+TRAIN_LOSS = gauge(
+    "mxnet_tpu_train_loss", "Most recent train-step loss.")
+TRAIN_STEP_FLOPS = gauge(
+    "mxnet_tpu_train_step_flops",
+    "XLA cost-analysis FLOPs of the compiled train step.")
+TRAIN_MFU = gauge(
+    "mxnet_tpu_train_mfu_ratio",
+    "Model FLOPs utilization: step_flops / step_seconds / peak_flops "
+    "(peak from set_peak_flops, MXNET_PEAK_TFLOPS, or docs/"
+    "mfu_probe.json).")
+
+# XLA compile path (fed by the jax.monitoring bridge)
+COMPILE_SECONDS = histogram(
+    "mxnet_tpu_compile_seconds", "Backend (XLA) compile wall time.")
+COMPILES = counter(
+    "mxnet_tpu_compiles_total", "Backend (XLA) compilations.")
+COMPILE_CACHE_HITS = counter(
+    "mxnet_tpu_compile_cache_hits_total",
+    "Persistent compilation-cache hits.")
+COMPILE_CACHE_MISSES = counter(
+    "mxnet_tpu_compile_cache_misses_total",
+    "Persistent compilation-cache misses.")
+
+# checkpointing
+CHECKPOINT_SAVE_SECONDS = histogram(
+    "mxnet_tpu_checkpoint_save_seconds",
+    "Checkpoint serialize+fsync+rename time.", ("mode",))
+CHECKPOINT_LOAD_SECONDS = histogram(
+    "mxnet_tpu_checkpoint_load_seconds",
+    "Checkpoint read+digest-verify time.")
+CHECKPOINT_QUEUE_DEPTH = gauge(
+    "mxnet_tpu_checkpoint_async_queue_depth",
+    "In-flight async checkpoint saves (0 or 1: overlapping saves "
+    "serialize).")
+CHECKPOINT_DIGEST_FAILURES = counter(
+    "mxnet_tpu_checkpoint_digest_failures_total",
+    "Checkpoints rejected by digest/structure verification.")
+
+# serving
+SERVING_REQUESTS = counter(
+    "mxnet_tpu_serving_requests_total",
+    "Batches submitted to Predictor.predict.")
+SERVING_REQUEST_SECONDS = histogram(
+    "mxnet_tpu_serving_request_seconds",
+    "Per-batch latency: upload submission to output yield.")
+SERVING_BATCH_SIZE = histogram(
+    "mxnet_tpu_serving_batch_size",
+    "Valid rows per submitted batch.", buckets=BATCH_SIZE_BUCKETS)
+SERVING_IN_FLIGHT = gauge(
+    "mxnet_tpu_serving_in_flight",
+    "Batches uploaded but not yet yielded.")
+SERVING_ERRORS = counter(
+    "mxnet_tpu_serving_errors_total",
+    "Predictor failures by kind (contract = shape/dtype violation, "
+    "transfer = host->device upload).", ("kind",))
+
+# profiler facade
+PROFILER_EVENTS_DROPPED = counter(
+    "mxnet_tpu_profiler_events_dropped_total",
+    "Timeline events evicted oldest-first at the profiler event cap.")
+
+
+# ---------------------------------------------------------------------------
+# jax.monitoring bridge: compile + compilation-cache events
+# ---------------------------------------------------------------------------
+
+_bridge_lock = threading.Lock()
+_bridge_installed = False
+
+_BACKEND_COMPILE_EVENTS = (
+    # jax 0.4.x name, and the _sec-suffixed spelling used by other
+    # versions — match either so the bridge survives jax upgrades
+    "/jax/core/compile/backend_compile_duration",
+    "/jax/core/compile/backend_compile_time_sec",
+)
+
+
+def _on_jax_event(event, **kw):
+    if not _enabled:
+        return
+    if event == "/jax/compilation_cache/cache_hits":
+        COMPILE_CACHE_HITS.inc()
+    elif event == "/jax/compilation_cache/cache_misses":
+        COMPILE_CACHE_MISSES.inc()
+
+
+def _on_jax_duration(event, duration_secs, **kw):
+    if not _enabled:
+        return
+    if event in _BACKEND_COMPILE_EVENTS:
+        COMPILES.inc()
+        COMPILE_SECONDS.observe(duration_secs)
+
+
+def _install_jax_bridge():
+    """Register the (idempotent, process-lifetime) jax.monitoring
+    listeners.  They early-return when telemetry is disabled, so the
+    cost of a later :func:`disable` is one branch per compile event."""
+    global _bridge_installed
+    with _bridge_lock:
+        if _bridge_installed:
+            return
+        try:
+            import jax.monitoring as _jm
+
+            _jm.register_event_listener(_on_jax_event)
+            _jm.register_event_duration_secs_listener(_on_jax_duration)
+            _bridge_installed = True
+        except Exception:
+            pass  # no jax (docs tooling) — counters simply stay 0
+
+
+# ---------------------------------------------------------------------------
+# MFU peak-FLOPs resolution
+# ---------------------------------------------------------------------------
+
+_peak_flops = None       # explicit set_peak_flops value
+_peak_resolved = None    # cached (found, value) from env/probe
+
+
+def set_peak_flops(flops_per_sec):
+    """Pin the accelerator peak FLOP/s used by the MFU gauge (overrides
+    MXNET_PEAK_TFLOPS and the probe artifact).  Pass None to unpin."""
+    global _peak_flops, _peak_resolved
+    _peak_flops = None if flops_per_sec is None else float(flops_per_sec)
+    _peak_resolved = None
+
+
+def peak_flops():
+    """Best-known accelerator peak FLOP/s, or None.
+
+    Resolution order: :func:`set_peak_flops` > ``MXNET_PEAK_TFLOPS`` env
+    flag > the matmul/conv ceiling measured into ``docs/mfu_probe.json``
+    by ``tools/bench_mfu.py`` (repo checkouts only).
+    """
+    global _peak_resolved
+    if _peak_flops is not None:
+        return _peak_flops
+    if _peak_resolved is not None:
+        return _peak_resolved[1]
+    val = None
+    raw = _config.get("MXNET_PEAK_TFLOPS")
+    if raw:
+        try:
+            val = float(raw) * 1e12
+        except ValueError:
+            pass
+    if val is None:
+        probe = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "docs", "mfu_probe.json")
+        try:
+            with open(probe) as f:
+                data = json.load(f)
+            tflops = max(max(r["tflops"] for r in data["matmul"]),
+                         data["conv"]["tflops"])
+            val = tflops * 1e12
+        except Exception:
+            val = None
+    _peak_resolved = (val is not None, val)
+    return val
+
+
+# ---------------------------------------------------------------------------
+# background reporter
+# ---------------------------------------------------------------------------
+
+class TelemetryReporter:
+    """Opt-in background snapshot thread.
+
+    Every ``interval`` seconds (default ``MXNET_TELEMETRY_INTERVAL``):
+    writes :func:`dump` to ``path`` (when given) and calls
+    ``callback(snapshot)`` with the :func:`collect` dict (when given) —
+    the hook ``monitor.start_heartbeat`` uses for its one-line log.
+    Daemon thread; ``stop()`` is synchronous and flushes a final
+    snapshot.  Also usable as a context manager.
+    """
+
+    def __init__(self, interval=None, path=None, callback=None,
+                 logger=None):
+        if interval is None:
+            interval = _config.get("MXNET_TELEMETRY_INTERVAL")
+        self.interval = float(interval)
+        if self.interval <= 0:
+            raise ValueError("reporter interval must be > 0, got %r"
+                             % (interval,))
+        self.path = os.fspath(path) if path is not None else None
+        self.callback = callback
+        import logging
+
+        self.logger = logger or logging.getLogger("mxnet_tpu.telemetry")
+        self._stop = threading.Event()
+        self._thread = None
+
+    def _tick(self):
+        try:
+            snap = None
+            if self.path is not None:
+                dump(self.path)
+            if self.callback is not None:
+                snap = collect()
+                self.callback(snap)
+        except Exception:
+            # a broken disk or callback must never kill the reporter —
+            # observability failing loudly inside the train loop would
+            # be worse than the condition it reports
+            self.logger.exception("telemetry snapshot failed")
+
+    def _run(self):
+        while not self._stop.wait(self.interval):
+            self._tick()
+
+    def start(self):
+        if self._thread is not None:
+            raise RuntimeError("reporter already started")
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run,
+                                        name="telemetry-reporter",
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self):
+        """Signal the thread, join it, and write one final snapshot."""
+        t = self._thread
+        if t is None:
+            return
+        self._stop.set()
+        t.join()
+        self._thread = None
+        self._tick()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+if _config.get("MXNET_TELEMETRY"):
+    enable()

@@ -1,0 +1,311 @@
+"""Unified runtime telemetry (mxnet_tpu/telemetry.py).
+
+Registry semantics, disabled-mode no-op, Prometheus exposition
+validity (the tier-1 guard: name lint + parseable scrape), the
+cross-layer instrumentation on a tiny 2-step CPU trainer + checkpoint
++ serving run, the profiler event-cap eviction and dumps() zero-count
+regressions, and the dump CLI.  Kept deliberately lean: ONE tiny
+trainer compile and one predictor compile for the whole file.
+"""
+import collections
+import importlib.util
+import json
+import os
+import re
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon, monitor, parallel, profiler
+from mxnet_tpu import telemetry as tel
+from mxnet_tpu.serving import Predictor
+
+
+@pytest.fixture
+def registry():
+    """Enable collection with a zeroed default registry; leave the
+    process disabled (the suite default) afterwards."""
+    tel.enable()
+    tel.reset()
+    yield tel
+    tel.reset()
+    tel.disable()
+
+
+# ---------------------------------------------------------------------------
+# registry semantics
+# ---------------------------------------------------------------------------
+
+def test_counter_gauge_label_semantics(registry):
+    r = tel.Registry()
+    c = r.counter("mxnet_tpu_t_total", "t", ("kind",))
+    c.inc(kind="a")
+    c.inc(2, kind="a")
+    c.inc(kind="b")
+    assert c.value(kind="a") == 3 and c.value(kind="b") == 1
+    with pytest.raises(ValueError):
+        c.inc(-1, kind="a")
+    with pytest.raises(ValueError):
+        c.inc(wrong="a")
+    g = r.gauge("mxnet_tpu_g", "g")
+    g.set(5)
+    g.dec(2)
+    assert g.value() == 3
+    # re-registration is idempotent; kind/label conflicts are errors
+    assert r.counter("mxnet_tpu_t_total", "t", ("kind",)) is c
+    with pytest.raises(ValueError):
+        r.gauge("mxnet_tpu_t_total", "t", ("kind",))
+    with pytest.raises(ValueError):
+        r.counter("mxnet_tpu_t_total", "t", ("other",))
+
+
+def test_histogram_buckets_and_quantile(registry):
+    r = tel.Registry()
+    h = r.histogram("mxnet_tpu_h_seconds", "h", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.1, 0.5, 5.0, 100.0):
+        h.observe(v)
+    # le= semantics: a value equal to a bound lands in that bucket
+    assert h.cumulative() == [(0.1, 2), (1.0, 3), (10.0, 4),
+                              (float("inf"), 5)]
+    assert h.count() == 5
+    assert h.sum() == pytest.approx(105.65)
+    assert 0.1 < h.quantile(0.5) <= 1.0
+    assert h.quantile(0.999) == 10.0  # open top bucket -> lower edge
+    empty = r.histogram("mxnet_tpu_e_seconds", "e")
+    assert empty.quantile(0.5) is None and empty.count() == 0
+
+
+def test_disabled_mode_is_noop():
+    tel.disable()
+    steps = tel.TRAIN_STEPS.value(loop="sharded")
+    obs = tel.TRAIN_STEP_SECONDS.count(loop="sharded")
+    sps = tel.TRAIN_SAMPLES_PER_SEC.value()
+    tel.TRAIN_STEPS.inc(loop="sharded")
+    tel.TRAIN_SAMPLES_PER_SEC.set(sps + 123.0)
+    tel.TRAIN_STEP_SECONDS.observe(1.0, loop="sharded")
+    assert tel.TRAIN_STEPS.value(loop="sharded") == steps
+    assert tel.TRAIN_STEP_SECONDS.count(loop="sharded") == obs
+    assert tel.TRAIN_SAMPLES_PER_SEC.value() == sps
+    # spans take no timestamp when both telemetry and profiler are off
+    with tel.span("noop") as s:
+        assert s._t0 is None
+
+
+# ---------------------------------------------------------------------------
+# tier-1 guards: name lint + valid Prometheus exposition
+# ---------------------------------------------------------------------------
+
+_NAME_RE = re.compile(r"^mxnet_tpu_[a-z0-9_]+$")
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_][a-zA-Z0-9_]*)(\{[^{}]*\})? (NaN|[+-]Inf|[0-9eE.+-]+)$")
+
+
+def test_metric_names_registered_at_import_are_lint_clean():
+    metrics = tel.REGISTRY.metrics()
+    assert len(metrics) >= 20
+    for m in metrics:
+        assert _NAME_RE.match(m.name), m.name
+        if m.kind == "counter":
+            assert m.name.endswith("_total"), m.name
+
+
+def test_scrape_is_valid_prometheus_exposition(registry):
+    tel.TRAIN_STEPS.inc(loop="sharded")
+    tel.TRAIN_STEP_SECONDS.observe(0.01, loop="sharded")
+    tel.SERVING_ERRORS.inc(kind="contract")
+    text = tel.scrape()
+    helped, typed, seen = set(), {}, set()
+    for line in text.strip().split("\n"):
+        if line.startswith("# HELP "):
+            helped.add(line.split()[2])
+            continue
+        if line.startswith("# TYPE "):
+            typed[line.split()[2]] = line.split()[3]
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, "unparseable sample line: %r" % line
+        assert line not in seen, "duplicate series: %r" % line
+        seen.add(line)
+        name = m.group(1)
+        family = re.sub(r"_(bucket|sum|count)$", "", name)
+        assert family in typed or name in typed, name
+    # every declared family emitted HELP+TYPE and a histogram emits
+    # cumulative buckets ending in +Inf == count
+    for m in tel.REGISTRY.metrics():
+        assert m.name in helped and typed[m.name] == m.kind
+    cum = tel.TRAIN_STEP_SECONDS.cumulative(loop="sharded")
+    assert [c for _, c in cum] == sorted(c for _, c in cum)
+    assert 'mxnet_tpu_train_step_seconds_bucket{loop="sharded",le="+Inf"} 1'\
+        in text
+    assert 'mxnet_tpu_train_step_seconds_count{loop="sharded"} 1' in text
+
+
+# ---------------------------------------------------------------------------
+# cross-layer instrumentation: 2-step trainer + checkpoint + serving
+# ---------------------------------------------------------------------------
+
+def test_trainer_checkpoint_serving_scrape(registry, tmp_path):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4))
+    net.initialize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = parallel.ShardedTrainer(net, lambda o, l: loss_fn(o, l),
+                                 mesh=None, on_nonfinite="skip")
+    x = nd.array(np.random.rand(8, 6).astype(np.float32))
+    y = nd.array(np.random.randint(0, 4, 8).astype(np.float32))
+    for _ in range(2):
+        tr.step([x], y)
+    assert tel.TRAIN_STEPS.value(loop="sharded") == 2
+    assert tel.TRAIN_STEP_SECONDS.count(loop="sharded") == 2
+    assert tel.TRAIN_SAMPLES_PER_SEC.value() > 0
+    assert np.isfinite(tel.TRAIN_LOSS.value())
+    # the one-time XLA cost attribution fed both the gauge and the
+    # profiler cost table
+    assert tel.TRAIN_STEP_FLOPS.value() > 0
+    assert "ShardedTrainer.step" in profiler._xla_costs
+
+    # a poisoned batch under "skip": counted, loss gauge shows the NaN
+    x_bad = nd.array(np.full((8, 6), np.nan, np.float32))
+    tr.step([x_bad], y)
+    assert tr.skipped_steps == 1
+    assert tel.TRAIN_SKIPPED_STEPS.value(loop="sharded") == 1
+
+    m = mx.CheckpointManager(str(tmp_path), async_save=False)
+    tr.save_checkpoint(m)
+    assert tel.CHECKPOINT_SAVE_SECONDS.count(mode="sync") == 1
+    assert m.load() is not None
+    assert tel.CHECKPOINT_LOAD_SECONDS.count() == 1
+
+    pred, _ = Predictor.from_block(net, x, chain=2)
+    batches = [np.random.rand(8, 6).astype(np.float32) for _ in range(3)]
+    assert len(list(pred.predict(batches))) == 3
+    assert tel.SERVING_REQUESTS.value() == 3
+    assert tel.SERVING_REQUEST_SECONDS.count() == 3
+    assert tel.SERVING_BATCH_SIZE.count() == 3
+    assert tel.SERVING_IN_FLIGHT.value() == 0
+
+    # the acceptance scrape: step-time histogram, skipped-step counter,
+    # checkpoint save latency, compile cache hit/miss counters
+    text = tel.scrape()
+    for needle in (
+            'mxnet_tpu_train_step_seconds_bucket{loop="sharded"',
+            'mxnet_tpu_train_skipped_steps_total{loop="sharded"} 1',
+            'mxnet_tpu_checkpoint_save_seconds_count{mode="sync"} 1',
+            "mxnet_tpu_compile_cache_hits_total",
+            "mxnet_tpu_compile_cache_misses_total",
+            "mxnet_tpu_compiles_total"):
+        assert needle in text, needle
+
+
+def test_serving_contract_error_counted_and_in_flight_released(registry):
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(2))
+    net.initialize()
+    x = np.random.rand(4, 3).astype(np.float32)
+    pred, _ = Predictor.from_block(net, nd.array(x), chain=2)
+    # a good upload followed by a contract violation: the stream dies
+    # before the good batch drains — the gauge must not leak it
+    with pytest.raises(TypeError):
+        list(pred.predict([x, x.astype(np.float64)]))
+    assert tel.SERVING_ERRORS.value(kind="contract") == 1
+    assert tel.SERVING_IN_FLIGHT.value() == 0
+    # abandoned stream (consumer stops early): same guarantee
+    gen = pred.predict([x, x, x, x])
+    next(gen)
+    gen.close()
+    assert tel.SERVING_IN_FLIGHT.value() == 0
+
+
+# ---------------------------------------------------------------------------
+# profiler satellites
+# ---------------------------------------------------------------------------
+
+def test_profiler_event_cap_evicts_oldest_and_counts_drops(
+        registry, monkeypatch):
+    monkeypatch.setattr(profiler, "_events",
+                        collections.deque(maxlen=4))
+    monkeypatch.setattr(profiler, "_dropped_events", 0)
+    saved_stats = dict(profiler._op_stats)
+    try:
+        for i in range(6):
+            profiler.record_op_time("evict_t%d" % i, 0.001)
+        assert [e[0] for e in profiler._events] == \
+            ["evict_t2", "evict_t3", "evict_t4", "evict_t5"]
+        assert profiler._dropped_events == 2
+        assert tel.PROFILER_EVENTS_DROPPED.value() == 2
+    finally:
+        profiler._op_stats.clear()
+        profiler._op_stats.update(saved_stats)
+
+
+def test_profiler_dumps_guards_zero_count_rows():
+    profiler._op_stats["zero_count_placeholder"] = [0.0, 0, float("inf"),
+                                                    0.0]
+    try:
+        out = profiler.dumps()
+        assert "zero_count_placeholder" in out
+    finally:
+        del profiler._op_stats["zero_count_placeholder"]
+
+
+# ---------------------------------------------------------------------------
+# dump + CLI + reporter/heartbeat
+# ---------------------------------------------------------------------------
+
+def _cli():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "telemetry_dump.py")
+    spec = importlib.util.spec_from_file_location("telemetry_dump", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_dump_json_and_cli_diff(registry, tmp_path, capsys):
+    a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    tel.TRAIN_STEPS.inc(loop="sharded")
+    tel.dump(a)
+    tel.TRAIN_STEPS.inc(loop="sharded")
+    tel.TRAIN_STEP_SECONDS.observe(0.25, loop="sharded")
+    tel.dump(b)
+    # strict RFC-8259 JSON: the +Inf bucket bound and any NaN gauge must
+    # ship as strings, never as the bare Infinity/NaN tokens only
+    # Python's lenient parser accepts
+    def _reject(tok):
+        raise AssertionError("non-portable JSON constant %r" % tok)
+
+    payload = json.loads(open(a).read(), parse_constant=_reject)
+    assert payload["format_version"] == 1
+    assert payload["metrics"]["mxnet_tpu_train_steps_total"]["type"] == \
+        "counter"
+    hist = payload["metrics"]["mxnet_tpu_compile_seconds"]  # eager series
+    assert hist["series"][0]["buckets"][-1][0] == "Infinity"
+    cli = _cli()
+    assert cli.main([a, "--top", "5"]) == 0
+    shown = capsys.readouterr().out
+    assert "mxnet_tpu_train_steps_total{loop=sharded}" in shown
+    assert cli.main(["--diff", a, b]) == 0
+    diffed = capsys.readouterr().out
+    assert "1 -> 2 (+1)" in diffed
+    assert "count +1" in diffed
+
+
+def test_reporter_and_heartbeat(registry, tmp_path):
+    tel.TRAIN_STEPS.inc(loop="sharded")
+    tel.TRAIN_STEP_SECONDS.observe(0.2, loop="sharded")
+    tel.TRAIN_LOSS.set(1.5)
+    hb = monitor.TelemetryHeartbeat()
+    line = hb.line()
+    assert "step 1" in line and "loss 1.5000" in line and "p50" in line
+    snap_path = str(tmp_path / "snap.json")
+    ticks = []
+    rep = tel.TelemetryReporter(interval=0.02, path=snap_path,
+                                callback=ticks.append)
+    with rep:
+        time.sleep(0.07)
+    assert os.path.exists(snap_path)
+    assert ticks and "mxnet_tpu_train_steps_total" in ticks[-1]
+    with pytest.raises(ValueError):
+        tel.TelemetryReporter(interval=0)

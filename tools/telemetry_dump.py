@@ -1,0 +1,170 @@
+"""Pretty-print (and diff) mxnet_tpu telemetry JSON snapshots.
+
+Reads the artifact written by ``mxnet_tpu.telemetry.dump(path)`` (or by
+a running ``TelemetryReporter``'s ``path=``) and renders the top-N
+series as a table: counters/gauges by value, histograms as
+count/sum/mean/p50/p99.
+
+    python tools/telemetry_dump.py snap.json [--top 20]
+    python tools/telemetry_dump.py --diff before.json after.json
+
+``--diff`` aligns series by (metric, labels) and prints deltas —
+the before/after view for bench runs (counter/histogram deltas are the
+work done between the snapshots; gauges show old -> new).
+"""
+import argparse
+import json
+import sys
+
+_INF = float("inf")
+
+
+def _load(path):
+    with open(path) as f:
+        data = json.load(f)
+    if "metrics" not in data:
+        raise SystemExit("%s: not a telemetry dump (no 'metrics' key)"
+                         % path)
+    return data
+
+
+def _series_key(name, labels):
+    return name + "".join(
+        "{%s=%s}" % kv for kv in sorted(labels.items()))
+
+
+def _num(v):
+    """Undo the dump's RFC-8259-safe encoding: non-finite values ship
+    as strings ("NaN"/"Infinity"/"-Infinity"), which float() parses."""
+    return float(v) if isinstance(v, str) else v
+
+
+def _quantile(buckets, q):
+    """Bucket-interpolated quantile from cumulative [(le, count)]."""
+    if not buckets:
+        return None
+    total = buckets[-1][1]
+    if total == 0:
+        return None
+    rank = q * total
+    prev_ub, prev_c = 0.0, 0
+    for ub, c in buckets:
+        ub = float(_num(ub))
+        if c >= rank:
+            if ub == _INF:
+                return prev_ub
+            if c == prev_c:
+                return ub
+            return prev_ub + (ub - prev_ub) * (rank - prev_c) / (c - prev_c)
+        prev_ub, prev_c = ub, c
+    return prev_ub
+
+
+def _flatten(data):
+    """dump payload -> {series_key: ("scalar", value) | ("hist", s)}."""
+    out = {}
+    for name, m in sorted(data["metrics"].items()):
+        for s in m["series"]:
+            key = _series_key(name, s.get("labels", {}))
+            if m["type"] == "histogram":
+                out[key] = ("hist", s)
+            else:
+                out[key] = ("scalar", _num(s.get("value", 0.0)))
+    return out
+
+
+def _fmt_num(v):
+    if v is None:
+        return "-"
+    if isinstance(v, float) and (v != v or v in (_INF, -_INF)):
+        return str(v)
+    if isinstance(v, float) and v != int(v):
+        return "%.6g" % v
+    return "%d" % int(v)
+
+
+def _hist_cells(s):
+    n = s.get("count", 0)
+    tot = s.get("sum", 0.0)
+    mean = tot / n if n else None
+    return (n, tot, mean, _quantile(s.get("buckets", []), 0.5),
+            _quantile(s.get("buckets", []), 0.99))
+
+
+def cmd_show(paths, top):
+    for path in paths:
+        data = _load(path)
+        print("== %s (t=%s) ==" % (path, data.get("time")))
+        flat = _flatten(data)
+        scalars = [(k, v) for k, (kind, v) in flat.items()
+                   if kind == "scalar"]
+        hists = [(k, s) for k, (kind, s) in flat.items() if kind == "hist"]
+        scalars.sort(key=lambda kv: -abs(kv[1]))
+        print("%-64s %14s" % ("series", "value"))
+        for k, v in scalars[:top]:
+            print("%-64s %14s" % (k, _fmt_num(v)))
+        if hists:
+            print()
+            print("%-52s %8s %10s %10s %10s %10s" % (
+                "histogram", "count", "sum", "mean", "p50", "p99"))
+            hists.sort(key=lambda kv: -kv[1].get("count", 0))
+            for k, s in hists[:top]:
+                n, tot, mean, p50, p99 = _hist_cells(s)
+                print("%-52s %8d %10s %10s %10s %10s" % (
+                    k, n, "%.4g" % tot, _fmt_num(mean), _fmt_num(p50),
+                    _fmt_num(p99)))
+        print()
+
+
+def cmd_diff(path_a, path_b, top):
+    a, b = _flatten(_load(path_a)), _flatten(_load(path_b))
+    rows = []
+    for key in sorted(set(a) | set(b)):
+        kind_a, va = a.get(key, (None, None))
+        kind_b, vb = b.get(key, (None, None))
+        kind = kind_b or kind_a
+        if kind == "hist":
+            na = va.get("count", 0) if va else 0
+            nb = vb.get("count", 0) if vb else 0
+            sa = va.get("sum", 0.0) if va else 0.0
+            sb = vb.get("sum", 0.0) if vb else 0.0
+            dn, ds = nb - na, sb - sa
+            if dn or ds:
+                rows.append((abs(dn), "%-56s count %+d  sum %+.4g  "
+                             "mean/new %s" % (key, dn, ds,
+                                              _fmt_num(ds / dn)
+                                              if dn else "-")))
+        else:
+            va = va or 0.0
+            vb = vb or 0.0
+            if va != vb:
+                rows.append((abs(vb - va), "%-56s %s -> %s (%+.6g)"
+                             % (key, _fmt_num(va), _fmt_num(vb), vb - va)))
+    rows.sort(key=lambda r: -r[0])
+    print("diff %s -> %s (%d changed series)" % (path_a, path_b, len(rows)))
+    for _, line in rows[:top]:
+        print(line)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Pretty-print/diff mxnet_tpu telemetry dumps")
+    p.add_argument("paths", nargs="*", help="telemetry dump JSON file(s)")
+    p.add_argument("--top", type=int, default=20,
+                   help="series per section (default 20)")
+    p.add_argument("--diff", nargs=2, metavar=("A", "B"),
+                   help="diff two dumps instead of printing them")
+    args = p.parse_args(argv)
+    if args.diff:
+        if args.paths:
+            p.error("--diff takes exactly two files and no positionals")
+        cmd_diff(args.diff[0], args.diff[1], args.top)
+    elif args.paths:
+        cmd_show(args.paths, args.top)
+    else:
+        p.error("give dump file(s) or --diff A B")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
