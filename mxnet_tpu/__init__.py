@@ -80,6 +80,7 @@ from . import util  # noqa: F401
 
 from . import remat  # noqa: F401
 from . import telemetry  # noqa: F401  (MXNET_TELEMETRY enables at import)
+from . import tracing  # noqa: F401  (MXNET_TRACE / MXNET_FLIGHT_RECORDER)
 from . import checkpoint  # noqa: F401
 from .checkpoint import CheckpointManager  # noqa: F401
 

@@ -223,8 +223,23 @@ def check_finite(values, policy, what="loss", logger=None):
     if finite:
         return True
     msg = ("non-finite %s detected (policy=%s)" % (what, policy))
+    from . import tracing as _tracing
+
+    # black-box dump BEFORE the policy acts: the recorder wants the
+    # spans/telemetry/HBM state of the step that produced the NaN (and
+    # a no-op unless armed).  Under "raise" the dump happens inside the
+    # except block so the bundle's exception carries a real traceback,
+    # and the error object rides along marked as captured so the
+    # step/fit exception hooks do not file a second bundle.
     if policy == "raise":
-        raise NonfiniteError(msg)
+        try:
+            raise NonfiniteError(msg)
+        except NonfiniteError as err:
+            _tracing.record_crash("nonfinite", err,
+                                  extra={"what": what, "policy": policy})
+            raise
+    _tracing.record_crash("nonfinite",
+                          extra={"what": what, "policy": policy})
     if policy == "skip":
         (logger or logging).warning("%s: discarding this update, keeping "
                                     "previous params/optimizer state", msg)
@@ -522,8 +537,13 @@ class CheckpointManager:
             with _telemetry.span("CheckpointManager.load",
                                  _telemetry.CHECKPOINT_LOAD_SECONDS):
                 return self._load_one(step, verify=verify)
-        except CheckpointCorruptError:
+        except CheckpointCorruptError as e:
             _telemetry.CHECKPOINT_DIGEST_FAILURES.inc()
+            from . import tracing as _tracing
+
+            _tracing.record_crash("digest_failure", e,
+                                  extra={"step": step,
+                                         "directory": self.directory})
             raise
 
     def load(self, step=None, verify=True, fallback=True):
@@ -588,6 +608,13 @@ class CheckpointManager:
                 # via self.preempted and older checkpoints remain intact
                 self.logger.exception("preemption flush failed")
             finally:
+                from . import tracing as _tracing
+
+                # the eviction black box: spans + stacks + HBM state at
+                # the moment the fleet pulled the plug (no-op when off;
+                # record_crash never raises into the handler)
+                _tracing.record_crash("preemption",
+                                      extra={"signal": int(signum)})
                 self.preempted = True
                 if exit_code is not None:
                     os._exit(exit_code)

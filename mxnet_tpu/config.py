@@ -92,6 +92,25 @@ FLAGS = {
                      "xla"),
         str, "honored",
         "directory backing the persistent compilation cache"),
+    "MXNET_TRACE": (
+        "0", _pbool, "honored",
+        "hierarchical span tracing (tracing.py): step/request/checkpoint "
+        "spans with trace/span/parent IDs into a bounded ring buffer, "
+        "exportable as one Chrome/Perfetto trace.json; off = one branch "
+        "per call site"),
+    "MXNET_TRACE_BUFFER": (
+        "4096", _pint, "honored",
+        "span ring-buffer capacity (oldest spans evicted first; "
+        "evictions counted in mxnet_tpu_trace_spans_dropped_total)"),
+    "MXNET_FLIGHT_RECORDER": (
+        "0", _pbool, "honored",
+        "black-box postmortem bundles (trace + telemetry + thread stacks "
+        "+ env/backend info) on non-finite guard trips, checkpoint "
+        "digest failures, SIGTERM/SIGINT preemption, and unhandled "
+        "step/fit/predict exceptions (tracing.record_crash)"),
+    "MXNET_FLIGHT_RECORDER_DIR": (
+        "", str, "honored",
+        "flight-recorder bundle directory ('' = ./flight_recorder)"),
     "MXNET_TELEMETRY": (
         "0", _pbool, "honored",
         "runtime metrics registry (telemetry.py): step/serving/"
@@ -197,6 +216,29 @@ def enable_telemetry(on=True):
         telemetry.enable()
     else:
         telemetry.disable()
+
+
+def enable_tracing(on=True):
+    """Toggle hierarchical span tracing (same switch as ``MXNET_TRACE``,
+    callable after import)."""
+    from . import tracing
+
+    if on:
+        tracing.enable()
+    else:
+        tracing.disable()
+
+
+def enable_flight_recorder(on=True, directory=None):
+    """Toggle the crash flight recorder (same switch as
+    ``MXNET_FLIGHT_RECORDER``; ``directory`` overrides
+    ``MXNET_FLIGHT_RECORDER_DIR``)."""
+    from . import tracing
+
+    if on:
+        tracing.enable_flight_recorder(directory)
+    else:
+        tracing.disable_flight_recorder()
 
 
 def enable_compile_cache(cache_dir=None, min_compile_time_secs=None):

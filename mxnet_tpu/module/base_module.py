@@ -18,6 +18,7 @@ import time
 from .. import metric as _metric
 from .. import ndarray
 from .. import telemetry as _telemetry
+from .. import tracing as _tracing
 from ..context import cpu
 
 __all__ = ["BaseModule", "_check_input_names", "_as_list"]
@@ -194,12 +195,16 @@ class BaseModule:
             return final_pairs
         nbatch = 0
         tel = _telemetry.enabled()
+        tr_on = _tracing.enabled()
         while batch is not None:
             if checkpoint_manager is not None and \
                     checkpoint_manager.preempted:
                 self.logger.warning("Epoch[%d] preempted at batch %d; "
                                     "leaving epoch loop", epoch, nbatch)
                 break
+            sp = _tracing.begin("Module.fit.batch",
+                                args={"epoch": epoch, "batch": nbatch}) \
+                if tr_on else None
             t_batch0 = time.perf_counter() if tel else None
             if monitor is not None:
                 monitor.tic()
@@ -229,6 +234,8 @@ class BaseModule:
             _fire(batch_end_callback,
                   BatchEndParam(epoch=epoch, nbatch=nbatch,
                                 eval_metric=eval_metric, locals=locals()))
+            if sp is not None:
+                sp.end()
             if tel:
                 dt = time.perf_counter() - t_batch0
                 _telemetry.TRAIN_STEP_SECONDS.observe(dt, loop="module")
@@ -334,6 +341,7 @@ class BaseModule:
         self._fit_current_epoch = begin_epoch
         if checkpoint_manager is not None:
             checkpoint_manager.install_preemption_handler(_ckpt_state)
+        outer_span = _tracing.current_span()
         try:
             for epoch in range(begin_epoch, num_epoch):
                 self._fit_current_epoch = epoch
@@ -341,6 +349,9 @@ class BaseModule:
                         checkpoint_manager.preempted:
                     break
                 start = time.time()
+                sp = _tracing.begin("Module.fit.epoch",
+                                    args={"epoch": epoch}) \
+                    if _tracing.enabled() else None
                 eval_metric.reset()
                 train_pairs = self._fit_epoch(
                     train_data, epoch, eval_metric, batch_end_callback,
@@ -379,6 +390,19 @@ class BaseModule:
                         self.logger.info("Epoch[%d] Validation-%s=%f",
                                          epoch, name, val)
                 train_data.reset()
+                if sp is not None:
+                    sp.end()
+        except Exception as e:
+            # postmortem bundle for a crashed fit (no-op unless the
+            # flight recorder is armed), taken BEFORE the unwind so the
+            # epoch/batch spans of the failing step are still open in it
+            _tracing.record_crash("exception-fit", e,
+                                  extra={"layer": "Module.fit"})
+            # then close the orphaned epoch/batch spans: a dead span
+            # left as the contextvar parent would corrupt the parentage
+            # of every span recorded after a caught-and-retried fit
+            _tracing.unwind_to(outer_span)
+            raise
         finally:
             if checkpoint_manager is not None:
                 checkpoint_manager.wait()

@@ -25,6 +25,7 @@ from ..ndarray.ndarray import NDArray
 from .. import autograd
 from .. import random as _random
 from .. import telemetry as _telemetry
+from .. import tracing as _tracing
 from ..gluon import block as _block_mod
 
 __all__ = ["ShardedTrainer", "sgd_init", "adam_init"]
@@ -389,6 +390,28 @@ class ShardedTrainer:
             self._lazy_init(example_inputs=raw_in)
         if self._step_fn is None:
             self._build(len(raw_in))
+        sp = _tracing.begin("ShardedTrainer.step",
+                            args={"step": self.global_step + 1}) \
+            if _tracing.enabled() else None
+        try:
+            return self._step_inner(raw_in, raw_label)
+        except Exception as e:
+            if sp is not None:
+                sp.end(error=True)
+                sp = None
+            # black-box bundle for the crashing step (no-op unless the
+            # flight recorder is armed; the span above is already closed
+            # with status=error so the bundle shows it).  The reason is
+            # layer-qualified: the per-reason rate limiter must not let
+            # a trainer crash suppress an unrelated serving/fit bundle.
+            _tracing.record_crash("exception-step", e,
+                                  extra={"layer": "ShardedTrainer.step"})
+            raise
+        finally:
+            if sp is not None:
+                sp.end()
+
+    def _step_inner(self, raw_in, raw_label):
         rng = _random.next_key()
         from .. import profiler as _profiler
 
@@ -463,6 +486,10 @@ class ShardedTrainer:
                 peak = _telemetry.peak_flops()
                 if peak and dt > 0:
                     _telemetry.TRAIN_MFU.set(self._step_flops / dt / peak)
+        if tel or _tracing.enabled():
+            # per-step HBM watermark sample: live/peak gauges per device
+            # plus a counter track in the exported chrome trace
+            _tracing.sample_device_memory()
         m = self._ckpt_manager
         if m is not None and self._ckpt_period and not m.preempted and \
                 next_step % self._ckpt_period == 0:

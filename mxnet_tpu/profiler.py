@@ -92,22 +92,21 @@ def resume(profile_process="worker"):
 def dump(finished=True, profile_process="worker"):
     """Write the chrome://tracing JSON to the configured ``filename``
     (reference Profiler::DumpProfile, src/profiler/profiler.h:256) and
-    stop any running jax trace."""
+    stop any running jax trace.
+
+    The payload is the UNIFIED timeline (tracing.chrome_trace_payload):
+    this facade's op events plus any hierarchical spans and per-device
+    HBM counter samples from ``mxnet_tpu.tracing`` — one valid
+    chrome/Perfetto file however the data was collected."""
     import json
 
     if _state["running"] and finished:
         stop()
     path = _config.get("filename", "profile.json")
-    trace_events = []
-    for name, t0, dur in _events:
-        trace_events.append({"name": name, "ph": "X", "cat": "op",
-                             "ts": t0 * 1e6, "dur": dur * 1e6,
-                             "pid": 0, "tid": 0})
-    payload = {"traceEvents": trace_events,
-               "displayTimeUnit": "ms",
-               "otherData": {"xla_costs": _xla_costs,
-                             "dropped_events": _dropped_events,
-                             "device_memory": device_memory_stats()}}
+    from . import tracing as _tracing
+
+    payload = _tracing.chrome_trace_payload(include_profiler=True)
+    payload["otherData"]["xla_costs"] = _xla_costs
     from .checkpoint import atomic_write
 
     atomic_write(path, json.dumps(payload))
@@ -183,18 +182,38 @@ def record_xla_cost(name, analysis):
 
 def device_memory_stats():
     """Per-device HBM counters from the XLA allocator (reference
-    storage_profiler.h GpuDeviceStorageProfiler role)."""
-    import jax
+    storage_profiler.h GpuDeviceStorageProfiler role).
 
+    The schema is STABLE across backends: every local device gets an
+    entry with at least ``bytes_in_use`` and ``peak_bytes_in_use``
+    (zeros), plus an ``"unavailable"`` reason string on backends whose
+    allocator exposes no ``memory_stats()`` (CPU on most jax builds) —
+    dashboards and the flight recorder never have to special-case an
+    empty dict."""
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception:
+        return {}
     out = {}
-    for d in jax.local_devices():
+    for d in devices:
+        reason = None
         try:
             ms = d.memory_stats()
-        except Exception:
-            ms = None
-        if ms:
-            out[str(d)] = {k: int(v) for k, v in ms.items()
-                           if isinstance(v, (int, float))}
+            if not ms:
+                reason = ("memory_stats() returned %r on backend %r"
+                          % (ms, getattr(d, "platform", "?")))
+        except Exception as e:
+            ms, reason = None, ("memory_stats() unsupported on backend "
+                                "%r: %s" % (getattr(d, "platform", "?"), e))
+        entry = {k: int(v) for k, v in (ms or {}).items()
+                 if isinstance(v, (int, float))}
+        entry.setdefault("bytes_in_use", 0)
+        entry.setdefault("peak_bytes_in_use", 0)
+        if reason is not None:
+            entry["unavailable"] = reason
+        out[str(d)] = entry
     return out
 
 

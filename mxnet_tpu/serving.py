@@ -25,13 +25,17 @@ pipeline is compute-bound at the device-resident numbers.
 """
 from __future__ import annotations
 
+import logging
 import time as _time
 
 import numpy as np
 
 from . import telemetry as _telemetry
+from . import tracing as _tracing
 
 __all__ = ["Predictor", "uint8_normalizer"]
+
+_logger = logging.getLogger("mxnet_tpu.serving")
 
 
 def uint8_normalizer(mean=(123.68, 116.779, 103.939), std=(58.393, 57.12, 57.375),
@@ -164,7 +168,7 @@ class Predictor:
                    batch_dtype=np.dtype(x_nd.dtype))
         return pred, jnp.asarray(x_nd._data)
 
-    def _upload(self, b):
+    def _upload(self, b, request_id=None):
         """Async host->device transfer of one raw batch.
 
         Pads a ragged final batch up to the compiled batch size on the
@@ -172,14 +176,32 @@ class Predictor:
         is ever compiled; returns (device_array, valid_rows)."""
         try:
             return self._upload_impl(b)
-        except (TypeError, ValueError):
+        except (TypeError, ValueError) as e:
             # batch-contract violations (shape/dtype) — caller bug
-            _telemetry.SERVING_ERRORS.inc(kind="contract")
+            self._count_error("contract", request_id, e)
             raise
-        except Exception:
+        except Exception as e:
             # retry-exhausted host->device transfer and anything else
-            _telemetry.SERVING_ERRORS.inc(kind="transfer")
+            self._count_error("transfer", request_id, e)
             raise
+
+    # per-request error series are bounded: past this many distinct ids
+    # the overflow bucket absorbs the rest (a misbehaving client hammering
+    # the contract must not grow the registry without bound — the log
+    # line and the trace span still carry every individual id)
+    _MAX_ERROR_ID_SERIES = 128
+
+    @classmethod
+    def _count_error(cls, kind, request_id, exc):
+        """Failure bookkeeping with a greppable request id: the id is
+        the request's root span id when tracing is on, else minted here
+        (errors only — the happy path never pays for one)."""
+        rid = request_id or _tracing.new_request_id()
+        _telemetry.SERVING_ERRORS.inc(kind=kind)
+        label = rid if len(_telemetry.SERVING_REQUEST_ERRORS._series) \
+            < cls._MAX_ERROR_ID_SERIES else "overflow"
+        _telemetry.SERVING_REQUEST_ERRORS.inc(kind=kind, request_id=label)
+        _logger.error("serving request %s failed (%s): %s", rid, kind, exc)
 
     def _upload_impl(self, b):
         import jax
@@ -243,14 +265,17 @@ class Predictor:
         (async) as soon as it is pulled from ``batches``; chunks of
         ``chain`` device-resident batches run as single dispatches; while
         chunk i's outputs are fetched, chunk i+1 is already executing."""
-        chunk = []            # [(device_array, n_valid, t_submit)]
-        pending = None        # (stacked device outputs, [(n_valid, t)..])
+        chunk = []            # [(device_array, n_valid, t_submit, span)]
+        pending = None        # (stacked device outputs, [(n, t, span)..])
         tel = _telemetry.enabled()
+        tr_on = _tracing.enabled()
         outstanding = [0]     # uploads not yet drained (gauge bookkeeping)
+        live_spans = []       # request spans not yet closed (bounded by
+                              # ~2 chunks; drained entries are removed)
 
         def dispatch(items):
-            arrs = [a for a, _n, _t in items]
-            valid = [(n, t) for _a, n, t in items]
+            arrs = [a for a, _n, _t, _s in items]
+            valid = [(n, t, s) for _a, n, t, s in items]
             if len(arrs) == 1 and self._chain == 1:
                 out = self._jit_one(arrs[0], self._params)
                 return out[None], valid
@@ -266,25 +291,43 @@ class Predictor:
             # would pay a tunnel round-trip per batch
             host = np.asarray(out)
             bs = self._batch_shape[0]
-            for i, (n, t0) in enumerate(valid):
+            for i, (n, t0, sp) in enumerate(valid):
                 if t0 is not None:
                     # latency = upload submission -> output on host
                     _telemetry.SERVING_REQUEST_SECONDS.observe(
                         _time.perf_counter() - t0)
                     _telemetry.SERVING_IN_FLIGHT.dec()
                     outstanding[0] -= 1
+                if sp is not None:
+                    sp.set(rows=n).end()
+                    live_spans.remove(sp)
                 yield host[i] if n == bs else host[i, :n]
 
         try:
             for b in batches:
                 t0 = _time.perf_counter() if tel else None
-                arr, n_valid = self._upload(b)
+                # one root span per request; its span_id IS the
+                # request_id the error paths log and label.  Requests
+                # overlap in flight, so the span is detached
+                # (activate=False) rather than a contextvar parent.
+                sp = _tracing.begin("serving.request", activate=False) \
+                    if tr_on else None
+                if sp is not None:
+                    live_spans.append(sp)
+                try:
+                    arr, n_valid = self._upload(
+                        b, sp.span_id if sp is not None else None)
+                except BaseException:
+                    if sp is not None:
+                        sp.end(error=True)
+                        live_spans.remove(sp)
+                    raise
                 if tel:
                     _telemetry.SERVING_REQUESTS.inc()
                     _telemetry.SERVING_BATCH_SIZE.observe(n_valid)
                     _telemetry.SERVING_IN_FLIGHT.inc()
                     outstanding[0] += 1
-                chunk.append((arr, n_valid, t0))
+                chunk.append((arr, n_valid, t0, sp))
                 if len(chunk) == self._chain:
                     out_n = dispatch(chunk)
                     chunk = []
@@ -298,10 +341,20 @@ class Predictor:
                 pending = out_n
             if pending is not None:
                 yield from drain(pending)
+        except Exception as e:
+            # black-box bundle for a failed request stream (no-op
+            # unless the flight recorder is armed)
+            _tracing.record_crash("exception-serving", e,
+                                  extra={"layer": "serving.Predictor"})
+            raise
         finally:
             # a stream abandoned early (consumer break / GeneratorExit)
             # or killed by a contract error must not leave phantom
-            # requests on the in-flight gauge forever
+            # requests on the in-flight gauge forever — nor phantom open
+            # spans that would show up as stuck requests in every later
+            # postmortem
             if outstanding[0]:
                 _telemetry.SERVING_IN_FLIGHT.dec(outstanding[0])
                 outstanding[0] = 0
+            for sp in live_spans:
+                sp.end(error=True)

@@ -123,7 +123,10 @@ class _Metric:
         self.name = name
         self.help = help
         self.label_names = tuple(label_names)
-        self._lock = threading.Lock()
+        # RLock: the flight recorder snapshots the registry from signal
+        # handlers, which can interrupt the owning thread inside one of
+        # these locked regions — a plain Lock would self-deadlock there
+        self._lock = threading.RLock()
         self._series = {}
         if not self.label_names:
             self._series[()] = self._new_series()
@@ -296,7 +299,7 @@ class Registry:
     """Named-metric store; ``REGISTRY`` below is the process-wide one."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()  # signal-handler safe (see _Metric)
         self._metrics = {}
 
     def _register(self, cls, name, help, label_names, **kw):
@@ -442,40 +445,19 @@ def reset():
 # span events
 # ---------------------------------------------------------------------------
 
-class span:
+def span(name, hist=None, **labels):
     """Timed scope: observes its duration into ``hist`` (when telemetry
-    is on) and into the profiler timeline/aggregate-stats table (when
-    ``profiler.set_config(aggregate_stats=True)`` is on) — one context
-    manager feeds both subsystems so dashboards and chrome-traces agree.
-    A scope that exits via an exception records NOTHING: latency series
-    describe completed operations (failures get their own counters).
-    """
+    is on), into the hierarchical trace ring buffer (``MXNET_TRACE=1``;
+    the labels double as span args), and into the profiler
+    timeline/aggregate-stats table (``aggregate_stats=True``) — one
+    context manager feeds all three so dashboards, traces, and
+    chrome-dumps agree.  Thin wrapper over :class:`tracing.span`, where
+    the semantics are documented (a scope that exits via an exception
+    observes nothing into ``hist``; the trace span IS recorded, with
+    ``status="error"``)."""
+    from . import tracing as _tracing
 
-    __slots__ = ("name", "hist", "labels", "_t0")
-
-    def __init__(self, name, hist=None, **labels):
-        self.name = name
-        self.hist = hist
-        self.labels = labels
-        self._t0 = None
-
-    def __enter__(self):
-        from . import profiler as _profiler
-
-        if _enabled or _profiler.aggregate_enabled():
-            self._t0 = time.perf_counter()
-        return self
-
-    def __exit__(self, exc_type, exc, tb):
-        if self._t0 is None or exc_type is not None:
-            return
-        dur = time.perf_counter() - self._t0
-        if _enabled and self.hist is not None:
-            self.hist.observe(dur, **self.labels)
-        from . import profiler as _profiler
-
-        if _profiler.aggregate_enabled():
-            _profiler.record_op_time(self.name, dur, self._t0)
+    return _tracing.span(name, hist=hist, **labels)
 
 
 # ---------------------------------------------------------------------------
@@ -556,10 +538,36 @@ SERVING_ERRORS = counter(
     "Predictor failures by kind (contract = shape/dtype violation, "
     "transfer = host->device upload).", ("kind",))
 
-# profiler facade
+SERVING_REQUEST_ERRORS = counter(
+    "mxnet_tpu_serving_request_errors_total",
+    "Predictor failures by kind AND request id (the greppable "
+    "per-request view; errors only, and past 128 distinct ids new "
+    "failures land in request_id=\"overflow\" so sustained failure "
+    "cannot grow the registry without bound).", ("kind", "request_id"))
+
+# device memory (sampled per train step by tracing.sample_device_memory)
+DEVICE_MEMORY_BYTES_IN_USE = gauge(
+    "mxnet_tpu_device_memory_bytes_in_use",
+    "Live HBM bytes per device at the last sample "
+    "(profiler.device_memory_stats; 0 when the backend reports none).",
+    ("device",))
+DEVICE_MEMORY_PEAK_BYTES = gauge(
+    "mxnet_tpu_device_memory_peak_bytes",
+    "Peak HBM bytes per device since process start at the last sample.",
+    ("device",))
+
+# profiler / tracing facade
 PROFILER_EVENTS_DROPPED = counter(
     "mxnet_tpu_profiler_events_dropped_total",
     "Timeline events evicted oldest-first at the profiler event cap.")
+TRACE_SPANS_DROPPED = counter(
+    "mxnet_tpu_trace_spans_dropped_total",
+    "Spans evicted oldest-first at the trace ring-buffer cap "
+    "(MXNET_TRACE_BUFFER).")
+FLIGHT_BUNDLES = counter(
+    "mxnet_tpu_flight_recorder_bundles_total",
+    "Flight-recorder postmortem bundles written, by trigger reason.",
+    ("reason",))
 
 
 # ---------------------------------------------------------------------------

@@ -145,12 +145,26 @@ def check_numeric_gradient(sym, location, aux_states=None, numeric_eps=1e-3,
     exe.backward()
     sym_grads = {k: grads[k].asnumpy() for k in grad_nodes}
 
+    # ONE reusable executor for every finite-difference evaluation:
+    # re-binding per eval re-traces and re-compiles the program each
+    # time, which made a 16-element FD sweep over a heavy op (ROIAlign)
+    # cost a minute of wall clock.  Shapes never change between evals,
+    # so one bind + per-eval arg rebind runs the already-jitted program.
+    eval_exe = sym.bind(
+        ctx=ctx,
+        args={k: array(v.astype(np.float32)) for k, v in location.items()},
+        grad_req="null", aux_states={k: v.copy() for k, v in aux.items()})
+    aux_host = {k: v.asnumpy() for k, v in aux.items()}
+
     def eval_at(loc):
-        vals = {k: array(v.astype(np.float32)) for k, v in loc.items()}
-        e = sym.bind(ctx=ctx, args=vals, grad_req="null",
-                     aux_states={k: v.copy() for k, v in aux.items()})
-        e.forward(is_train=use_forward_train)
-        return float(np.sum(e.outputs[0].asnumpy()))
+        # train-mode forwards mutate aux in place (moving stats):
+        # restore the originals so every eval sees identical state,
+        # exactly as the old fresh-bind-per-eval did
+        for k, v in aux_host.items():
+            eval_exe.aux_dict[k]._rebind(array(v)._data)
+        feed = {k: array(v.astype(np.float32)) for k, v in loc.items()}
+        eval_exe.forward(is_train=use_forward_train, **feed)
+        return float(np.sum(eval_exe.outputs[0].asnumpy()))
 
     for name in grad_nodes:
         base = location[name]

@@ -13,6 +13,9 @@ modes the checkpoint tests drive:
 * :func:`send_preemption` — deliver SIGTERM (or any signal) to a
   process after an optional delay, from a daemon thread — the simulated
   TPU-fleet eviction notice.
+* :func:`poison_batch` — inject NaN/Inf into a batch (the bad-record
+  data poisoning that trips the non-finite step guard and, when armed,
+  the tracing flight recorder).
 * :class:`FlakyCallable` — fails the first N calls then succeeds
   (drives the ``retry`` helper and download paths).
 """
@@ -24,7 +27,23 @@ import threading
 import time
 
 __all__ = ["FailingWriter", "failing_open", "truncate_file", "flip_bit",
-           "corrupt_file", "send_preemption", "FlakyCallable"]
+           "corrupt_file", "poison_batch", "send_preemption",
+           "FlakyCallable"]
+
+
+def poison_batch(arr, value=float("nan"), fraction=1.0):
+    """A float copy of ``arr`` with the first ``fraction`` of entries
+    replaced by ``value`` (NaN by default) — one poisoned record is all
+    the non-finite step guard needs to trip."""
+    import numpy as np
+
+    out = np.array(arr, copy=True)
+    if not np.issubdtype(out.dtype, np.floating):
+        out = out.astype(np.float32)
+    flat = out.reshape(-1)
+    n = max(1, int(round(float(fraction) * flat.size)))
+    flat[:n] = value
+    return out
 
 
 class FailingWriter:

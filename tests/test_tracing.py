@@ -1,0 +1,432 @@
+"""Span tracing + flight recorder (mxnet_tpu/tracing.py).
+
+Span hierarchy/IDs, ring-buffer eviction, the tier-1 chrome-trace
+invariant guard (nested + concurrent-thread spans), the stable
+device_memory_stats schema, the instrumented 3-step trainer trace with
+nested checkpoint spans and HBM counter samples, flight-recorder
+bundles for NaN / SIGTERM / digest-failure triggers, serving
+request-id error labeling, and the trace_view / telemetry_dump CLIs.
+Kept lean: ONE trainer compile and one predictor compile for the file
+(the suite runs ~860 s of an 870 s budget).
+"""
+import importlib.util
+import json
+import os
+import re
+import threading
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd, parallel, profiler, tracing
+from mxnet_tpu import telemetry as tel
+from mxnet_tpu.serving import Predictor
+from mxnet_tpu.testing import faults
+
+
+def _tool(name):
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", name + ".py")
+    spec = importlib.util.spec_from_file_location(name, path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture
+def traced():
+    """Span collection on with clean buffers; everything off after."""
+    tracing.reset()
+    tracing.enable()
+    yield tracing
+    tracing.reset()
+    tracing.disable()
+    tracing.disable_flight_recorder()
+
+
+@pytest.fixture(scope="module")
+def tiny_trainer():
+    """One compiled 2-step-capable trainer shared by the file (compile
+    once; every test that steps it reuses the same XLA program)."""
+    net = gluon.nn.HybridSequential()
+    net.add(gluon.nn.Dense(4))
+    net.initialize()
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    tr = parallel.ShardedTrainer(net, lambda o, l: loss_fn(o, l),
+                                 mesh=None, on_nonfinite="skip")
+    x = nd.array(np.random.rand(8, 6).astype(np.float32))
+    y = nd.array(np.random.randint(0, 4, 8).astype(np.float32))
+    tr.step([x], y)  # warm-up/compile outside any enabled-state test
+    return net, tr, x, y
+
+
+# ---------------------------------------------------------------------------
+# span semantics
+# ---------------------------------------------------------------------------
+
+def test_span_hierarchy_ids_and_disabled_noop(traced):
+    with tracing.span("root", shard=3):
+        assert tracing.current_span().name == "root"
+        with tracing.span("child"):
+            assert tracing.current_span().name == "child"
+        detached = tracing.begin("detached", activate=False)
+        assert tracing.current_span().name == "root"  # not a parent
+        detached.end()
+    assert tracing.current_span() is None
+    recs = {r["name"]: r for r in tracing._buffer}
+    assert recs["child"]["parent_id"] == recs["root"]["span_id"]
+    # detached spans still parent onto the enclosing context
+    assert recs["detached"]["parent_id"] == recs["root"]["span_id"]
+    assert recs["root"]["parent_id"] is None
+    assert recs["root"]["args"] == {"shard": 3}
+    assert len({r["span_id"] for r in recs.values()}) == 3
+    # error exits are recorded (unlike telemetry latency series)
+    with pytest.raises(RuntimeError):
+        with tracing.span("boom"):
+            raise RuntimeError("x")
+    assert [r for r in tracing._buffer if r["name"] == "boom"][0][
+        "status"] == "error"
+
+    tracing.disable()
+    with tel.span("off") as s:
+        assert s._t0 is None and s._span is None
+    assert not any(r["name"] == "off" for r in tracing._buffer)
+
+
+def test_unwind_to_closes_orphans_and_restores_parent(traced):
+    outer = tracing.begin("outer")
+    a = tracing.begin("loop.a")
+    tracing.begin("loop.b")
+    tracing.unwind_to(outer)     # the exception-path cleanup fit uses
+    assert tracing.current_span() is outer
+    assert a.status == "error"
+    outer.end()
+    assert tracing.current_span() is None
+    recs = {r["name"]: r["status"] for r in tracing._buffer}
+    assert recs == {"outer": "ok", "loop.a": "error", "loop.b": "error"}
+
+
+def test_ring_buffer_evicts_oldest_and_counts(traced):
+    orig = tracing._buffer.maxlen
+    tel.enable()
+    try:
+        tracing.enable(buffer_size=16)
+        for i in range(20):
+            with tracing.span("s%d" % i):
+                pass
+        names = [r["name"] for r in tracing._buffer]
+        assert names == ["s%d" % i for i in range(4, 20)]
+        assert tracing._dropped == 4
+        assert tel.TRACE_SPANS_DROPPED.value() >= 4
+    finally:
+        tel.disable()
+        tel.reset()
+        tracing.enable(buffer_size=orig)
+
+
+# ---------------------------------------------------------------------------
+# tier-1 guard: chrome-trace invariants (nested + concurrent threads)
+# ---------------------------------------------------------------------------
+
+def test_chrome_trace_invariants_nested_and_threads(traced, tmp_path,
+                                                    capsys):
+    barrier = threading.Barrier(3)  # truly-concurrent spans (and three
+                                    # distinct live tids — no id reuse)
+
+    def worker(i):
+        with tracing.span("thread.outer", worker=i):
+            barrier.wait()
+            with tracing.span("thread.inner"):
+                pass
+
+    with tracing.span("main.outer"):
+        threads = [threading.Thread(target=worker, args=(i,))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        with tracing.span("main.inner"):
+            pass
+    tracing.sample_device_memory()
+    path = str(tmp_path / "trace.json")
+    tracing.export_trace(path)
+
+    data = json.loads(open(path).read())
+    spans = [e for e in data["traceEvents"]
+             if e.get("ph") == "X" and e.get("cat") == "span"]
+    assert len(spans) == 8
+    # each worker thread rooted its own tree on its own tid
+    outers = [e for e in spans if e["name"] == "thread.outer"]
+    assert len({e["tid"] for e in outers}) == 3
+    inner_parents = {e["args"]["parent_id"] for e in spans
+                     if e["name"] == "thread.inner"}
+    assert inner_parents == {e["args"]["span_id"] for e in outers}
+    # the validating summarizer agrees: no invariant violations
+    tv = _tool("trace_view")
+    assert tv.validate(data) == []
+    assert tv.main([path, "--tree"]) == 0
+    out = capsys.readouterr().out
+    assert "main.outer" in out and "thread.inner" in out
+    # invariants, re-checked directly: monotonic ts, shared pid, unique
+    # span ids, memory counter events present
+    timed = [e for e in data["traceEvents"] if e.get("ph") != "M"]
+    ts = [e["ts"] for e in timed]
+    assert ts == sorted(ts)
+    assert {e["pid"] for e in timed} == {data["otherData"]["pid"]}
+    ids = [e["args"]["span_id"] for e in spans]
+    assert len(ids) == len(set(ids))
+    assert any(e.get("ph") == "C" for e in data["traceEvents"])
+    # a corrupted span id trips the validator
+    spans[0]["args"]["parent_id"] = "ffffffffffffffff"
+    assert any("parent" in p for p in tv.validate(data))
+
+
+# ---------------------------------------------------------------------------
+# satellite: stable device_memory_stats schema
+# ---------------------------------------------------------------------------
+
+def test_device_memory_stats_stable_schema():
+    import jax
+
+    stats = profiler.device_memory_stats()
+    assert set(stats) == {str(d) for d in jax.local_devices()}
+    for entry in stats.values():
+        assert isinstance(entry["bytes_in_use"], int)
+        assert isinstance(entry["peak_bytes_in_use"], int)
+        # a backend with no allocator stats reports zeros + a reason,
+        # never a missing entry
+        if entry["bytes_in_use"] == 0 and "unavailable" in entry:
+            assert isinstance(entry["unavailable"], str)
+
+
+# ---------------------------------------------------------------------------
+# instrumented trainer: nested step -> checkpoint spans + HBM samples
+# ---------------------------------------------------------------------------
+
+def test_trainer_trace_nested_checkpoint_and_memory(tiny_trainer, traced,
+                                                    tmp_path):
+    net, tr, x, y = tiny_trainer
+    tel.enable()
+    m = mx.CheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+    tr.attach_checkpoint_manager(m, period=1, auto_resume=False,
+                                 install_signal_handler=False)
+    try:
+        for _ in range(3):
+            tr.step([x], y)
+    finally:
+        tr._ckpt_manager = None
+        tr._ckpt_period = 0
+        tel.disable()
+        tel.reset()
+    path = str(tmp_path / "trace.json")
+    tracing.export_trace(path)
+    data = json.loads(open(path).read())
+    spans = [e for e in data["traceEvents"]
+             if e.get("ph") == "X" and e.get("cat") == "span"]
+    steps = [e for e in spans if e["name"] == "ShardedTrainer.step"]
+    saves = [e for e in spans if e["name"] == "CheckpointManager.save"]
+    assert len(steps) == 3 and len(saves) == 3
+    step_ids = {e["args"]["span_id"] for e in steps}
+    # the periodic sync save runs inside the step: parent resolves
+    assert all(e["args"]["parent_id"] in step_ids for e in saves)
+    assert all(e["args"]["status"] == "ok" for e in steps)
+    # per-device HBM counter track sampled each step
+    c_events = [e for e in data["traceEvents"] if e.get("ph") == "C"]
+    assert len(c_events) >= 3
+    assert {"bytes_in_use", "peak_bytes_in_use"} <= set(
+        c_events[0]["args"])
+
+
+# ---------------------------------------------------------------------------
+# flight recorder triggers
+# ---------------------------------------------------------------------------
+
+def test_nan_step_dumps_one_bundle(tiny_trainer, traced, tmp_path):
+    net, tr, x, y = tiny_trainer
+    fr = str(tmp_path / "fr")
+    tracing.enable_flight_recorder(fr)
+    x_bad = nd.array(faults.poison_batch(x.asnumpy()))
+    assert not np.isfinite(x_bad.asnumpy()).any()
+    tr.step([x_bad], y)          # non-finite guard (policy "skip") fires
+    tr.step([x_bad], y)          # rate limiter: still one bundle
+    dirs = tracing.bundles(fr)
+    assert len(dirs) == 1
+    b = dirs[0]
+    assert sorted(os.listdir(b)) == ["info.json", "stacks.txt",
+                                     "telemetry.json", "trace.json"]
+    info = json.loads(open(os.path.join(b, "info.json")).read())
+    assert info["reason"] == "nonfinite"
+    assert info["extra"]["policy"] == "skip"
+    assert info["trace_id"] == tracing.TRACE_ID
+    assert "MXNET_FLIGHT_RECORDER" in info["config"]
+    assert "MainThread" in open(os.path.join(b, "stacks.txt")).read()
+    json.loads(open(os.path.join(b, "trace.json")).read())
+    json.loads(open(os.path.join(b, "telemetry.json")).read())
+
+
+def test_sigterm_during_training_dumps_one_resolvable_bundle(
+        tiny_trainer, traced, tmp_path):
+    net, tr, x, y = tiny_trainer
+    fr = str(tmp_path / "fr")
+    tracing.enable_flight_recorder(fr)
+    m = mx.CheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+    tr.attach_checkpoint_manager(m, period=0, auto_resume=False)
+    try:
+        tr.step([x], y)
+        faults.send_preemption()         # SIGTERM, delivered inline
+        assert m.preempted
+    finally:
+        m.uninstall_preemption_handler()
+        tr._ckpt_manager = None
+    dirs = tracing.bundles(fr)
+    assert len(dirs) == 1, dirs
+    info = json.loads(open(os.path.join(dirs[0], "info.json")).read())
+    assert info["reason"] == "preemption"
+    # the final checkpoint flushed before the black box was written
+    assert m.latest_step() is not None
+    data = json.loads(open(os.path.join(dirs[0], "trace.json")).read())
+    spans = [e for e in data["traceEvents"]
+             if e.get("ph") == "X" and e.get("cat") == "span"]
+    assert spans
+    ids = {e["args"]["span_id"] for e in spans}
+    assert all(e["args"]["parent_id"] in ids for e in spans
+               if e["args"]["parent_id"] is not None)
+    tv = _tool("trace_view")
+    assert tv.validate(data) == []
+
+
+def test_digest_failure_dumps_bundle(traced, tmp_path):
+    fr = str(tmp_path / "fr")
+    tracing.enable_flight_recorder(fr)
+    m = mx.CheckpointManager(str(tmp_path / "ckpt"), async_save=False)
+    m.save(1, {"w": np.arange(4.0)}, block=True)
+    faults.flip_bit(m.data_path(1))
+    assert m.load() is None      # sole checkpoint corrupt -> fallback None
+    dirs = tracing.bundles(fr)
+    assert len(dirs) == 1
+    info = json.loads(open(os.path.join(dirs[0], "info.json")).read())
+    assert info["reason"] == "digest_failure"
+    assert "checkpoint step 1" in info["exception"]["message"]
+    assert info["exception"]["type"] == "CheckpointCorruptError"
+
+
+def test_bundle_dedupe_and_retry_after_failed_write(traced, tmp_path,
+                                                    monkeypatch):
+    fr = str(tmp_path / "fr")
+    tracing.enable_flight_recorder(fr)
+    # an exception already captured by an inner layer is not re-dumped
+    # by an outer hook under a different reason
+    e = RuntimeError("boom")
+    assert tracing.record_crash("inner", e) is not None
+    assert tracing.record_crash("outer", e) is None
+    assert len(tracing.bundles(fr)) == 1
+    # a failed write un-stamps the rate-limit window so the next
+    # trigger of the same reason retries instead of going silent
+    monkeypatch.setattr(tracing, "_write_bundle",
+                        lambda *a: (_ for _ in ()).throw(OSError("disk")))
+    assert tracing.record_crash("flaky") is None
+    monkeypatch.undo()
+    assert tracing.record_crash("flaky") is not None
+    assert len(tracing.bundles(fr)) == 2
+
+
+# ---------------------------------------------------------------------------
+# satellite: serving request ids on error paths
+# ---------------------------------------------------------------------------
+
+def test_serving_request_id_grepable_on_error(traced, caplog):
+    tel.enable()
+    tel.reset()
+    try:
+        net = gluon.nn.HybridSequential()
+        net.add(gluon.nn.Dense(2))
+        net.initialize()
+        x = np.random.rand(4, 3).astype(np.float32)
+        pred, _ = Predictor.from_block(net, nd.array(x), chain=2)
+        assert len(list(pred.predict([x]))) == 1   # happy path first
+        with caplog.at_level("ERROR", logger="mxnet_tpu.serving"):
+            with pytest.raises(TypeError):
+                list(pred.predict([x, x.astype(np.float64)]))
+        # the aggregate counter is unchanged in shape; the per-request
+        # counter carries the greppable id, which also appears in the log
+        assert tel.SERVING_ERRORS.value(kind="contract") == 1
+        series = tel.SERVING_REQUEST_ERRORS.series_labels()
+        assert len(series) == 1 and series[0]["kind"] == "contract"
+        rid = series[0]["request_id"]
+        assert re.fullmatch(r"[0-9a-f]{16}", rid)
+        assert any(rid in r.getMessage() for r in caplog.records)
+        # the id IS the failing request's root span id, status=error
+        # (the already-uploaded batch the dead stream abandoned is
+        # closed as error too by the generator cleanup)
+        err_spans = [r for r in tracing._buffer
+                     if r["name"] == "serving.request"
+                     and r["status"] == "error"]
+        assert rid in [r["span_id"] for r in err_spans]
+        # happy-path requests get spans too (first batch drained ok)
+        assert any(r["name"] == "serving.request" and r["status"] == "ok"
+                   for r in tracing._buffer)
+        # an abandoned stream must not leak open request spans into
+        # every later postmortem
+        gen = pred.predict([x, x, x, x])
+        next(gen)
+        gen.close()
+        assert not any(s.name == "serving.request"
+                       for s in tracing._active.values())
+    finally:
+        tel.disable()
+        tel.reset()
+
+
+# ---------------------------------------------------------------------------
+# satellites: telemetry_dump --diff robustness, unified profiler.dump
+# ---------------------------------------------------------------------------
+
+def test_telemetry_dump_diff_new_gone_and_malformed(tmp_path, capsys):
+    cli = _tool("telemetry_dump")
+
+    def snap(metrics):
+        return {"format_version": 1, "time": 0.0, "metrics": metrics}
+
+    scalar = {"type": "gauge", "help": "h", "label_names": [],
+              "series": [{"labels": {}, "value": 2.0}]}
+    hist = {"type": "histogram", "help": "h", "label_names": [],
+            "series": [{"labels": {}, "buckets": [["Infinity", 3]],
+                        "sum": 0.5, "count": 3}]}
+    a, b = str(tmp_path / "a.json"), str(tmp_path / "b.json")
+    with open(a, "w") as f:
+        json.dump(snap({"mxnet_tpu_gone_metric": scalar}), f)
+    with open(b, "w") as f:
+        json.dump(snap({"mxnet_tpu_new_seconds": hist}), f)
+    assert cli.main(["--diff", a, b]) == 0
+    out = capsys.readouterr().out
+    assert "mxnet_tpu_gone_metric" in out and "gone (2)" in out
+    assert "mxnet_tpu_new_seconds" in out and "new (count 3" in out
+
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as f:
+        f.write('{"metrics": {tru')
+    with pytest.raises(SystemExit) as ei:
+        cli.main(["--diff", a, bad])
+    assert "malformed JSON" in str(ei.value)
+    with pytest.raises(SystemExit) as ei:
+        cli.main([bad])
+    assert "malformed JSON" in str(ei.value)
+
+
+def test_profiler_dump_is_unified_trace(traced, tmp_path):
+    profiler.record_op_time("unified_op", 0.001)
+    with tracing.span("unified_span"):
+        pass
+    path = str(tmp_path / "profile.json")
+    profiler.set_config(filename=path)
+    try:
+        assert profiler.dump() == path
+    finally:
+        profiler.set_config(filename="profile.json")
+    data = json.loads(open(path).read())
+    cats = {e.get("cat") for e in data["traceEvents"]}
+    assert {"op", "span"} <= cats
+    assert "xla_costs" in data["otherData"]
+    assert _tool("trace_view").validate(data) == []

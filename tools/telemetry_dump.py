@@ -20,9 +20,17 @@ _INF = float("inf")
 
 
 def _load(path):
-    with open(path) as f:
-        data = json.load(f)
-    if "metrics" not in data:
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except OSError as e:
+        raise SystemExit("%s: cannot read (%s)" % (path, e))
+    except ValueError as e:
+        # truncated/garbage file (e.g. a dump interrupted before the
+        # atomic-writer landed): a clear message + nonzero exit, not a
+        # json traceback
+        raise SystemExit("%s: malformed JSON (%s)" % (path, e))
+    if not isinstance(data, dict) or "metrics" not in data:
         raise SystemExit("%s: not a telemetry dump (no 'metrics' key)"
                          % path)
     return data
@@ -117,12 +125,28 @@ def cmd_show(paths, top):
 
 
 def cmd_diff(path_a, path_b, top):
-    a, b = _flatten(_load(path_a)), _flatten(_load(path_b))
+    data_a, data_b = _load(path_a), _load(path_b)
+    a, b = _flatten(data_a), _flatten(data_b)
+    fams_a, fams_b = set(data_a["metrics"]), set(data_b["metrics"])
     rows = []
     for key in sorted(set(a) | set(b)):
         kind_a, va = a.get(key, (None, None))
         kind_b, vb = b.get(key, (None, None))
         kind = kind_b or kind_a
+        # a metric family present in only one snapshot (registered by a
+        # different code version, or renamed between runs): flag it as
+        # new/gone instead of diffing against a silent zero.  A label
+        # SERIES missing on one side within a shared family still diffs
+        # from zero (a counter's first increment is real work done).
+        family = key.split("{", 1)[0]
+        if family not in fams_a or family not in fams_b:
+            tag = "new" if family not in fams_a else "gone"
+            s = vb if va is None else va
+            val = "count %d sum %.4g" % (s.get("count", 0),
+                                         s.get("sum", 0.0)) \
+                if kind == "hist" else _fmt_num(s)
+            rows.append((_INF, "%-56s %s (%s)" % (key, tag, val)))
+            continue
         if kind == "hist":
             na = va.get("count", 0) if va else 0
             nb = vb.get("count", 0) if vb else 0

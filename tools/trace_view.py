@@ -1,0 +1,176 @@
+"""Summarize (and sanity-check) mxnet_tpu Chrome-trace exports.
+
+Reads the artifact written by ``mxnet_tpu.tracing.export_trace(path)``,
+``mx.profiler.dump()``, or a flight-recorder bundle directory (the
+bundle's ``trace.json`` is used), validates the Chrome-trace invariants
+the tier-1 guard enforces (valid JSON, unique span IDs, resolvable
+parents, ts-sorted events), and prints:
+
+* per-span-name aggregates (count, total/mean/max ms, errors),
+* per-device HBM watermarks from the counter track,
+* with ``--tree``, the span hierarchy of the slowest roots.
+
+    python tools/trace_view.py trace.json [--top 20] [--tree]
+    python tools/trace_view.py flight_recorder/flight-...-nonfinite-p1-1
+
+Exit status is nonzero on malformed input or violated invariants, so CI
+can gate on it.
+"""
+import argparse
+import json
+import os
+import sys
+
+
+def load_trace(path):
+    """Trace payload from a file or a flight-recorder bundle dir."""
+    if os.path.isdir(path):
+        path = os.path.join(path, "trace.json")
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except OSError as e:
+        raise SystemExit("%s: cannot read (%s)" % (path, e))
+    except ValueError as e:
+        raise SystemExit("%s: malformed JSON (%s)" % (path, e))
+    if not isinstance(data, dict) or "traceEvents" not in data:
+        raise SystemExit("%s: not a chrome trace (no 'traceEvents')"
+                         % path)
+    return data
+
+
+def validate(data):
+    """Chrome-trace invariant check; returns a list of violations."""
+    problems = []
+    seen_ids = set()
+    last_ts = None
+    for ev in data["traceEvents"]:
+        ph = ev.get("ph")
+        if ph == "M":
+            continue  # metadata events carry no timestamp
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)):
+            problems.append("event %r has no numeric ts" % (ev.get("name"),))
+            continue
+        if last_ts is not None and ts < last_ts:
+            problems.append("ts not monotonic at %r (%s < %s)"
+                            % (ev.get("name"), ts, last_ts))
+        last_ts = ts
+        if "pid" not in ev or "tid" not in ev:
+            problems.append("event %r missing pid/tid" % (ev.get("name"),))
+        if ph == "X" and ev.get("cat") == "span":
+            sid = ev.get("args", {}).get("span_id")
+            if sid is None:
+                problems.append("span %r has no span_id" % (ev.get("name"),))
+            elif sid in seen_ids:
+                problems.append("duplicate span_id %s" % sid)
+            else:
+                seen_ids.add(sid)
+    for ev in data["traceEvents"]:
+        if ev.get("ph") == "X" and ev.get("cat") == "span":
+            parent = ev.get("args", {}).get("parent_id")
+            if parent is not None and parent not in seen_ids:
+                problems.append("span %r parent %s not in trace"
+                                % (ev.get("name"), parent))
+    return problems
+
+
+def _spans(data):
+    return [ev for ev in data["traceEvents"]
+            if ev.get("ph") == "X" and ev.get("cat") == "span"]
+
+
+def summarize(data, top):
+    spans = _spans(data)
+    agg = {}  # name -> [count, total_us, max_us, errors]
+    for ev in spans:
+        st = agg.setdefault(ev["name"], [0, 0.0, 0.0, 0])
+        st[0] += 1
+        st[1] += ev.get("dur", 0.0)
+        st[2] = max(st[2], ev.get("dur", 0.0))
+        if ev.get("args", {}).get("status") == "error":
+            st[3] += 1
+    other = data.get("otherData", {})
+    print("trace_id %s  pid %s  events %d  spans %d (open %s, dropped %s)"
+          % (other.get("trace_id", "?"), other.get("pid", "?"),
+             len(data["traceEvents"]), len(spans),
+             other.get("open_spans", "?"), other.get("dropped_spans", "?")))
+    if agg:
+        print()
+        print("%-36s %7s %11s %11s %11s %6s" % (
+            "span", "count", "total(ms)", "mean(ms)", "max(ms)", "err"))
+        for name, (n, tot, mx, err) in sorted(
+                agg.items(), key=lambda kv: -kv[1][1])[:top]:
+            print("%-36s %7d %11.3f %11.3f %11.3f %6d" % (
+                name, n, tot / 1e3, tot / n / 1e3, mx / 1e3, err))
+    mem = {}  # device -> (max in_use, max peak)
+    for ev in data["traceEvents"]:
+        if ev.get("ph") == "C":
+            args = ev.get("args", {})
+            dev = ev.get("name", "?")
+            prev = mem.get(dev, (0, 0))
+            mem[dev] = (max(prev[0], args.get("bytes_in_use", 0)),
+                        max(prev[1], args.get("peak_bytes_in_use", 0)))
+    if mem:
+        print()
+        print("%-44s %14s %14s" % ("memory counter", "max in_use",
+                                   "max peak"))
+        for dev, (in_use, peak) in sorted(mem.items()):
+            print("%-44s %14d %14d" % (dev, in_use, peak))
+
+
+def print_tree(data, top):
+    spans = _spans(data)
+    by_id = {ev["args"]["span_id"]: ev for ev in spans
+             if ev.get("args", {}).get("span_id")}
+    children = {}
+    roots = []
+    for ev in spans:
+        parent = ev.get("args", {}).get("parent_id")
+        if parent and parent in by_id:
+            children.setdefault(parent, []).append(ev)
+        else:
+            roots.append(ev)
+    roots.sort(key=lambda e: -e.get("dur", 0.0))
+
+    def walk(ev, depth):
+        flags = "".join(
+            [" !err" if ev["args"].get("status") == "error" else "",
+             " (open)" if ev["args"].get("incomplete") else ""])
+        print("%s%-*s %9.3f ms%s" % ("  " * depth, 40 - 2 * depth,
+                                     ev["name"],
+                                     ev.get("dur", 0.0) / 1e3, flags))
+        for c in sorted(children.get(ev["args"].get("span_id"), []),
+                        key=lambda e: e["ts"]):
+            walk(c, depth + 1)
+
+    print()
+    for ev in roots[:top]:
+        walk(ev, 0)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Summarize/validate mxnet_tpu chrome-trace exports")
+    p.add_argument("path", help="trace JSON file or flight-recorder "
+                                "bundle directory")
+    p.add_argument("--top", type=int, default=20,
+                   help="rows per section (default 20)")
+    p.add_argument("--tree", action="store_true",
+                   help="print the span hierarchy of the slowest roots")
+    args = p.parse_args(argv)
+    data = load_trace(args.path)
+    problems = validate(data)
+    summarize(data, args.top)
+    if args.tree:
+        print_tree(data, args.top)
+    if problems:
+        print()
+        for msg in problems:
+            print("INVARIANT VIOLATION: %s" % msg, file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
