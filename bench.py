@@ -82,11 +82,21 @@ def main():
     log("devices=%s batch=%d steps=%d" % (jax.devices(), batch, steps))
     log("model built + host-initialized; compiling train step")
 
-    # warmup/compile
+    # warmup/compile — timed per step so the ~97 s cold-start (the
+    # ROADMAP AOT-compile item) is a parsed per-run metric with a
+    # trajectory, not a stderr-only log line.  Step 0 carries the XLA
+    # compile (or the persistent-cache load); later warmup steps are
+    # steady-state and bound the residual trace/dispatch cost.
+    warmup_step_secs = []
+    t_w0 = time.perf_counter()
     for i in range(warmup):
+        t_s = time.perf_counter()
         loss = trainer.step([x], y)
         jax.block_until_ready(loss)
-        log("warmup step %d done (loss=%.4f)" % (i, float(loss)))
+        warmup_step_secs.append(round(time.perf_counter() - t_s, 3))
+        log("warmup step %d done (loss=%.4f, %.1fs)"
+            % (i, float(loss), warmup_step_secs[-1]))
+    warmup_secs = time.perf_counter() - t_w0
 
     t0 = time.perf_counter()
     for i in range(steps):
@@ -102,6 +112,8 @@ def main():
         "value": round(ips, 2),
         "unit": "images/sec",
         "vs_baseline": round(ips / baseline, 3),
+        "warmup_seconds": round(warmup_secs, 2),
+        "warmup_step_seconds": warmup_step_secs,
     }))
 
 

@@ -82,6 +82,17 @@ FLAGS = {
         "", str, "honored",
         "default activation-remat policy for Executor/CachedOp/"
         "ShardedTrainer ('' = off; see mxnet_tpu.remat.list_policies())"),
+    "MXNET_FUSION": (
+        "", str, "honored",
+        "default graph-fusion policy for Executor/CachedOp/Module/"
+        "ShardedTrainer: '' = identical-math patterns + cost-table "
+        "upgrades, 'off', 'all', or a pattern-name list "
+        "(mxnet_tpu.symbol.fusion.list_patterns())"),
+    "MXNET_FUSION_TUNE": (
+        "", str, "honored",
+        "path to the measured shape-keyed fusion cost table written by "
+        "tools/autotune.py ('' = no table: only default-on patterns "
+        "fire); override programmatically via config.fusion_cost_table"),
     "MXNET_COMPILE_CACHE": (
         "1", _pbool, "honored",
         "persistent XLA compilation cache: the second process-level run "
@@ -184,27 +195,63 @@ def describe():
     return "\n".join(rows)
 
 
-def compile_cache_safe():
+def _cache_deser_affected(version):
+    """Is ``version`` of jax affected by the multi-device CPU persistent-
+    cache mis-deserialization (repro in docs/perf_notes.md: cache-warm
+    8-virtual-device allreduce returns wrong loss)?  Observed on the
+    0.4.x line; treat everything below 0.5.0 as affected and newer
+    releases as fixed (the deserialization path was rewritten), so the
+    cache comes back exactly where it matters most as soon as the
+    installed jax moves off the buggy line.  Unparseable versions count
+    as affected — the failure mode of a wrong "safe" is silently wrong
+    training losses."""
+    try:
+        parts = tuple(int(x) for x in str(version).split(".")[:2])
+    except (TypeError, ValueError):
+        return True
+    return parts < (0, 5)
+
+
+def compile_cache_safe(jax_version=None):
     """Whether the persistent compile cache is safe to enable by default.
 
     jax 0.4.x deserializes MULTI-DEVICE CPU executables incorrectly
     (measured: a cache-warm 8-virtual-device allreduce step returns
     wrong loss values — examples/distributed_horovod_style.py fails its
-    equivalence check on the second run).  The forced-host-device-count
-    CPU mesh is a test harness, so the bootstrap skips the cache there;
-    real accelerators and plain single-device CPU keep it.  An explicit
-    ``enable_compile_cache()`` call still works everywhere.
-    """
+    equivalence check on the second run).  The guard is VERSION-GATED:
+    under a forced-host-device-count CPU mesh the bootstrap skips the
+    cache only when the installed jax is on an affected line
+    (:func:`_cache_deser_affected`); unaffected jax keeps the cache
+    even there.  Real accelerators and plain single-device CPU always
+    keep it, and an explicit ``enable_compile_cache()`` call still
+    works everywhere.  ``jax_version`` overrides the installed version
+    (tests)."""
     flags = os.environ.get("XLA_FLAGS", "")
     if "xla_force_host_platform_device_count" in flags:
+        multi = False
         for tok in flags.split():
             if tok.startswith("--xla_force_host_platform_device_count"):
                 try:
-                    if int(tok.split("=", 1)[1]) > 1:
-                        return False
+                    multi = int(tok.split("=", 1)[1]) > 1
                 except (IndexError, ValueError):
-                    return False
+                    multi = True
+        if multi:
+            if jax_version is None:
+                import jax
+
+                jax_version = jax.__version__
+            return not _cache_deser_affected(jax_version)
     return True
+
+
+def fusion_cost_table(table):
+    """Install the process-wide fusion cost table (same switch as the
+    ``MXNET_FUSION_TUNE`` env path, callable after import): a JSON
+    path, a ``fusion_cost.CostTable``/dict, or None to force no table.
+    ``tools/autotune.py`` writes compatible tables."""
+    from . import fusion_cost
+
+    fusion_cost.set_cost_table(table)
 
 
 def enable_telemetry(on=True):

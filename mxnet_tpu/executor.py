@@ -34,15 +34,19 @@ __all__ = ["Executor"]
 
 class Executor:
     def __init__(self, symbol, ctx, args, args_grad, grad_req, aux_states,
-                 shared_exec=None, remat_policy=None):
+                 shared_exec=None, remat_policy=None, fusion=None):
         import jax
 
         from .remat import resolve_policy
+        from . import fusion_cost as _fc
 
         # validate eagerly so a typo'd policy fails at bind, not at the
         # first backward; None defers to MXNET_REMAT_POLICY
         resolve_policy(remat_policy)
         self._remat_policy = remat_policy
+        # same contract for the fusion spec (None defers to MXNET_FUSION)
+        fusion_plan = _fc.resolve_fusion(fusion)
+        self._fusion = fusion
 
         self._symbol = symbol
         self._ctx = ctx or current_context()
@@ -63,7 +67,23 @@ class Executor:
             if (grad_req.get(n, "null") if isinstance(grad_req, dict)
                 else grad_req) != "null"))
 
-        self._sym_fn, _, _ = symbol._build_fn()
+        # trace-guided graph fusion: rewrite the compiled graph through
+        # the pattern registry, gated per site shape by the measured
+        # cost table.  Patterns preserve arg/aux/output contracts, so
+        # only the compiled fn sees the fused graph; self._symbol (and
+        # every name list above) stays the user's graph.
+        exec_symbol = symbol
+        self.fusion_fired = []
+        if fusion_plan is not None:
+            from .symbol import fusion as _fusion_pass
+
+            known = {n: (tuple(a.shape), a.dtype)
+                     for d in (self.arg_dict, self.aux_dict)
+                     for n, a in d.items()}
+            exec_symbol, self.fusion_fired = _fusion_pass.apply_fusion(
+                symbol, fusion_plan, known=known)
+
+        self._sym_fn, _, _ = exec_symbol._build_fn()
         self._outputs = None
         self._pending = None  # values dict awaiting lazy train-forward
         self.monitor_callback = None
@@ -255,7 +275,8 @@ class Executor:
                 nd_zeros(shp, ctx=self._ctx, dtype=old.dtype)
         return Executor(self._symbol, self._ctx, new_args, new_grads,
                         self._grad_req, new_aux,
-                        remat_policy=self._remat_policy)
+                        remat_policy=self._remat_policy,
+                        fusion=self._fusion)
 
     def set_monitor_callback(self, callback, monitor_all=False):
         self.monitor_callback = callback

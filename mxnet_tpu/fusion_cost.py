@@ -1,0 +1,419 @@
+"""Shape-keyed measured fusion cost table + fusion-plan resolution.
+
+"A Learned Performance Model for TPUs" (PAPERS.md) motivates gating
+graph rewrites with a per-shape cost estimate instead of firing them
+unconditionally; FusionStitching motivates the rewrites themselves.
+This module is the *measured* (not learned) version of that idea:
+
+* ``tools/autotune.py`` micro-benchmarks every registered fusion
+  pattern fused-vs-unfused per input shape and persists a JSON table
+  (atomic via ``checkpoint.atomic_write``) keyed by
+  ``pattern|dtype|shape`` — see :func:`shape_key`.
+* At bind/hybridize time :func:`resolve_fusion` turns the ``fusion=``
+  argument (or the ``MXNET_FUSION`` env default) into a
+  :class:`FusionPlan`, which consults the table loaded from
+  ``MXNET_FUSION_TUNE`` / :func:`set_cost_table`
+  (``config.fusion_cost_table``) and decides per matched site whether
+  the rewrite fires.
+* With no table, the safe defaults apply: identical-math elementwise
+  patterns (``default_on``) stay on, numerics-changing kernels (one-pass
+  normalization stats, conv+BN+ReLU) stay off until measured faster.
+
+The block-tracing paths (CachedOp/hybridize, ShardedTrainer) have no
+Symbol graph to rewrite; they install the plan in a contextvar
+(:func:`scope`) and shape-specialized op fast paths consult
+:func:`runtime_decision` during the jit trace, where shapes are
+concrete — the same table, the same keys, per-shape decisions on both
+front-ends.
+
+Decision rule (:meth:`FusionPlan.decide`): a table entry with measured
+``speedup >= SPEEDUP_FIRE`` fires the rewrite even for default-off
+patterns; ``speedup < SPEEDUP_KEEP`` suppresses it even for default-on
+patterns; anything between (or no entry) falls back to the pattern's
+``default_on``.  Explicitly named patterns (``fusion="layer_norm_fast"``)
+force-fire — an explicit opt-in outranks the table.
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import datetime
+import json
+import os
+import re
+import warnings
+
+from .base import MXNetError
+from . import config as _config
+
+__all__ = ["shape_key", "CostTable", "validate_table", "load_table",
+           "save_table", "set_cost_table", "current_table",
+           "FusionPlan", "resolve_fusion", "scope", "current_plan",
+           "runtime_decision", "SPEEDUP_FIRE", "SPEEDUP_KEEP",
+           "TABLE_VERSION"]
+
+# a default-OFF pattern fires when measured at least this much faster;
+# a default-ON pattern is suppressed when measured slower than parity.
+# The asymmetric band keeps noise (~±3% on the CPU harness) from
+# flapping decisions run-to-run.
+SPEEDUP_FIRE = 1.05
+SPEEDUP_KEEP = 1.0
+TABLE_VERSION = 1
+
+_DTYPE_TAGS = {"float32": "f32", "float64": "f64", "float16": "f16",
+               "bfloat16": "bf16", "int32": "i32", "int64": "i64"}
+
+# pattern|dtype|DxDx...[|ax<k>]
+_KEY_RE = re.compile(
+    r"^[A-Za-z0-9_]+\|[a-z0-9]+\|\d+(x\d+)*(\|ax-?\d+)?(\|[a-z0-9.]+)?$")
+
+_ENTRY_REQUIRED = ("pattern", "fused_ms", "unfused_ms", "speedup")
+
+
+def _dtype_tag(dtype):
+    s = str(dtype)
+    # jnp/np dtype objects stringify to the canonical name
+    for name, tag in _DTYPE_TAGS.items():
+        if name in s:
+            return tag
+    return re.sub(r"[^a-z0-9]", "", s.lower()) or "f32"
+
+
+def shape_key(pattern, shape, dtype="float32", axis=None, extra=None):
+    """Canonical cost-table key for one rewrite site.
+
+    The same function keys autotune measurements and bind-time lookups,
+    so a table regenerated on TPU drops straight into a CPU-authored
+    config and vice versa (the backend rides in the table meta, the key
+    stays backend-neutral).  ``axis`` is canonicalized to its negative
+    form so semantically identical spellings (axis=2 vs axis=-1 on 3-D
+    data) hit the same entry; ``extra`` is a pattern-supplied
+    discriminator tag (e.g. conv geometry) appended verbatim."""
+    key = "%s|%s|%s" % (pattern, _dtype_tag(dtype),
+                        "x".join(str(int(d)) for d in shape))
+    if axis is not None:
+        ax = int(axis)
+        if ax >= 0:
+            ax -= len(shape)
+        key += "|ax%d" % ax
+    if extra:
+        key += "|%s" % extra
+    return key
+
+
+def validate_table(data, max_age_days=None, now=None):
+    """Schema/shape-key/staleness check for a cost-table dict.
+
+    Returns ``(problems, stale)``: ``problems`` are malformed-input
+    errors (nonzero exit in ``autotune --check``); ``stale`` are
+    entries older than ``max_age_days`` (reported, not fatal — an old
+    measurement is still a measurement)."""
+    problems, stale = [], []
+    if not isinstance(data, dict):
+        return ["table is not a JSON object"], stale
+    if data.get("version") != TABLE_VERSION:
+        problems.append("version %r != supported %d"
+                        % (data.get("version"), TABLE_VERSION))
+    entries = data.get("entries")
+    if not isinstance(entries, dict):
+        return problems + ["'entries' missing or not an object"], stale
+    now = now if now is not None else datetime.datetime.now(
+        datetime.timezone.utc)
+    for key, e in entries.items():
+        if not _KEY_RE.match(key):
+            problems.append("bad shape key %r (want pattern|dtype|DxD"
+                            "[|axK])" % key)
+            continue
+        if not isinstance(e, dict):
+            problems.append("entry %r is not an object" % key)
+            continue
+        for f in _ENTRY_REQUIRED:
+            if f not in e:
+                problems.append("entry %r missing field %r" % (key, f))
+            elif f != "pattern" and not isinstance(e[f], (int, float)):
+                problems.append("entry %r field %r is not numeric"
+                                % (key, f))
+        if e.get("pattern") and key.split("|", 1)[0] != e["pattern"]:
+            problems.append("entry %r pattern field %r does not match "
+                            "its key" % (key, e["pattern"]))
+        if isinstance(e.get("speedup"), (int, float)) and \
+                e["speedup"] <= 0:
+            problems.append("entry %r speedup %r is not positive"
+                            % (key, e["speedup"]))
+        if max_age_days is not None and e.get("measured_at"):
+            try:
+                ts = datetime.datetime.fromisoformat(
+                    str(e["measured_at"]))
+                if ts.tzinfo is None:
+                    ts = ts.replace(tzinfo=datetime.timezone.utc)
+                age = (now - ts).total_seconds() / 86400.0
+                if age > max_age_days:
+                    stale.append("%s (measured %.0f days ago)"
+                                 % (key, age))
+            except ValueError:
+                problems.append("entry %r measured_at %r is not ISO-8601"
+                                % (key, e["measured_at"]))
+    return problems, stale
+
+
+class CostTable:
+    """In-memory view of a measured cost table (see module doc)."""
+
+    __slots__ = ("entries", "meta")
+
+    def __init__(self, entries=None, meta=None):
+        self.entries = dict(entries or {})
+        self.meta = dict(meta or {})
+
+    @classmethod
+    def from_dict(cls, data, source="<dict>"):
+        problems, _stale = validate_table(data)
+        if problems:
+            raise MXNetError("invalid fusion cost table %s: %s"
+                             % (source, "; ".join(problems[:5])))
+        meta = {k: v for k, v in data.items() if k != "entries"}
+        return cls(data["entries"], meta)
+
+    def to_dict(self):
+        d = dict(self.meta)
+        d.setdefault("version", TABLE_VERSION)
+        d["entries"] = self.entries
+        return d
+
+    def speedup(self, key):
+        e = self.entries.get(key)
+        return e.get("speedup") if isinstance(e, dict) else None
+
+    def add(self, key, fused_ms, unfused_ms, **extra):
+        e = {"pattern": key.split("|", 1)[0],
+             "fused_ms": round(float(fused_ms), 6),
+             "unfused_ms": round(float(unfused_ms), 6),
+             "speedup": round(float(unfused_ms) / max(float(fused_ms),
+                                                      1e-12), 4),
+             "measured_at": datetime.datetime.now(
+                 datetime.timezone.utc).isoformat(timespec="seconds")}
+        e.update(extra)
+        self.entries[key] = e
+        return e
+
+
+def load_table(path):
+    """Load + validate a cost table; raises MXNetError on malformed
+    input (mirrors telemetry_dump's loud-failure behavior)."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except OSError as e:
+        raise MXNetError("fusion cost table %s: cannot read (%s)"
+                         % (path, e))
+    except ValueError as e:
+        raise MXNetError("fusion cost table %s: malformed JSON (%s)"
+                         % (path, e))
+    return CostTable.from_dict(data, source=path)
+
+
+def save_table(path, table):
+    """Atomically persist ``table`` (CostTable or dict) as JSON."""
+    from .checkpoint import atomic_write
+
+    data = table.to_dict() if isinstance(table, CostTable) else table
+    atomic_write(os.fspath(path), json.dumps(data, indent=2, sort_keys=True))
+    return path
+
+
+# ---------------------------------------------------------------------------
+# active table: config override > MXNET_FUSION_TUNE env path
+# ---------------------------------------------------------------------------
+
+_UNSET = object()
+_override = _UNSET       # None = explicitly no table; CostTable; path str
+_path_cache = {}         # path -> (mtime, CostTable | None)
+_warned_paths = set()
+
+
+def set_cost_table(table):
+    """Install the process-wide cost table (``config.fusion_cost_table``
+    calls this): a path, a CostTable/dict, or None to force no table.
+    Pass ``_UNSET``-clearing is done via :func:`clear_cost_table`."""
+    global _override
+    if isinstance(table, dict):
+        table = CostTable.from_dict(table)
+    _override = table
+
+
+def clear_cost_table():
+    """Back to the env default (``MXNET_FUSION_TUNE``)."""
+    global _override
+    _override = _UNSET
+    _path_cache.clear()
+    _warned_paths.clear()
+
+
+def _load_cached(path):
+    try:
+        mtime = os.path.getmtime(path)
+    except OSError:
+        mtime = None
+    hit = _path_cache.get(path)
+    if hit is not None and hit[0] == mtime:
+        return hit[1]
+    table = None
+    try:
+        table = load_table(path)
+    except MXNetError as e:
+        # a broken table must not break every bind: warn once, fuse on
+        # defaults (the conservative direction)
+        if path not in _warned_paths:
+            _warned_paths.add(path)
+            warnings.warn(str(e))
+    _path_cache[path] = (mtime, table)
+    return table
+
+
+def current_table():
+    """The active cost table, or None."""
+    if _override is not _UNSET:
+        if isinstance(_override, (str, os.PathLike)):
+            return _load_cached(os.fspath(_override))
+        return _override
+    path = _config.get("MXNET_FUSION_TUNE")
+    if not path:
+        return None
+    return _load_cached(path)
+
+
+# ---------------------------------------------------------------------------
+# fusion plan
+# ---------------------------------------------------------------------------
+
+
+class FusionPlan:
+    """Resolved fusion policy: which patterns may fire, forced or
+    table/default gated."""
+
+    __slots__ = ("patterns", "force", "table")
+
+    def __init__(self, patterns=None, force=False, table=None):
+        self.patterns = patterns  # None = every registered pattern
+        self.force = force
+        self.table = table
+
+    def wants(self, pattern):
+        return self.patterns is None or pattern in self.patterns
+
+    def decide(self, pattern, default_on, key=None):
+        """Should the ``pattern`` rewrite fire at the site ``key``?"""
+        if not self.wants(pattern):
+            return False
+        if self.force:
+            return True
+        if self.table is not None and key is not None:
+            sp = self.table.speedup(key)
+            if sp is not None:
+                if sp >= SPEEDUP_FIRE:
+                    return True
+                if sp < SPEEDUP_KEEP:
+                    return False
+        return bool(default_on)
+
+    def needs_shapes(self):
+        """Bind-time sites only need shape inference when a table could
+        flip a decision."""
+        return self.table is not None and not self.force
+
+    def __repr__(self):
+        return "FusionPlan(patterns=%r, force=%r, table=%s)" % (
+            self.patterns, self.force,
+            "yes" if self.table is not None else "no")
+
+
+def resolve_fusion(spec):
+    """``fusion=`` argument -> FusionPlan or None (fusion off).
+
+    Accepted: None (defer to ``MXNET_FUSION``), bool, ``"off"``/
+    ``"none"``/``"0"``, ``""``/``"default"``/``"on"``/``"1"`` (default
+    patterns + cost table), ``"all"`` (force every pattern), or a
+    comma/plus-separated pattern-name list (forced).  Unknown names
+    raise ValueError at bind — same fail-fast contract as
+    ``remat_policy``."""
+    if spec is None:
+        spec = _config.get("MXNET_FUSION")
+    if isinstance(spec, FusionPlan):
+        return spec
+    if spec is False:
+        return None
+    if spec is True:
+        spec = "default"
+    s = str(spec).strip()
+    low = s.lower()
+    if low in ("off", "none", "0", "false"):
+        return None
+    if low in ("", "default", "on", "1", "true"):
+        return FusionPlan(patterns=None, force=False,
+                          table=current_table())
+    if low == "all":
+        return FusionPlan(patterns=None, force=True, table=None)
+    names = [t for t in re.split(r"[,+\s]+", s) if t]
+    from .symbol import fusion as _fusion
+
+    known = set(_fusion.list_patterns())
+    unknown = [n for n in names if n not in known]
+    if unknown:
+        raise ValueError(
+            "unknown fusion pattern(s) %s; registered: %s (or use "
+            "'default'/'all'/'off')" % (unknown, sorted(known)))
+    return FusionPlan(patterns=names, force=True, table=None)
+
+
+# ---------------------------------------------------------------------------
+# runtime (trace-time) plan context for the block paths
+# ---------------------------------------------------------------------------
+
+_ctx = contextvars.ContextVar("mxnet_tpu_fusion_plan", default=None)
+
+
+@contextlib.contextmanager
+def scope(plan):
+    """Install ``plan`` for the duration of a block trace (CachedOp /
+    ShardedTrainer); shape-specialized op fast paths consult it via
+    :func:`runtime_decision`."""
+    token = _ctx.set(plan)
+    try:
+        yield plan
+    finally:
+        _ctx.reset(token)
+
+
+def current_plan():
+    return _ctx.get()
+
+
+def note_fired(pattern, site, key=None):
+    """Telemetry counter + trace annotation for one fired rewrite, so
+    wins are attributable in the PR 4/5 exports."""
+    from . import telemetry as _telemetry
+
+    if _telemetry.enabled():
+        _telemetry.FUSION_REWRITES.inc(pattern=pattern)
+    from . import tracing as _tracing
+
+    if _tracing.enabled():
+        sp = _tracing.begin("fusion:%s" % pattern,
+                            args={"site": site, "key": key})
+        sp.end()
+
+
+def runtime_decision(pattern, shape, dtype, default_on=False, axis=None,
+                     site="<trace>"):
+    """Per-shape decision inside a traced op fast path.  Shapes are
+    concrete during the jit trace, so the lookup uses the exact same
+    keys the autotuner measured.  Returns False when no plan is
+    installed (eager/imperative calls keep stock behavior)."""
+    plan = _ctx.get()
+    if plan is None:
+        return False
+    key = shape_key(pattern, shape, dtype, axis=axis)
+    ok = plan.decide(pattern, default_on, key)
+    if ok:
+        note_fired(pattern, site, key)
+    return ok

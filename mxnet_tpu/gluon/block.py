@@ -374,10 +374,11 @@ class CachedOp:
     cached_op.cc via MXCreateCachedOpEx)."""
 
     def __init__(self, block, static_alloc=False, static_shape=False,
-                 remat_policy=None):
+                 remat_policy=None, fusion=None):
         import jax
 
         from ..remat import resolve_policy
+        from .. import fusion_cost as _fc
 
         self._block = block
         self._jits = {}  # is_train -> jitted fn
@@ -387,17 +388,38 @@ class CachedOp:
         # fail fast on a typo'd policy; None defers to MXNET_REMAT_POLICY
         resolve_policy(remat_policy)
         self._remat_policy = remat_policy
+        # block traces have no Symbol graph to rewrite; the plan
+        # (hybridize(fusion=...) or the MXNET_FUSION default) is
+        # installed around the trace and shape-specialized op fast
+        # paths consult it per concrete shape (fusion_cost.scope).
+        # Validate the spec now (fail fast on a typo), but keep the raw
+        # spec and re-resolve per trace so a cost table installed after
+        # construction (config.fusion_cost_table / MXNET_FUSION_TUNE)
+        # applies to new-shape retraces — same contract as Executor,
+        # which re-resolves per bind.
+        _fc.resolve_fusion(fusion)
+        self._fusion = fusion
 
     def _make_fn(self, is_train, n_inputs, n_params):
         block = self._block
 
         def raw_fn(rng, inputs, params):
+            from .. import fusion_cost as _fc
+            from contextlib import ExitStack
+
+            # resolved per trace (not at construction) so a cost table
+            # installed later applies to new-shape retraces; resolve
+            # BEFORE mutating the global trace state so a bad
+            # MXNET_FUSION set after construction cannot leak it
+            fusion_plan = _fc.resolve_fusion(self._fusion)
             _random.push_trace_key(rng)
             prev_t = autograd.set_training(is_train)
             prev_r = autograd.set_recording(False)
             sink = []
             _aux_sink.sink = sink
             _trace_state.active = True
+            stack = ExitStack()
+            stack.enter_context(_fc.scope(fusion_plan))
             try:
                 nd_inputs = [NDArray(x) for x in inputs]
                 # rebind live param NDArrays to tracers for the trace
@@ -418,6 +440,7 @@ class CachedOp:
                             for (_p, v) in sink]
                 return tuple(outs), tuple(aux_vals), tmpl, aux_params
             finally:
+                stack.close()
                 _trace_state.active = False
                 _aux_sink.sink = None
                 autograd.set_recording(prev_r)

@@ -37,9 +37,10 @@ class DataParallelExecutorGroup:
                  param_names, for_training, inputs_need_grad,
                  shared_group=None, logger=None, fixed_param_names=None,
                  grad_req="write", state_names=None, group2ctxs=None,
-                 remat_policy=None):
+                 remat_policy=None, fusion=None):
         self.symbol = symbol
         self.remat_policy = remat_policy
+        self.fusion = fusion
         self.contexts = contexts
         self.workload = workload or [1] * len(contexts)
         self.for_training = for_training
@@ -94,6 +95,7 @@ class DataParallelExecutorGroup:
             exe = self.symbol.simple_bind(ctx=ctx, grad_req=self.grad_req,
                                           shared_exec=shared,
                                           remat_policy=self.remat_policy,
+                                          fusion=self.fusion,
                                           **shapes)
             self.execs.append(exe)
 

@@ -1,15 +1,30 @@
-"""Fused conv+BN+ReLU block op — the training-mode half of the
-graph-fusion pass (mxnet_tpu/symbol/fusion.py).
+"""Fused kernels emitted by the graph-fusion pattern registry
+(mxnet_tpu/symbol/fusion.py).
 
-Why a dedicated op when XLA already fuses elementwise chains: the MFU
+Why dedicated ops when XLA already fuses elementwise chains: the MFU
 accounting (docs/perf_notes.md) shows the ResNet-50 step spends ~69 ms
 of a 121.8 ms step on HBM traffic, a large slice of which is the
-backward pass re-reading normalized activations.  Here the normalize+
-activate tail is wrapped in ``jax.checkpoint``, so its VJP *recomputes*
-the normalized activation from the conv output (one cheap elementwise
-pass over data already needed for the conv gradient) instead of
-streaming a second saved activation tensor from HBM — the
-FusionStitching recipe for memory-bound ops.
+backward pass re-reading normalized activations.  Two recipes recur
+below:
+
+* ``jax.checkpoint`` around the normalize+activate tail, so the VJP
+  *recomputes* the normalized activation from data the backward pass
+  reads anyway instead of streaming a second saved tensor from HBM —
+  the FusionStitching recipe for memory-bound ops
+  (``_contrib_conv_bn_relu``, ``_contrib_norm_act``).
+* one-pass statistics (mean and mean-of-squares in a single fused
+  multi-output reduction, fp32 accumulation) instead of the stock
+  mean-then-var double pass (``_contrib_layer_norm_fused``) — measured
+  up to ~2x on the CPU harness for wide rows, and *slower* on some
+  shapes, which is exactly why the cost table gates it per shape.
+
+The pure elementwise chain ops (``_contrib_add_act``,
+``_contrib_act_scale_add``) compute the identical jax expressions the
+unfused graphs trace to — bitwise-parity refactors that collapse
+multi-node subgraphs into one op node (fewer nodes to trace/pattern-
+match downstream, one attributable site in the trace), safe to fire by
+default.  VJPs for every op here come from jax.vjp over the same pure
+function, so gradient correctness rides the parity tests.
 
 Input order puts the optional conv bias LAST so the auxiliary-state
 positions (moving_mean, moving_var) are stable for graphs with and
@@ -29,7 +44,7 @@ from jax import lax
 
 from .registry import register
 from .utils import pbool, pint, pfloat, ptuple
-from .nn import _conv_dims, _dim_numbers
+from .nn import _conv_dims, _dim_numbers, activation
 
 
 @register("_contrib_conv_bn_relu", num_inputs=-1, num_outputs=3,
@@ -76,12 +91,116 @@ def conv_bn_relu(data, weight, gamma, beta, moving_mean, moving_var,
         inv = lax.rsqrt(var_.astype(jnp.float32) + eps).astype(y_.dtype)
         out_ = (y_ - mean_.reshape(shape)) * inv.reshape(shape) \
             * g_.reshape(shape) + b_.reshape(shape)
-        if act == "relu":
-            out_ = jax.nn.relu(out_)
-        return out_
+        return _apply_act(out_, act)
 
     # jax.checkpoint saves only the inputs (conv output + per-channel
     # stats/affine) and re-derives the normalized activation in the
     # backward pass — no second activation tensor round-trips HBM
     out = jax.checkpoint(_norm_act)(y, mean, var, g, beta)
+    return out, mean, var
+
+
+# ---------------------------------------------------------------------------
+# elementwise chain kernels (identical-math refactors; default-on)
+# ---------------------------------------------------------------------------
+
+
+def _apply_act(x, act):
+    # delegate to the standalone Activation implementation so the fused
+    # expression (and its VJP — e.g. relu'(0)) is the exact one the
+    # unfused graph traces to
+    if not act:
+        return x
+    return activation(x, act_type=act)
+
+
+@register("_contrib_add_act", num_inputs=2)
+def add_act(lhs, rhs, act_type="relu", **kw):
+    """(lhs + rhs) -> activation, one node.  Covers bias+activation and
+    the residual-add+relu join (ResNet v1 unit tail)."""
+    return _apply_act(lhs + rhs, act_type or "relu")
+
+
+@register("_contrib_act_scale_add", num_inputs=-1)
+def act_scale_add(data, *rest, act_type="relu", scalar=None, **kw):
+    """activation -> scale -> add chain as one node.
+
+    ``scalar`` set: inputs are (data, add_rhs) and the scale is the
+    static scalar; otherwise inputs are (data, mul_rhs, add_rhs)."""
+    y = _apply_act(data, act_type or "relu")
+    if scalar is not None:
+        add_rhs, = rest
+        y = y * data.dtype.type(float(scalar))
+    else:
+        mul_rhs, add_rhs = rest
+        y = y * mul_rhs
+    return y + add_rhs
+
+
+# ---------------------------------------------------------------------------
+# one-pass normalization kernels (numerics-bearing; cost-table gated)
+# ---------------------------------------------------------------------------
+
+
+def layer_norm_fast(data, gamma, beta, axis=-1, eps=1e-5):
+    """One-pass LayerNorm: mean and mean-of-squares in a single fused
+    reduction over ``data`` (fp32 accumulation), ``var = E[x^2] -
+    E[x]^2`` clamped at zero.  One fewer full pass over the activation
+    than the stock mean-then-var kernel; the cancellation error of the
+    E[x^2] form stays below the parity tolerance for activation-scale
+    data (tests/test_fusion_patterns.py) but IS a different rounding —
+    hence default-off until the cost table measures it faster."""
+    from .utils import normalize_axis
+
+    ax = normalize_axis(pint(axis, -1), data.ndim)
+    eps = pfloat(eps, 1e-5)
+    xf = data.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=ax, keepdims=True)
+    meansq = jnp.mean(xf * xf, axis=ax, keepdims=True)
+    var = jnp.maximum(meansq - mean * mean, 0.0)
+    shape = [1] * data.ndim
+    shape[ax] = data.shape[ax]
+    out = ((xf - mean) * lax.rsqrt(var + eps)).astype(data.dtype)
+    return out * gamma.reshape(shape) + beta.reshape(shape)
+
+
+register("_contrib_layer_norm_fused", num_inputs=3)(
+    lambda data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False,
+    **kw: layer_norm_fast(data, gamma, beta, axis=axis, eps=eps))
+
+
+@register("_contrib_norm_act", num_inputs=5, num_outputs=3,
+          visible_outputs=1)
+def norm_act(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
+             momentum=0.9, fix_gamma=True, use_global_stats=False,
+             axis=1, act_type="relu", **kw):
+    """BatchNorm -> activation collapsed into one node for BN nodes the
+    conv fusion cannot reach (shared-producer residual branches).  Same
+    train/eval semantics and (out, mean, var) contract as BatchNorm —
+    the executor threads the moving-stat updates identically — with the
+    normalize+activate tail checkpointed so the VJP recomputes the
+    normalized activation instead of re-reading it from HBM."""
+    from .utils import normalize_axis
+    from .. import autograd
+
+    ax = normalize_axis(pint(axis, 1), data.ndim)
+    eps = pfloat(eps, 1e-3)
+    red = tuple(i for i in range(data.ndim) if i != ax)
+    shape = [1] * data.ndim
+    shape[ax] = data.shape[ax]
+    if pbool(use_global_stats) or not autograd.is_training():
+        mean, var = moving_mean, moving_var
+    else:
+        mean = jnp.mean(data, axis=red)
+        var = jnp.var(data, axis=red)
+    g = jnp.ones_like(gamma) if pbool(fix_gamma, True) else gamma
+    act = act_type or ""
+
+    def _norm_act_tail(x_, mean_, var_, g_, b_):
+        inv = lax.rsqrt(var_.astype(jnp.float32) + eps).astype(x_.dtype)
+        out_ = (x_ - mean_.reshape(shape)) * inv.reshape(shape) \
+            * g_.reshape(shape) + b_.reshape(shape)
+        return _apply_act(out_, act)
+
+    out = jax.checkpoint(_norm_act_tail)(data, mean, var, g, beta)
     return out, mean, var

@@ -264,6 +264,18 @@ def batch_norm(data, gamma, beta, moving_mean, moving_var, eps=1e-3,
 
 @register("LayerNorm", num_inputs=3)
 def layer_norm(data, gamma, beta, axis=-1, eps=1e-5, output_mean_var=False, **kw):
+    from .. import fusion_cost as _fc
+
+    # block-trace fusion fast path: under an active fusion plan
+    # (CachedOp/hybridize/ShardedTrainer install one via
+    # fusion_cost.scope) the shape-keyed cost table can swap in the
+    # one-pass-statistics kernel per concrete traced shape — the same
+    # decision the Symbol-path graph rewrite makes at bind time
+    if _fc.runtime_decision("layer_norm_fast", data.shape, data.dtype,
+                            axis=pint(axis, -1), site="LayerNorm"):
+        from .fused import layer_norm_fast
+
+        return layer_norm_fast(data, gamma, beta, axis=axis, eps=eps)
     ax = normalize_axis(pint(axis, -1), data.ndim)
     eps = pfloat(eps, 1e-5)
     mean = jnp.mean(data, axis=ax, keepdims=True)

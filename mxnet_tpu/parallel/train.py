@@ -100,18 +100,27 @@ class ShardedTrainer:
     def __init__(self, net, loss_fn, mesh=None, optimizer="sgd",
                  optimizer_params=None, batch_axis_spec="dp",
                  param_spec_fn=None, dtype=None, donate=True,
-                 remat_policy=None, on_nonfinite=None):
+                 remat_policy=None, fusion=None, on_nonfinite=None):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from ..remat import resolve_policy
         from ..checkpoint import nonfinite_policy
+        from .. import fusion_cost as _fc
 
         self.net = net
         self.loss_fn = loss_fn
         # fail fast on a typo'd policy; None defers to MXNET_REMAT_POLICY
         resolve_policy(remat_policy)
         self._remat_policy = remat_policy
+        # fusion spec for the step trace (fusion= or the MXNET_FUSION
+        # default): installed around the forward trace so shape-
+        # specialized op fast paths can consult the measured cost
+        # table.  Validated now (fail fast on a typo) but re-resolved
+        # per trace, so a table installed after construction still
+        # applies to new-shape retraces — same contract as Executor.
+        _fc.resolve_fusion(fusion)
+        self._fusion = fusion
         # NaN/Inf step guard (None defers to MXNET_NONFINITE_POLICY):
         # "skip" compiles a select into the step so a non-finite loss
         # discards the whole update (params, optimizer state, moving
@@ -265,13 +274,26 @@ class ShardedTrainer:
         trainable = self._trainable
         cdtype = self._dtype
 
+        fusion_spec = self._fusion
+
         def forward_loss(param_arrays, inputs, label, rng):
+            from contextlib import ExitStack
+
+            from .. import fusion_cost as _fc
+
+            # resolved per trace, not at build: a cost table installed
+            # after construction applies to new-shape retraces; resolve
+            # BEFORE mutating the global trace state so a bad
+            # MXNET_FUSION set after construction cannot leak it
+            fusion_plan = _fc.resolve_fusion(fusion_spec)
             _random.push_trace_key(rng)
             prev_t = autograd.set_training(True)
             prev_r = autograd.set_recording(False)
             sink = []
             _block_mod._aux_sink.sink = sink
             _block_mod._trace_state.active = True
+            stack = ExitStack()
+            stack.enter_context(_fc.scope(fusion_plan))
             try:
                 saved = []
                 for p, arr in zip(params_objs, param_arrays):
@@ -301,6 +323,7 @@ class ShardedTrainer:
 
                 return jnp.mean(loss._data).astype(jnp.float32), aux_vals
             finally:
+                stack.close()
                 _block_mod._trace_state.active = False
                 _block_mod._aux_sink.sink = None
                 autograd.set_recording(prev_r)

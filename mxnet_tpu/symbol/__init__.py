@@ -2,7 +2,8 @@
 from .symbol import (Symbol, var, Variable, Group, load, load_json,  # noqa: F401
                      zeros, ones, _invoke_sym)
 from . import fusion  # noqa: F401
-from .fusion import fold_batchnorm, fuse_conv_bn_relu  # noqa: F401
+from .fusion import (fold_batchnorm, fuse_conv_bn_relu,  # noqa: F401
+                     apply_fusion, list_patterns)  # noqa: F401
 from . import register as _register
 
 _register.populate(globals())

@@ -1,26 +1,38 @@
-"""Graph-level fusion passes over the Symbol DAG.
+"""Graph-level fusion over the Symbol DAG: a trace-guided pattern
+registry with a measured, shape-keyed cost table.
 
 The MFU accounting (docs/perf_notes.md) shows the ResNet-50 train step
 is HBM-bound: ~69 ms of a 121.8 ms step is BN/ReLU streaming and bwd
 re-reads, not MXU work.  These passes attack that traffic at the graph
 level, in the FusionStitching (arXiv:1811.05213) memory-bound-op sense:
 
+* :class:`FusionPattern` / :func:`register_pattern` — the registry.
+  Each pattern is a matcher (``plan``) + emitter over
+  :func:`rewrite_graph`, carries its safety class (``default_on``:
+  identical-math refactor vs numerics-bearing kernel), and a
+  ``bench_builder`` so tools/autotune.py, tools/bench_fusion.py and the
+  tier-1 parity guard all measure/verify the exact chain the matcher
+  targets.  Registered: ``conv_bn_relu``, ``norm_act``,
+  ``act_scale_add``, ``add_act``, ``layer_norm_fast`` — kernels in
+  mxnet_tpu/ops/fused.py.
+* :func:`apply_fusion` — runs the registry over a Symbol, one pass per
+  pattern, gating every matched site through the
+  :class:`mxnet_tpu.fusion_cost.FusionPlan` (explicit ``fusion=`` arg,
+  ``MXNET_FUSION`` env default, shape-keyed cost table from
+  ``MXNET_FUSION_TUNE``).  Fired rewrites emit a telemetry counter and
+  a trace annotation so wins are attributable.
 * :func:`fold_batchnorm` — inference: fold BatchNorm scale/shift
   algebraically into the adjacent Convolution/FullyConnected weights;
   the BN node disappears from the graph entirely (zero extra passes
-  over the activation at serving time).
-* :func:`fuse_conv_bn_relu` — training: collapse
-  Convolution -> BatchNorm [-> relu] chains into the fused
-  ``_contrib_conv_bn_relu`` block op (mxnet_tpu/ops/fused.py) whose
-  VJP *recomputes* the normalized activation instead of re-reading it
-  from HBM.
-* :func:`rewrite_graph` — the generic rebuild engine both passes (and
-  the int8 rewrite in contrib/quantization.py) run on, so future
-  passes hang off one piece of infrastructure.
+  over the activation at serving time).  Value-rewriting, so it stays
+  an explicit call rather than a registry pattern.
+* :func:`rewrite_graph` — the generic rebuild engine every pass (and
+  the int8 rewrite in contrib/quantization.py) runs on.
 
-Both passes preserve parameter names wherever a node survives, so the
-original ``arg_params``/``aux_params`` dicts keep working; BN folding
-returns updated param dicts because it changes weight *values*.
+All patterns preserve parameter/aux names (fused nodes consume the
+very same variable nodes), so existing ``arg_params``/``aux_params``
+bind unchanged; BN folding returns updated param dicts because it
+changes weight *values*.
 """
 from __future__ import annotations
 
@@ -31,7 +43,8 @@ from ..ops.utils import pbool, pint, pfloat
 from . import symbol as S
 
 __all__ = ["rewrite_graph", "fold_batchnorm", "fuse_conv_bn_relu",
-           "count_ops"]
+           "count_ops", "FusionPattern", "register_pattern",
+           "get_pattern", "list_patterns", "apply_fusion", "microbench"]
 
 
 # ---------------------------------------------------------------------------
@@ -273,6 +286,53 @@ def _cbr_plan(sym):
     return plan
 
 
+def _cbr_tag(conv):
+    """Cost-key discriminator for the conv geometry: the input shape
+    alone would let one measured entry gate every conv config that
+    happens to share it (a 1x1 stride-2 projection vs the measured 3x3
+    stride-1 conv)."""
+    from ..ops.utils import ptuple
+
+    kernel = ptuple(conv.attrs.get("kernel"))
+    nd = len(kernel)
+    parts = ["k" + "x".join(str(d) for d in kernel)]
+    for tag, attr in (("s", "stride"), ("d", "dilate"), ("p", "pad")):
+        dflt = (1,) * nd if tag in ("s", "d") else (0,) * nd
+        v = ptuple(conv.attrs.get(attr), ndim=nd, default=dflt)
+        if tuple(v) != dflt:
+            parts.append(tag + "x".join(str(d) for d in v))
+    parts.append("f%d" % pint(conv.attrs.get("num_filter"), 0))
+    g = pint(conv.attrs.get("num_group"), 1)
+    if g != 1:
+        parts.append("g%d" % g)
+    return ".".join(parts)
+
+
+def _cbr_sites(sym):
+    return {hid: {"conv": conv, "bn": bn, "has_act": has_act,
+                  "data": conv.inputs[0], "tag": _cbr_tag(conv)}
+            for hid, (conv, bn, has_act) in _cbr_plan(sym).items()}
+
+
+def _cbr_emit(node, ins, sub, site):
+    conv, bn, has_act = site["conv"], site["bn"], site["has_act"]
+    data_s = sub(conv.inputs[0])
+    weight_s = sub(conv.inputs[1])
+    bias = [sub(conv.inputs[2])] if len(conv.inputs) > 2 else []
+    bn_ins = [sub(e) for e in bn.inputs[1:]]  # gamma..moving_var
+    attrs = {k: v for k, v in conv.attrs.items()
+             if k not in ("no_bias",)}
+    attrs["no_bias"] = not bias
+    for k in ("eps", "momentum", "fix_gamma", "use_global_stats"):
+        if k in bn.attrs:
+            attrs[k] = bn.attrs[k]
+    attrs["act_type"] = "relu" if has_act else ""
+    return S._invoke_sym(
+        "_contrib_conv_bn_relu",
+        [data_s, weight_s] + bn_ins + bias, attrs,
+        name=conv.name + "_bn_act")
+
+
 def fuse_conv_bn_relu(sym):
     """Collapse conv->BN[->relu] chains into ``_contrib_conv_bn_relu``.
 
@@ -284,29 +344,541 @@ def fuse_conv_bn_relu(sym):
     very same variable nodes, so existing ``arg_params``/``aux_params``
     bind unchanged.
     """
-    plan = _cbr_plan(sym)
-    if not plan:
-        return sym
+    fused, _fired = apply_fusion(sym, "conv_bn_relu")
+    return fused
 
-    def emit(node, ins, sub):
-        chain = plan.get(id(node))
-        if chain is None:
+
+# ---------------------------------------------------------------------------
+# pattern registry
+# ---------------------------------------------------------------------------
+
+# activations every fused elementwise kernel supports with math
+# identical to the standalone op/Activation node
+_FUSABLE_ACTS = ("relu", "sigmoid", "tanh", "softrelu", "softsign")
+_UNARY_ACTS = ("relu", "sigmoid", "tanh", "softsign")
+_ADD_OPS = ("elemwise_add", "broadcast_add")
+_MUL_OPS = ("elemwise_mul", "broadcast_mul")
+
+
+class FusionPattern:
+    """One registered rewrite.
+
+    ``plan(sym)`` returns ``{id(head_node): site}`` where ``site`` is a
+    dict with at least ``"data"`` — the original ``(node, out_index)``
+    entry whose output shape keys the cost table (optionally
+    ``"axis"``).  ``emit(head, ins, sub, site)`` builds the fused
+    replacement (rewrite_graph contract).  ``default_on`` marks
+    identical-math refactors that are safe without a cost table;
+    numerics-bearing kernels stay off until measured faster.
+    ``bench_builder(shape)`` returns ``(chain_sym, {input: shape})`` —
+    the canonical micro-benchmark/parity chain for the pattern, shared
+    by tools/autotune.py, tools/bench_fusion.py and the tier-1 parity
+    guard (a pattern registered without one fails the suite).
+    """
+
+    __slots__ = ("name", "plan", "emit", "default_on", "doc",
+                 "bench_builder", "bench_shapes")
+
+    def __init__(self, name, plan, emit, default_on=False, doc="",
+                 bench_builder=None, bench_shapes=()):
+        self.name = name
+        self.plan = plan
+        self.emit = emit
+        self.default_on = default_on
+        self.doc = doc
+        self.bench_builder = bench_builder
+        self.bench_shapes = tuple(bench_shapes)
+
+    def site_key(self, site, structs):
+        """Cost-table key for a matched site, or None when the shape is
+        unknown (decision then falls back to ``default_on``)."""
+        if structs is None:
             return None
-        conv, bn, has_act = chain
-        data_s = sub(conv.inputs[0])
-        weight_s = sub(conv.inputs[1])
-        bias = [sub(conv.inputs[2])] if len(conv.inputs) > 2 else []
-        bn_ins = [sub(e) for e in bn.inputs[1:]]  # gamma..moving_var
-        attrs = {k: v for k, v in conv.attrs.items()
-                 if k not in ("no_bias",)}
-        attrs["no_bias"] = not bias
-        for k in ("eps", "momentum", "fix_gamma", "use_global_stats"):
-            if k in bn.attrs:
-                attrs[k] = bn.attrs[k]
-        attrs["act_type"] = "relu" if has_act else ""
-        return S._invoke_sym(
-            "_contrib_conv_bn_relu",
-            [data_s, weight_s] + bn_ins + bias, attrs,
-            name=conv.name + "_bn_act")
+        node, oi = site["data"]
+        outs = structs.get(id(node))
+        if not outs or oi >= len(outs) or outs[oi] is None:
+            return None
+        from .. import fusion_cost as _fc
 
-    return rewrite_graph(sym, emit)
+        st = outs[oi]
+        return _fc.shape_key(self.name, st.shape, st.dtype,
+                             axis=site.get("axis"),
+                             extra=site.get("tag"))
+
+
+_PATTERNS = {}  # insertion-ordered: passes run in registration order
+
+
+def register_pattern(pattern):
+    if pattern.name in _PATTERNS:
+        raise MXNetError("fusion pattern %r already registered"
+                         % pattern.name)
+    _PATTERNS[pattern.name] = pattern
+    return pattern
+
+
+def get_pattern(name):
+    try:
+        return _PATTERNS[name]
+    except KeyError:
+        raise MXNetError("unknown fusion pattern %r (registered: %s)"
+                         % (name, sorted(_PATTERNS)))
+
+
+def list_patterns():
+    return list(_PATTERNS)
+
+
+# ---------------------------------------------------------------------------
+# per-node shape inference (cost-table gating)
+# ---------------------------------------------------------------------------
+
+
+def _node_structs(sym, known):
+    """``{id(node): [ShapeDtypeStruct] | None}`` by abstract evaluation.
+
+    ``known`` maps variable names to ``(shape, dtype)``.  Partial by
+    construction: any node whose inputs (or whose own eval) cannot be
+    resolved gets None, and gating just falls back to the pattern
+    default — shape gating must never make a bind fail."""
+    import jax
+
+    from ..ops.registry import get_op
+
+    out = {}
+    for node in sym._topo_nodes():
+        if node.op is None:
+            sd = known.get(node.name)
+            out[id(node)] = None if sd is None else [
+                jax.ShapeDtypeStruct(tuple(sd[0]), sd[1])]
+            continue
+        in_structs = []
+        ok = True
+        for (inp, i) in node.inputs:
+            s = out.get(id(inp))
+            if not s or i >= len(s) or s[i] is None:
+                ok = False
+                break
+            in_structs.append(s[i])
+        if not ok:
+            out[id(node)] = None
+            continue
+        info = get_op(node.op)
+
+        def f(*arrs, _info=info, _attrs=node.attrs):
+            o = _info.fn(*arrs, **_attrs)
+            return o if isinstance(o, tuple) else (o,)
+
+        try:
+            out[id(node)] = list(jax.eval_shape(f, *in_structs))
+        except Exception:
+            out[id(node)] = None
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the trace-guided rewrite driver
+# ---------------------------------------------------------------------------
+
+
+def apply_fusion(sym, fusion=None, known=None):
+    """Run the pattern registry over ``sym`` under a fusion plan.
+
+    ``fusion`` is anything :func:`mxnet_tpu.fusion_cost.resolve_fusion`
+    accepts (None defers to ``MXNET_FUSION``); ``known`` maps bound
+    variable names to ``(shape, dtype)`` so cost-table decisions can be
+    made per concrete site shape.  Returns ``(fused_sym, fired)`` where
+    ``fired`` is a list of ``(pattern, site_name, key)`` — empty when
+    the plan is off or nothing matched.  Per fired rewrite a telemetry
+    counter (``mxnet_tpu_fusion_rewrites_total{pattern}``) and a trace
+    annotation (``fusion:<pattern>`` span) are emitted."""
+    from .. import fusion_cost as _fc
+
+    plan = _fc.resolve_fusion(fusion)
+    if plan is None:
+        return sym, []
+    fired = []
+    structs = None  # per-graph cache: recompute only after a rewrite
+    for pattern in _PATTERNS.values():
+        if not plan.wants(pattern.name):
+            continue
+        if not plan.force and plan.table is None and \
+                not pattern.default_on:
+            continue  # nothing could fire: skip the matcher entirely
+        sites = pattern.plan(sym)
+        if not sites:
+            continue
+        if structs is None and plan.needs_shapes() and known:
+            structs = _node_structs(sym, known)
+        decisions = {}
+        any_fire = False
+        for hid, site in sites.items():
+            key = pattern.site_key(site, structs)
+            ok = plan.decide(pattern.name, pattern.default_on, key)
+            decisions[hid] = (ok, key)
+            any_fire = any_fire or ok
+        if not any_fire:
+            continue
+
+        def emit(node, ins, sub, _p=pattern, _sites=sites,
+                 _dec=decisions):
+            d = _dec.get(id(node))
+            if d is None or not d[0]:
+                return None
+            out = _p.emit(node, ins, sub, _sites[id(node)])
+            if out is not None:
+                fired.append((_p.name, node.name, d[1]))
+            return out
+
+        sym = rewrite_graph(sym, emit)
+        structs = None  # graph changed: stale node ids
+    for name, site_name, key in fired:
+        _fc.note_fired(name, site_name, key)
+    return sym, fired
+
+
+# ---------------------------------------------------------------------------
+# registered patterns
+# ---------------------------------------------------------------------------
+
+
+def _head_act(node):
+    """act_type for an Activation/unary-activation head, else None."""
+    if node.op == "Activation":
+        act = node.attrs.get("act_type", "relu") or "relu"
+        return act if act in _FUSABLE_ACTS else None
+    if node.op in _UNARY_ACTS:
+        return node.op
+    return None
+
+
+def _fusable_inner(node, entry, ops, consumers, entries):
+    """The producer behind ``entry`` if it is an ``ops`` node safe to
+    collapse (single consumer, not a graph output, first output)."""
+    src, oi = entry
+    if oi != 0 or src.op not in ops:
+        return None
+    if id(src) in entries or len(consumers.get(id(src), ())) != 1:
+        return None
+    return src
+
+
+def _norm_act_sites(sym):
+    """BatchNorm -> activation chains the conv fusion cannot reach."""
+    nodes = sym._topo_nodes()
+    consumers = _consumer_map(nodes)
+    entries = _entry_ids(sym)
+    sites = {}
+    for head in nodes:
+        act = _head_act(head)
+        if act is None or not head.inputs:
+            continue
+        bn = _fusable_inner(head, head.inputs[0], ("BatchNorm",),
+                            consumers, entries)
+        if bn is None or pbool(bn.attrs.get("output_mean_var")):
+            continue
+        if not all(_is_plain_var(n) for (n, _i) in bn.inputs[1:]):
+            continue
+        sites[id(head)] = {"bn": bn, "act": act, "data": bn.inputs[0]}
+    return sites
+
+
+def _norm_act_emit(node, ins, sub, site):
+    bn = site["bn"]
+    attrs = dict(bn.attrs)
+    attrs["act_type"] = site["act"]
+    return S._invoke_sym("_contrib_norm_act",
+                         [sub(e) for e in bn.inputs], attrs,
+                         name=node.name)
+
+
+def _add_act_sites(sym):
+    """(elemwise|broadcast)_add -> activation (bias add / residual
+    join)."""
+    nodes = sym._topo_nodes()
+    consumers = _consumer_map(nodes)
+    entries = _entry_ids(sym)
+    sites = {}
+    for head in nodes:
+        act = _head_act(head)
+        if act is None or not head.inputs:
+            continue
+        add = _fusable_inner(head, head.inputs[0], _ADD_OPS,
+                             consumers, entries)
+        if add is None:
+            continue
+        sites[id(head)] = {"add": add, "act": act, "data": add.inputs[0]}
+    return sites
+
+
+def _add_act_emit(node, ins, sub, site):
+    add = site["add"]
+    return S._invoke_sym("_contrib_add_act",
+                         [sub(add.inputs[0]), sub(add.inputs[1])],
+                         {"act_type": site["act"]}, name=node.name)
+
+
+def _act_scale_add_sites(sym):
+    """activation -> scale (tensor or scalar) -> add/residual-add."""
+    nodes = sym._topo_nodes()
+    consumers = _consumer_map(nodes)
+    entries = _entry_ids(sym)
+    sites = {}
+    for head in nodes:
+        if head.op not in _ADD_OPS:
+            continue
+        for add_pos in (0, 1):
+            mul = _fusable_inner(head, head.inputs[add_pos],
+                                 _MUL_OPS + ("_mul_scalar",),
+                                 consumers, entries)
+            if mul is None:
+                continue
+            act_node = None
+            mul_pos = 0
+            for p in range(len(mul.inputs)):
+                cand = _fusable_inner(
+                    mul, mul.inputs[p],
+                    ("Activation",) + _UNARY_ACTS, consumers, entries)
+                if cand is not None and _head_act(cand) is not None:
+                    act_node, mul_pos = cand, p
+                    break
+            if act_node is None:
+                continue
+            sites[id(head)] = {
+                "mul": mul, "act_node": act_node,
+                "act": _head_act(act_node), "add_pos": add_pos,
+                "mul_pos": mul_pos, "data": act_node.inputs[0]}
+            break
+    return sites
+
+
+def _act_scale_add_emit(node, ins, sub, site):
+    mul, act_node = site["mul"], site["act_node"]
+    data_s = sub(act_node.inputs[0])
+    add_other = sub(node.inputs[1 - site["add_pos"]])
+    attrs = {"act_type": site["act"]}
+    if mul.op == "_mul_scalar":
+        attrs["scalar"] = mul.attrs.get("scalar", 1.0)
+        inputs = [data_s, add_other]
+    else:
+        inputs = [data_s, sub(mul.inputs[1 - site["mul_pos"]]),
+                  add_other]
+    return S._invoke_sym("_contrib_act_scale_add", inputs, attrs,
+                         name=node.name)
+
+
+def _layer_norm_sites(sym):
+    sites = {}
+    for node in sym._topo_nodes():
+        if node.op != "LayerNorm" or \
+                pbool(node.attrs.get("output_mean_var")):
+            continue
+        sites[id(node)] = {"ln": node, "data": node.inputs[0],
+                           "axis": pint(node.attrs.get("axis"), -1)}
+    return sites
+
+
+def _layer_norm_emit(node, ins, sub, site):
+    return S._invoke_sym("_contrib_layer_norm_fused", ins,
+                         dict(node.attrs), name=node.name)
+
+
+# -- canonical micro-benchmark / parity chains ------------------------------
+
+
+def _bb_conv_bn_relu(shape):
+    data = S.var("data")
+    c = S._invoke_sym("Convolution", [data],
+                      {"kernel": (3, 3), "num_filter": max(shape[1], 4),
+                       "pad": (1, 1), "no_bias": True}, name="conv0")
+    b = S._invoke_sym("BatchNorm", [c], {"fix_gamma": False}, name="bn0")
+    r = S._invoke_sym("Activation", [b], {"act_type": "relu"},
+                      name="relu0")
+    return r, {"data": shape}
+
+
+def _bb_norm_act(shape):
+    data = S.var("data")
+    b = S._invoke_sym("BatchNorm", [data], {"fix_gamma": False},
+                      name="bn0")
+    r = S._invoke_sym("Activation", [b], {"act_type": "relu"},
+                      name="relu0")
+    return r, {"data": shape}
+
+
+def _bb_add_act(shape):
+    a, b = S.var("data"), S.var("residual")
+    s = S._invoke_sym("broadcast_add", [a, b], {}, name="add0")
+    r = S._invoke_sym("Activation", [s], {"act_type": "relu"},
+                      name="relu0")
+    return r, {"data": shape, "residual": shape}
+
+
+def _bb_act_scale_add(shape):
+    a, res = S.var("data"), S.var("residual")
+    g = S.var("scale")
+    y = S._invoke_sym("Activation", [a], {"act_type": "relu"},
+                      name="act0")
+    y = S._invoke_sym("broadcast_mul", [y, g], {}, name="mul0")
+    y = S._invoke_sym("broadcast_add", [y, res], {}, name="add0")
+    return y, {"data": shape, "residual": shape,
+               "scale": (shape[-1],)}
+
+
+def _bb_layer_norm(shape):
+    data = S.var("data")
+    y = S._invoke_sym("LayerNorm", [data], {"axis": -1}, name="ln0")
+    return y, {"data": shape}
+
+
+register_pattern(FusionPattern(
+    "conv_bn_relu", _cbr_sites, _cbr_emit, default_on=False,
+    doc="Convolution -> BatchNorm [-> relu] into _contrib_conv_bn_relu "
+        "(VJP recomputes the normalized activation)",
+    bench_builder=_bb_conv_bn_relu,
+    bench_shapes=((8, 16, 28, 28), (4, 32, 56, 56))))
+
+register_pattern(FusionPattern(
+    "norm_act", _norm_act_sites, _norm_act_emit, default_on=False,
+    doc="BatchNorm -> activation into _contrib_norm_act (checkpointed "
+        "normalize+activate tail; covers BN sites behind shared conv "
+        "outputs)",
+    bench_builder=_bb_norm_act,
+    bench_shapes=((8, 32, 28, 28), (16, 64, 14, 14))))
+
+register_pattern(FusionPattern(
+    "act_scale_add", _act_scale_add_sites, _act_scale_add_emit,
+    default_on=True,
+    doc="activation -> scale -> add/residual-add chain into "
+        "_contrib_act_scale_add (identical math, one node)",
+    bench_builder=_bb_act_scale_add,
+    bench_shapes=((256, 1024), (64, 4096))))
+
+register_pattern(FusionPattern(
+    "add_act", _add_act_sites, _add_act_emit, default_on=True,
+    doc="add -> activation (bias+act / residual join) into "
+        "_contrib_add_act (identical math, one node)",
+    bench_builder=_bb_add_act,
+    bench_shapes=((256, 1024), (64, 4096))))
+
+register_pattern(FusionPattern(
+    "layer_norm_fast", _layer_norm_sites, _layer_norm_emit,
+    default_on=False,
+    doc="LayerNorm into _contrib_layer_norm_fused (one-pass E[x^2] "
+        "statistics, fp32 accumulation; the attention-path "
+        "normalization)",
+    bench_builder=_bb_layer_norm,
+    bench_shapes=((64, 1024), (256, 4096), (32, 128, 512))))
+
+
+# ---------------------------------------------------------------------------
+# per-shape micro-benchmark (autotune / bench_fusion / tests)
+# ---------------------------------------------------------------------------
+
+
+def microbench(pattern_name, shape, iters=20, warmup=3, grad=True,
+               rng=None, repeats=5):
+    """Measure one pattern's canonical chain fused vs unfused at
+    ``shape`` on the current backend.
+
+    Binds two executors over the same values — stock graph vs the graph
+    with only ``pattern_name`` force-applied — and times forward
+    (inference) and forward+backward (training) loops.  Timing runs
+    ``repeats`` blocks of ``iters`` calls, fused and unfused blocks
+    INTERLEAVED, and scores the per-executor minimum: a background
+    CPU spike lands on both sides or neither, instead of silently
+    flipping the decision (the shared-container harness measured 3x
+    run-to-run swings with one-shot timing).  Returns a dict with
+    ``{fused,unfused}_{fwd,train}_ms``, ``speedup`` (training, the
+    cost-table decision basis), ``speedup_infer``, and ``fired``
+    (False means the matcher found no site — a registry bug the guard
+    test catches)."""
+    import time
+
+    import jax
+
+    from ..context import cpu as _cpu_ctx
+
+    pattern = get_pattern(pattern_name)
+    if pattern.bench_builder is None:
+        raise MXNetError("pattern %r has no bench_builder" % pattern_name)
+    rng = rng or np.random.RandomState(0)
+    chain, feeds = pattern.bench_builder(tuple(shape))
+    loss = S._invoke_sym("sum", [chain], {}, name="loss")
+    fused_sym, fired = apply_fusion(loss, pattern_name)
+
+    vals = {n: rng.rand(*s).astype(np.float32) + 0.5
+            for n, s in feeds.items()}
+
+    def bind(sym_):
+        exe = sym_.simple_bind(ctx=_cpu_ctx(), fusion="off",
+                               grad_req="write" if grad else "null",
+                               **feeds)
+        import jax.numpy as jnp
+
+        for n, a in exe.arg_dict.items():
+            if n in vals:
+                a._rebind(jnp.asarray(vals[n]))
+            else:
+                vals[n] = rng.rand(*a.shape).astype(np.float32) + 0.5
+                a._rebind(jnp.asarray(vals[n]))
+        for n, a in exe.aux_dict.items():
+            v = vals.setdefault(
+                n, rng.rand(*a.shape).astype(np.float32) + 0.5)
+            a._rebind(jnp.asarray(v))
+        return exe
+
+    def fwd_block(exe, n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            exe.forward(is_train=False)
+        jax.block_until_ready([o._data for o in exe.outputs])
+        return (time.perf_counter() - t0) / n * 1e3
+
+    def train_block(exe, n):
+        t0 = time.perf_counter()
+        for _ in range(n):
+            exe.forward(is_train=True)
+            exe.backward()
+        jax.block_until_ready([g._data for g in exe.grad_dict.values()])
+        return (time.perf_counter() - t0) / n * 1e3
+
+    def measure(block, exes, target_block_ms=40.0):
+        # warmup both (compile + caches), size the timed block so it
+        # spans >= target_block_ms (sub-ms kernels would otherwise be
+        # dominated by scheduler jitter), then interleave the blocks
+        for exe in exes:
+            for _ in range(max(1, warmup)):
+                block(exe, 1)
+        t1 = max(min(block(exe, 1) for exe in exes), 1e-3)
+        n = max(iters, int(target_block_ms / t1) + 1)
+        best = [float("inf")] * len(exes)
+        for _ in range(max(1, repeats)):
+            for i, exe in enumerate(exes):
+                best[i] = min(best[i], block(exe, n))
+        return best
+
+    out = {"pattern": pattern_name, "shape": list(shape),
+           "fired": bool(fired)}
+    exe_u, exe_f = bind(loss), bind(fused_sym)
+    out["unfused_fwd_ms"], out["fused_fwd_ms"] = measure(
+        fwd_block, (exe_u, exe_f))
+    if grad:
+        out["unfused_train_ms"], out["fused_train_ms"] = measure(
+            train_block, (exe_u, exe_f))
+        out["speedup"] = out["unfused_train_ms"] / max(
+            out["fused_train_ms"], 1e-9)
+    else:
+        out["speedup"] = out["unfused_fwd_ms"] / max(
+            out["fused_fwd_ms"], 1e-9)
+    out["speedup_infer"] = out["unfused_fwd_ms"] / max(
+        out["fused_fwd_ms"], 1e-9)
+    # the table key MUST be derived through the same site_key path the
+    # bind-time gate uses (axis suffix and all), so tuned entries hit
+    sites = pattern.plan(loss)
+    known = {n: (s, np.float32) for n, s in feeds.items()}
+    structs = _node_structs(loss, known)
+    keys = {pattern.site_key(s, structs) for s in sites.values()}
+    keys.discard(None)
+    out["key"] = sorted(keys)[0] if keys else None
+    return out

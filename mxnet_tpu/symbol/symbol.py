@@ -50,11 +50,13 @@ AUX_INPUTS = {
     "BatchNorm": ("moving_mean", "moving_var"),
     "SyncBatchNorm": ("moving_mean", "moving_var"),
     "_contrib_conv_bn_relu": ("moving_mean", "moving_var"),
+    "_contrib_norm_act": ("moving_mean", "moving_var"),
 }
 
 # ops that return (out, batch_mean, batch_var) and whose bound moving
 # stats receive the momentum update in train mode (_build_fn)
-_MOVING_STAT_OPS = ("BatchNorm", "SyncBatchNorm", "_contrib_conv_bn_relu")
+_MOVING_STAT_OPS = ("BatchNorm", "SyncBatchNorm", "_contrib_conv_bn_relu",
+                    "_contrib_norm_act")
 
 # canonical input names per op for auto-created variables
 _INPUT_NAMES = {
@@ -65,7 +67,10 @@ _INPUT_NAMES = {
     # conv bias LAST so the aux positions are bias-independent
     "_contrib_conv_bn_relu": ("data", "weight", "gamma", "beta",
                               "moving_mean", "moving_var", "bias"),
+    "_contrib_norm_act": ("data", "gamma", "beta", "moving_mean",
+                          "moving_var"),
     "LayerNorm": ("data", "gamma", "beta"),
+    "_contrib_layer_norm_fused": ("data", "gamma", "beta"),
     "InstanceNorm": ("data", "gamma", "beta"),
     "Embedding": ("data", "weight"),
     "LeakyReLU": ("data", "gamma"),
@@ -465,7 +470,7 @@ class Symbol:
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
                     stype_dict=None, group2ctx=None, shared_arg_names=None,
                     shared_exec=None, shared_buffer=None, remat_policy=None,
-                    **kwargs):
+                    fusion=None, **kwargs):
         from ..executor import Executor
         from ..ndarray.ndarray import zeros as nd_zeros
         from ..context import current_context
@@ -486,11 +491,12 @@ class Symbol:
         aux = {n: nd_zeros(s, ctx=ctx)
                for n, s in zip(self.list_auxiliary_states(), aux_shapes)}
         return Executor(self, ctx, args, args_grad, grad_req, aux,
-                        shared_exec=shared_exec, remat_policy=remat_policy)
+                        shared_exec=shared_exec, remat_policy=remat_policy,
+                        fusion=fusion)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None,
-             remat_policy=None):
+             remat_policy=None, fusion=None):
         from ..executor import Executor
 
         arg_names = self.list_arguments()
@@ -503,7 +509,7 @@ class Symbol:
             aux_states = dict(zip(aux_names, aux_states))
         return Executor(self, ctx, args or {}, args_grad or {}, grad_req,
                         aux_states or {}, shared_exec=shared_exec,
-                        remat_policy=remat_policy)
+                        remat_policy=remat_policy, fusion=fusion)
 
     # gradient: returns symbolic grad graph — TPU-native answer is vjp at
     # executor level; provided for API parity on simple cases.
@@ -614,7 +620,7 @@ def _deduce_param_shape(node, pos, input_name, node_out_shapes, shapes):
             return (data_shape[1], nf // ng) + k
         if input_name == "bias":
             return (nf,)
-    elif op in ("BatchNorm", "SyncBatchNorm"):
+    elif op in ("BatchNorm", "SyncBatchNorm", "_contrib_norm_act"):
         ax = pint(attrs.get("axis"), 1)
         c = data_shape[ax]
         return (c,)
@@ -627,7 +633,7 @@ def _deduce_param_shape(node, pos, input_name, node_out_shapes, shapes):
         if input_name in ("gamma", "beta", "moving_mean", "moving_var",
                           "bias"):
             return (nf,)
-    elif op in ("LayerNorm",):
+    elif op in ("LayerNorm", "_contrib_layer_norm_fused"):
         ax = pint(attrs.get("axis"), -1)
         return (data_shape[ax],)
     elif op == "InstanceNorm":

@@ -492,6 +492,11 @@ TRAIN_MFU = gauge(
     "Model FLOPs utilization: step_flops / step_seconds / peak_flops "
     "(peak from set_peak_flops, MXNET_PEAK_TFLOPS, or docs/"
     "mfu_probe.json).")
+FUSION_REWRITES = counter(
+    "mxnet_tpu_fusion_rewrites_total",
+    "Graph-fusion rewrites fired at bind/hybridize/trace time, by "
+    "pattern (symbol/fusion.py registry; gated by the shape-keyed "
+    "cost table).", ("pattern",))
 
 # XLA compile path (fed by the jax.monitoring bridge)
 COMPILE_SECONDS = histogram(

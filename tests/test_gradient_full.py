@@ -441,6 +441,12 @@ COVERED = {
     "_contrib_conv_bn_relu": "tests/test_graph_fusion.py (fused-vs-"
                              "unfused conv/BN/relu grads + moving-stat "
                              "parity)",
+    "_contrib_add_act": "tests/test_fusion_patterns.py (per-pattern "
+                        "fused-vs-unfused fwd+grad parity)",
+    "_contrib_act_scale_add": "tests/test_fusion_patterns.py",
+    "_contrib_norm_act": "tests/test_fusion_patterns.py (grads + "
+                         "moving-stat parity)",
+    "_contrib_layer_norm_fused": "tests/test_fusion_patterns.py",
     "_image_to_tensor": "test_image_op_gradients in this file",
     "_image_normalize": "test_image_op_gradients in this file",
     "SoftmaxOutput": "test_loss_head_gradients_analytic in this file",

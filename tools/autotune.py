@@ -1,0 +1,191 @@
+"""Trace-guided fusion autotuner: measure fused-vs-unfused per shape,
+persist the shape-keyed cost table `symbol/fusion.py` consults at bind.
+
+Tuning replays the PR 5 unified timeline to rank where the time and
+HBM traffic actually go, then micro-benchmarks every registered fusion
+pattern's canonical chain (``FusionPattern.bench_builder``) fused vs
+unfused per input shape on the *current* backend, and writes the table
+atomically (``checkpoint.atomic_write``)::
+
+    python tools/autotune.py --out docs/fusion_cost_cpu.json \
+        [--trace trace.json] [--patterns add_act,layer_norm_fast] \
+        [--shapes 64x1024 256x4096] [--iters 20]
+
+``--trace`` takes a ``tracing.export_trace`` / ``profiler.dump()`` /
+flight-recorder artifact; its op-timeline ranking (total time + est.
+HBM bytes from the XLA cost table — the same view as
+``trace_view.py --top-ops``) is printed and embedded in the table meta
+so a tuning run documents *why* those rewrites matter on that run.
+
+Validation mode mirrors telemetry_dump's behavior — nonzero exit on
+malformed input, loud but zero on stale entries::
+
+    python tools/autotune.py --check table.json [--max-age-days 90]
+"""
+import argparse
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))  # trace_view (shared ranking)
+
+
+def log(msg):
+    print("[autotune] %s" % msg, file=sys.stderr, flush=True)
+
+
+def rank_trace_ops(path, top=10):
+    """(name, total_ms, calls, est_bytes|None) rows from a unified
+    chrome-trace export, most expensive first — the exact
+    ``trace_view.py --top-ops`` ranking (shared aggregation)."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except OSError as e:
+        raise SystemExit("%s: cannot read (%s)" % (path, e))
+    except ValueError as e:
+        raise SystemExit("%s: malformed JSON (%s)" % (path, e))
+    if not isinstance(data, dict) or "traceEvents" not in data:
+        raise SystemExit("%s: not a chrome trace (no 'traceEvents')" % path)
+    import trace_view
+
+    return trace_view.aggregate_op_costs(data)[:top]
+
+
+def run_check(path, max_age_days):
+    from mxnet_tpu import fusion_cost as fc
+
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except OSError as e:
+        print("%s: cannot read (%s)" % (path, e), file=sys.stderr)
+        return 1
+    except ValueError as e:
+        print("%s: malformed JSON (%s)" % (path, e), file=sys.stderr)
+        return 1
+    problems, stale = fc.validate_table(data, max_age_days=max_age_days)
+    entries = data.get("entries") if isinstance(data, dict) else None
+    n = len(entries) if isinstance(entries, dict) else 0
+    print("%s: %d entries, backend=%s, created=%s"
+          % (path, n, data.get("backend", "?") if isinstance(data, dict)
+             else "?",
+             data.get("created", "?") if isinstance(data, dict) else "?"))
+    for msg in stale:
+        print("STALE: %s" % msg)
+    for msg in problems:
+        print("MALFORMED: %s" % msg, file=sys.stderr)
+    return 1 if problems else 0
+
+
+def run_tune(args):
+    import mxnet_tpu  # noqa: F401  (backend init)
+    import jax
+
+    from mxnet_tpu import fusion_cost as fc
+    from mxnet_tpu.symbol import fusion as F
+
+    hot = None
+    if args.trace:
+        hot = rank_trace_ops(args.trace)
+        log("timeline ranking from %s (total ms | calls | est HBM bytes):"
+            % args.trace)
+        for name, ms, n, est in hot:
+            log("  %-40s %10.3f %6d %s"
+                % (name, ms, n, "%12.0f" % est if est else "           -"))
+
+    names = ([p for p in args.patterns.split(",") if p]
+             if args.patterns else F.list_patterns())
+    shapes = None
+    if args.shapes:
+        shapes = [tuple(int(d) for d in s.lower().split("x"))
+                  for s in args.shapes]
+
+    table = fc.CostTable(meta={
+        "version": fc.TABLE_VERSION,
+        "backend": jax.default_backend(),
+        "devices": [str(d) for d in jax.devices()],
+        "jax": jax.__version__,
+        "created": __import__("datetime").datetime.now(
+            __import__("datetime").timezone.utc).isoformat(
+                timespec="seconds"),
+        "iters": args.iters,
+    })
+    if hot:
+        table.meta["trace_hot_ops"] = [
+            {"name": n, "total_ms": round(ms, 3), "calls": c,
+             "est_hbm_bytes": est} for n, ms, c, est in hot]
+
+    for name in names:
+        pattern = F.get_pattern(name)
+        if pattern.bench_builder is None:
+            log("skip %s: no bench_builder" % name)
+            continue
+        for shape in (shapes or pattern.bench_shapes):
+            if len(shape) < 2:
+                log("skip %s @ %s: chain needs >=2 dims" % (name, shape))
+                continue
+            try:
+                res = F.microbench(name, shape, iters=args.iters,
+                                   grad=not args.no_grad)
+            except Exception as e:
+                log("skip %s @ %s: %s" % (name, shape, e))
+                continue
+            if not res["fired"]:
+                log("WARNING: pattern %s did not match its own bench "
+                    "chain at %s" % (name, shape))
+                continue
+            extra = {"shape": list(shape),
+                     "fused_fwd_ms": round(res["fused_fwd_ms"], 6),
+                     "unfused_fwd_ms": round(res["unfused_fwd_ms"], 6),
+                     "speedup_infer": round(res["speedup_infer"], 4)}
+            fused = res.get("fused_train_ms", res["fused_fwd_ms"])
+            unfused = res.get("unfused_train_ms", res["unfused_fwd_ms"])
+            e = table.add(res["key"], fused, unfused, **extra)
+            log("%-48s fused %8.3f ms  unfused %8.3f ms  speedup %.2fx"
+                % (res["key"], fused, unfused, e["speedup"]))
+
+    fc.save_table(args.out, table)
+    fires = sum(1 for e in table.entries.values()
+                if e["speedup"] >= fc.SPEEDUP_FIRE)
+    slower = sum(1 for e in table.entries.values()
+                 if e["speedup"] < fc.SPEEDUP_KEEP)
+    log("wrote %s: %d entries (%d fire >=%.2fx, %d measured slower -> "
+        "suppressed)" % (args.out, len(table.entries), fires,
+                         fc.SPEEDUP_FIRE, slower))
+    log("activate with MXNET_FUSION_TUNE=%s (or "
+        "mxnet_tpu.config.fusion_cost_table(%r))" % (args.out, args.out))
+    return 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Measure fused-vs-unfused per shape and write the "
+                    "fusion cost table (or --check an existing one)")
+    p.add_argument("--out", help="cost-table JSON to write (tuning mode)")
+    p.add_argument("--check", metavar="TABLE",
+                   help="validate a cost-table JSON instead of tuning")
+    p.add_argument("--trace", help="chrome-trace export to rank hot ops "
+                                   "from (tracing.export_trace output)")
+    p.add_argument("--patterns", help="comma list (default: all "
+                                      "registered)")
+    p.add_argument("--shapes", nargs="*",
+                   help="shapes like 64x1024 (default: per-pattern "
+                        "bench_shapes)")
+    p.add_argument("--iters", type=int, default=20)
+    p.add_argument("--no-grad", action="store_true",
+                   help="time forward only (serving-shaped tables)")
+    p.add_argument("--max-age-days", type=float, default=90.0,
+                   help="--check: flag entries older than this")
+    args = p.parse_args(argv)
+    if args.check:
+        return run_check(args.check, args.max_age_days)
+    if not args.out:
+        p.error("--out is required in tuning mode (or use --check)")
+    return run_tune(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

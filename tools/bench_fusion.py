@@ -1,0 +1,112 @@
+"""Benchmark: fused vs unfused graphs for every registered fusion
+pattern (symbol/fusion.py registry), BENCH-comparable output.
+
+For each pattern x shape the canonical chain (the same
+``FusionPattern.bench_builder`` the autotuner and the tier-1 parity
+guard use) is bound twice — stock graph vs force-fused — and timed for
+forward (inference) and forward+backward (training).  One JSON line per
+measurement goes to stdout::
+
+    {"metric": "fusion_layer_norm_fast_256x4096_train_speedup",
+     "value": 1.72, "unit": "x", ...}
+
+plus a headline ``fusion_best_speedup`` line — train-mode only (the
+acceptance gate: >=1.10 fwd+bwd on at least one elementwise chain).
+Progress to stderr.
+
+    python tools/bench_fusion.py [--patterns a,b] [--shapes 64x1024 ...]
+        [--iters 30] [--json out.json]
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+_T0 = time.time()
+
+
+def log(msg):
+    print("[bench_fusion %6.1fs] %s" % (time.time() - _T0, msg),
+          file=sys.stderr, flush=True)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Measure fused-vs-unfused speedups per pattern/shape")
+    p.add_argument("--patterns", help="comma list (default: all)")
+    p.add_argument("--shapes", nargs="*",
+                   help="shapes like 64x1024 (default: per-pattern "
+                        "bench_shapes)")
+    p.add_argument("--iters", type=int, default=30)
+    p.add_argument("--json", help="also write all rows to this file")
+    args = p.parse_args(argv)
+
+    log("importing jax/mxnet_tpu")
+    import jax
+
+    import mxnet_tpu  # noqa: F401
+    from mxnet_tpu.symbol import fusion as F
+
+    log("devices=%s" % (jax.devices(),))
+    names = ([n for n in args.patterns.split(",") if n]
+             if args.patterns else F.list_patterns())
+    shapes = None
+    if args.shapes:
+        shapes = [tuple(int(d) for d in s.lower().split("x"))
+                  for s in args.shapes]
+
+    rows = []
+    best = None
+    for name in names:
+        pattern = F.get_pattern(name)
+        if pattern.bench_builder is None:
+            continue
+        for shape in (shapes or pattern.bench_shapes):
+            log("measuring %s @ %s" % (name, shape))
+            try:
+                res = F.microbench(name, shape, iters=args.iters)
+            except Exception as e:
+                log("skip %s @ %s: %s" % (name, shape, e))
+                continue
+            if not res["fired"]:
+                log("WARNING: %s did not match its own chain at %s"
+                    % (name, shape))
+                continue
+            tag = "%s_%s" % (name, "x".join(str(d) for d in shape))
+            row = {
+                "metric": "fusion_%s_train_speedup" % tag,
+                "value": round(res["speedup"], 3),
+                "unit": "x",
+                "fused_ms": round(res["fused_train_ms"], 4),
+                "unfused_ms": round(res["unfused_train_ms"], 4),
+                "infer_speedup": round(res["speedup_infer"], 3),
+                "key": res["key"],
+            }
+            rows.append(row)
+            print(json.dumps(row), flush=True)
+            # headline is TRAIN-ONLY: the acceptance gate is a
+            # training-path win, an inference-only win must not pass it
+            if best is None or res["speedup"] > best["value"]:
+                best = {"metric": "fusion_best_speedup",
+                        "value": round(res["speedup"], 3), "unit": "x",
+                        "pattern": name, "mode": "train",
+                        "shape": "x".join(str(d) for d in shape)}
+    if best is not None:
+        print(json.dumps(best), flush=True)
+        rows.append(best)
+    if args.json:
+        from mxnet_tpu.checkpoint import atomic_write
+
+        atomic_write(args.json, json.dumps(
+            {"backend": jax.default_backend(), "iters": args.iters,
+             "rows": rows}, indent=2))
+        log("wrote %s" % args.json)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -8,9 +8,13 @@ parents, ts-sorted events), and prints:
 
 * per-span-name aggregates (count, total/mean/max ms, errors),
 * per-device HBM watermarks from the counter track,
-* with ``--tree``, the span hierarchy of the slowest roots.
+* with ``--tree``, the span hierarchy of the slowest roots,
+* with ``--top-ops N``, the N most expensive op-timeline entries with
+  total time and estimated HBM bytes (XLA cost analysis x call count) —
+  the human-readable face of the ranking tools/autotune.py feeds on.
 
     python tools/trace_view.py trace.json [--top 20] [--tree]
+    python tools/trace_view.py trace.json --top-ops 15
     python tools/trace_view.py flight_recorder/flight-...-nonfinite-p1-1
 
 Exit status is nonzero on malformed input or violated invariants, so CI
@@ -119,6 +123,42 @@ def summarize(data, top):
             print("%-44s %14d %14d" % (dev, in_use, peak))
 
 
+def aggregate_op_costs(data):
+    """``(name, total_ms, calls, est_hbm_bytes|None)`` rows over the op
+    timeline, most expensive first.  est = per-program XLA 'bytes
+    accessed' x call count; None when the program has no cost-analysis
+    entry.  Profiler timeline ops only: span events cover their
+    children and would double-count (and dominate) the ranking.  The
+    single source of the ranking tools/autotune.py replays."""
+    agg = {}  # name -> [total_us, count]
+    for ev in data["traceEvents"]:
+        if ev.get("ph") != "X" or ev.get("cat") != "op":
+            continue
+        st = agg.setdefault(ev.get("name", "?"), [0.0, 0])
+        st[0] += ev.get("dur", 0.0)
+        st[1] += 1
+    costs = data.get("otherData", {}).get("xla_costs", {})
+    rows = []
+    for name, (tot_us, n) in agg.items():
+        ba = costs.get(name, {}).get("bytes_accessed")
+        est = ba * n if isinstance(ba, (int, float)) else None
+        rows.append((name, tot_us / 1e3, n, est))
+    rows.sort(key=lambda r: -r[1])
+    return rows
+
+
+def print_top_ops(data, n):
+    """The N most expensive timeline ops: total/mean ms and estimated
+    HBM bytes ('-' when the program has no cost-analysis entry)."""
+    print()
+    print("%-40s %7s %11s %11s %14s" % (
+        "op/program", "calls", "total(ms)", "mean(ms)", "est HBM bytes"))
+    for name, tot_ms, cnt, est in aggregate_op_costs(data)[:n]:
+        est_s = "%14.0f" % est if est is not None else "%14s" % "-"
+        print("%-40s %7d %11.3f %11.3f %s" % (
+            name, cnt, tot_ms, tot_ms / cnt, est_s))
+
+
 def print_tree(data, top):
     spans = _spans(data)
     by_id = {ev["args"]["span_id"]: ev for ev in spans
@@ -158,10 +198,15 @@ def main(argv=None):
                    help="rows per section (default 20)")
     p.add_argument("--tree", action="store_true",
                    help="print the span hierarchy of the slowest roots")
+    p.add_argument("--top-ops", type=int, default=0, metavar="N",
+                   help="print the N most expensive timeline ops with "
+                        "total time and est. HBM bytes")
     args = p.parse_args(argv)
     data = load_trace(args.path)
     problems = validate(data)
     summarize(data, args.top)
+    if args.top_ops:
+        print_top_ops(data, args.top_ops)
     if args.tree:
         print_tree(data, args.top)
     if problems:
