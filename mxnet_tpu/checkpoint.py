@@ -145,7 +145,7 @@ def atomic_write(path, data):
 # ---------------------------------------------------------------------------
 
 def retry(fn, retries=3, backoff=0.05, jitter=0.5, exceptions=(OSError,),
-          logger=None):
+          logger=None, deadline=None):
     """Wrap ``fn`` with bounded retries + exponential backoff + jitter.
 
     ``retries`` is the number of *re*-attempts after the first call (so
@@ -154,11 +154,22 @@ def retry(fn, retries=3, backoff=0.05, jitter=0.5, exceptions=(OSError,),
     a fleet of workers retrying a shared endpoint does not stampede in
     lockstep.  Only ``exceptions`` are retried — anything else
     propagates immediately.
+
+    ``deadline`` (seconds, measured from the first attempt of each
+    call) is an overall wall-clock budget: a re-attempt whose backoff
+    sleep would not fit inside the remaining budget is abandoned and
+    the last failure re-raised immediately.  A retry loop inside a
+    caller that itself has a timeout (a serving request deadline, a
+    download with an SLA) can therefore never outlive its caller's
+    budget by sleeping.
     """
     if retries < 0:
         raise ValueError("retries must be >= 0, got %r" % (retries,))
+    if deadline is not None and deadline < 0:
+        raise ValueError("deadline must be >= 0, got %r" % (deadline,))
 
     def wrapped(*args, **kwargs):
+        t0 = time.monotonic()
         delay = backoff
         for attempt in range(retries + 1):
             try:
@@ -167,6 +178,13 @@ def retry(fn, retries=3, backoff=0.05, jitter=0.5, exceptions=(OSError,),
                 if attempt == retries:
                     raise
                 sleep = delay * (1.0 + jitter * _pyrandom.random())
+                if deadline is not None and \
+                        time.monotonic() - t0 + sleep >= deadline:
+                    (logger or logging).warning(
+                        "retry budget exhausted after %s (deadline "
+                        "%.3fs): %s", getattr(fn, "__name__", fn),
+                        deadline, e)
+                    raise
                 (logger or logging).warning(
                     "retry %d/%d after %s: %s (sleeping %.3fs)",
                     attempt + 1, retries, getattr(fn, "__name__", fn), e,

@@ -151,6 +151,30 @@ FLAGS = {
         "base URL for gluon model_zoo weight downloads (file:// works "
         "for air-gapped mirrors); '' disables downloads "
         "(model_store.get_model_file)"),
+    "MXNET_HOME": (
+        os.path.join("~", ".mxnet"), str, "honored",
+        "data/cache root for gluon contrib dataset downloads "
+        "(gluon/contrib/data.py)"),
+    "MXNET_SERVING_QUEUE": (
+        "64", _pint, "honored",
+        "AsyncPredictor bounded request-queue depth (serving_async.py); "
+        "a full queue rejects non-blocking submits with a typed "
+        "Overloaded error instead of growing latency without bound"),
+    "MXNET_SERVING_DEADLINE_MS": (
+        "0", _pfloat, "honored",
+        "AsyncPredictor default per-request deadline in milliseconds "
+        "(0 = none): expired requests fail with DeadlineExceeded — in "
+        "the queue via the sweep, at dispatch pickup, or on late "
+        "completion — instead of silently blowing the client timeout"),
+    "MXNET_SERVING_MAX_INFLIGHT": (
+        "0", _pint, "honored",
+        "AsyncPredictor cap on admitted-but-uncompleted requests, "
+        "queued + claimed (0 = auto: queue depth + 2 x chain x B x "
+        "replicas — pipeline capacity in requests, so it binds when "
+        "dispatches are stuck, not before the queue); past it submits "
+        "shed with "
+        "Overloaded(reason='inflight') or block when backpressure is "
+        "requested"),
     "DMLC_ROLE": ("worker", str, "honored", "dist kvstore role"),
     "DMLC_PS_ROOT_URI": ("", str, "honored", "dist kvstore server host"),
     "DMLC_PS_ROOT_PORT": ("9091", _pint, "honored",
@@ -331,3 +355,19 @@ def enable_compile_cache(cache_dir=None, min_compile_time_secs=None):
         except Exception:
             pass
     return cache_dir
+
+
+def markdown_table():
+    """``docs/env_vars.md`` table body — regenerate that file with
+    ``python -m mxnet_tpu.config`` whenever a flag is added (the
+    tests/test_env_knobs.py guard fails until it is)."""
+    rows = ["| `%s` | %s | `%s` | %s |"
+            % (n, d[2], d[0] if d[0] != "" else "''",
+               d[3].replace("|", "\\|"))
+            for n, d in sorted(FLAGS.items())]
+    return "\n".join(["| knob | disposition | default | notes |",
+                      "| --- | --- | --- | --- |"] + rows)
+
+
+if __name__ == "__main__":
+    print(markdown_table())

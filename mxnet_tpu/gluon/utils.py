@@ -4,6 +4,7 @@ from __future__ import annotations
 
 import hashlib
 import math
+import time
 
 import numpy as np
 
@@ -91,7 +92,7 @@ def check_sha1(filename, sha1_hash):
 
 
 def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
-             verify_ssl=True):
+             verify_ssl=True, deadline=None):
     """Fetch ``url`` to ``path`` with bounded retries and an atomic
     final write.
 
@@ -100,7 +101,9 @@ def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
     leaves a truncated file at the destination, and the sha1 check runs
     *before* the file appears there, so a corrupt mirror response is
     retried instead of cached.  ``file://`` URLs work for air-gapped
-    mirrors (this environment has no network).
+    mirrors (this environment has no network).  ``deadline`` (seconds)
+    bounds the whole retry loop's wall clock: backoff sleeps never
+    outlive a caller's timeout budget (``checkpoint.retry``).
     """
     import os
 
@@ -120,10 +123,21 @@ def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
     dirname = os.path.dirname(os.path.abspath(fname))
     os.makedirs(dirname, exist_ok=True)
 
+    t0 = time.monotonic() if deadline is not None else None
+
     def _fetch():
         from urllib.request import urlopen
 
         kwargs = {}
+        if deadline is not None:
+            # the retry wrapper's deadline only gates backoff sleeps
+            # BETWEEN attempts; a hung connect/read inside an attempt
+            # must be bounded too or the budget means nothing
+            remaining = deadline - (time.monotonic() - t0)
+            if remaining <= 0:
+                raise OSError("download deadline (%.3fs) exhausted "
+                              "before attempt: %s" % (deadline, url))
+            kwargs["timeout"] = remaining
         if not verify_ssl and url.lower().startswith("https"):
             import ssl
 
@@ -148,7 +162,7 @@ def download(url, path=None, overwrite=False, sha1_hash=None, retries=5,
         return fname
 
     return retry(_fetch, retries=retries, backoff=0.5, jitter=0.5,
-                 exceptions=(OSError,))()
+                 exceptions=(OSError,), deadline=deadline)()
 
 
 def shape_is_known(shape):

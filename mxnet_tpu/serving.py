@@ -68,7 +68,8 @@ class Predictor:
     """
 
     def __init__(self, forward, params, chain=8, preprocess=None,
-                 postprocess=None, batch_shape=None, batch_dtype=None):
+                 postprocess=None, batch_shape=None, batch_dtype=None,
+                 device=None):
         import jax
         from jax import lax
 
@@ -78,8 +79,10 @@ class Predictor:
         self._postprocess = postprocess
         # commit every param to the device ONCE: host-resident params
         # would re-upload per call, paying the tunnel's per-transfer
-        # latency for each tensor on every dispatch
-        self._dev = jax.devices()[0]
+        # latency for each tensor on every dispatch.  ``device`` pins
+        # the replica to a specific mesh device (serving_async places
+        # one Predictor per device); default stays device 0.
+        self._dev = device if device is not None else jax.devices()[0]
         self._params = jax.tree_util.tree_map(
             lambda a: jax.device_put(a, self._dev), params)
         jax.block_until_ready(self._params)
@@ -120,9 +123,29 @@ class Predictor:
         self._batch_shape = tuple(batch_shape) if batch_shape else None
         self._batch_dtype = np.dtype(batch_dtype) if batch_dtype else None
 
+    @property
+    def chain(self):
+        """Microbatches fused per dispatch (compile-time constant)."""
+        return self._chain
+
+    @property
+    def batch_shape(self):
+        """The compiled per-batch shape contract (None until pinned)."""
+        return self._batch_shape
+
+    @property
+    def batch_dtype(self):
+        """The compiled batch dtype contract (None until pinned)."""
+        return self._batch_dtype
+
+    @property
+    def device(self):
+        """The jax device this replica's params are committed to."""
+        return self._dev
+
     @classmethod
     def from_block(cls, net, example_input, chain=8, preprocess=None,
-                   postprocess=None):
+                   postprocess=None, device=None):
         """Build from a gluon HybridBlock: traces the block's forward the
         same way CachedOp does (moving stats frozen — inference).
 
@@ -165,7 +188,7 @@ class Predictor:
         pred = cls(forward, param_arrays, chain=chain,
                    preprocess=preprocess, postprocess=postprocess,
                    batch_shape=tuple(x_nd.shape),
-                   batch_dtype=np.dtype(x_nd.dtype))
+                   batch_dtype=np.dtype(x_nd.dtype), device=device)
         return pred, jnp.asarray(x_nd._data)
 
     def _upload(self, b, request_id=None):
@@ -291,17 +314,36 @@ class Predictor:
             # would pay a tunnel round-trip per batch
             host = np.asarray(out)
             bs = self._batch_shape[0]
-            for i, (n, t0, sp) in enumerate(valid):
-                if t0 is not None:
-                    # latency = upload submission -> output on host
-                    _telemetry.SERVING_REQUEST_SECONDS.observe(
-                        _time.perf_counter() - t0)
-                    _telemetry.SERVING_IN_FLIGHT.dec()
-                    outstanding[0] -= 1
-                if sp is not None:
-                    sp.set(rows=n).end()
-                    live_spans.remove(sp)
-                yield host[i] if n == bs else host[i, :n]
+            pos = 0
+            try:
+                for i, (n, t0, sp) in enumerate(valid):
+                    # finalize BEFORE the yield: a consumer that breaks
+                    # mid-chunk (GeneratorExit lands on the yield below)
+                    # must not strand this request's gauge/span until
+                    # the blanket finally
+                    pos = i + 1
+                    if t0 is not None:
+                        # latency = upload submission -> output on host
+                        _telemetry.SERVING_REQUEST_SECONDS.observe(
+                            _time.perf_counter() - t0)
+                        _telemetry.SERVING_IN_FLIGHT.dec()
+                        outstanding[0] -= 1
+                    if sp is not None:
+                        sp.set(rows=n).end()
+                        live_spans.remove(sp)
+                    yield host[i] if n == bs else host[i, :n]
+            finally:
+                # abandoned mid-drain: the rest of the chunk was computed
+                # but never consumed — close its requests here (error:
+                # the client went away) so the exit path sees a clean
+                # gauge/span table no matter which chunk broke
+                for n, t0, sp in valid[pos:]:
+                    if t0 is not None:
+                        _telemetry.SERVING_IN_FLIGHT.dec()
+                        outstanding[0] -= 1
+                    if sp is not None:
+                        sp.set(rows=n, abandoned=True).end(error=True)
+                        live_spans.remove(sp)
 
         try:
             for b in batches:

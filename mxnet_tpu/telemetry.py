@@ -550,6 +550,43 @@ SERVING_REQUEST_ERRORS = counter(
     "failures land in request_id=\"overflow\" so sustained failure "
     "cannot grow the registry without bound).", ("kind", "request_id"))
 
+# async serving tier (serving_async.AsyncPredictor)
+SERVING_ASYNC_REQUESTS = counter(
+    "mxnet_tpu_serving_async_requests_total",
+    "Requests admitted past AsyncPredictor admission control.")
+SERVING_SHED = counter(
+    "mxnet_tpu_serving_shed_total",
+    "Requests rejected at admission by reason (queue = queue full, "
+    "inflight = in-flight cap, wait = estimated wait over SLO, "
+    "slo = burn-rate shedding, unhealthy = no healthy replica, "
+    "shutdown = predictor closed).", ("reason",))
+SERVING_DEADLINE_EXCEEDED = counter(
+    "mxnet_tpu_serving_deadline_exceeded_total",
+    "Requests failed by their deadline, by stage (queue = expired "
+    "waiting via the sweep, pickup = expired at batch-former pickup, "
+    "dispatch = expired while a replica was computing, completion = "
+    "result arrived too late).", ("stage",))
+SERVING_QUEUE_DEPTH = gauge(
+    "mxnet_tpu_serving_queue_depth",
+    "AsyncPredictor requests waiting in the bounded queue.")
+SERVING_QUEUE_WAIT_SECONDS = histogram(
+    "mxnet_tpu_serving_queue_wait_seconds",
+    "Admission to batch-former pickup wait per request.")
+SERVING_DISPATCH_ROWS = histogram(
+    "mxnet_tpu_serving_dispatch_rows",
+    "Valid rows packed into one replica dispatch by the batch former "
+    "(capacity = chain x batch rows).", buckets=BATCH_SIZE_BUCKETS)
+SERVING_REPLICA_EJECTIONS = counter(
+    "mxnet_tpu_serving_replica_ejections_total",
+    "Replicas ejected from AsyncPredictor rotation, by reason "
+    "(error = dispatch raised, stall = watchdog timeout).", ("reason",))
+SERVING_REPLICAS_HEALTHY = gauge(
+    "mxnet_tpu_serving_replicas_healthy",
+    "AsyncPredictor replicas currently accepting dispatches.")
+SERVING_REQUEST_RETRIES = counter(
+    "mxnet_tpu_serving_request_retries_total",
+    "Requests requeued onto a healthy replica after an ejection.")
+
 # device memory (sampled per train step by tracing.sample_device_memory)
 DEVICE_MEMORY_BYTES_IN_USE = gauge(
     "mxnet_tpu_device_memory_bytes_in_use",

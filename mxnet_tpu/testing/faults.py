@@ -18,9 +18,18 @@ modes the checkpoint tests drive:
   the tracing flight recorder).
 * :class:`FlakyCallable` — fails the first N calls then succeeds
   (drives the ``retry`` helper and download paths).
+* :class:`LatencySpike` — wraps a callable with an injected delay on a
+  chosen call window (a slow device / garbage-collection pause).
+* :class:`StallingCallable` — wraps a callable so chosen calls block on
+  an event until :meth:`~StallingCallable.release` (or raise) — the
+  stuck-replica scenario the serving watchdog must survive.
+* :func:`transient_device_put_failures` — context manager making the
+  first N ``jax.device_put`` calls raise, driving the serving upload
+  retry path.
 """
 from __future__ import annotations
 
+import contextlib
 import os
 import signal as _signal
 import threading
@@ -28,7 +37,8 @@ import time
 
 __all__ = ["FailingWriter", "failing_open", "truncate_file", "flip_bit",
            "corrupt_file", "poison_batch", "send_preemption",
-           "FlakyCallable"]
+           "FlakyCallable", "LatencySpike", "StallingCallable",
+           "transient_device_put_failures"]
 
 
 def poison_batch(arr, value=float("nan"), fraction=1.0):
@@ -140,6 +150,89 @@ def send_preemption(pid=None, sig=_signal.SIGTERM, delay=0.0):
                          daemon=True)
     t.start()
     return t
+
+
+class LatencySpike:
+    """Callable wrapper that sleeps ``delay`` seconds before delegating,
+    for calls ``start <= i < start + count`` (0-indexed; ``count=None``
+    = every call from ``start`` on) — a deterministic slow-device /
+    GC-pause injection for deadline and SLO tests."""
+
+    def __init__(self, fn, delay, start=0, count=None):
+        self._fn = fn
+        self.delay = float(delay)
+        self._start = int(start)
+        self._count = count if count is None else int(count)
+        self.calls = 0
+        self.spiked = 0
+
+    def __call__(self, *args, **kwargs):
+        i = self.calls
+        self.calls += 1
+        if i >= self._start and (self._count is None
+                                 or i < self._start + self._count):
+            self.spiked += 1
+            time.sleep(self.delay)
+        return self._fn(*args, **kwargs)
+
+
+class StallingCallable:
+    """Callable wrapper whose calls from number ``stall_after`` on
+    either block until :meth:`release` (``exc=None`` — the
+    hung-device stall a watchdog must detect) or raise ``exc`` (the
+    fail-fast replica fault).
+
+    ``stalled`` is set while a caller is blocked (wait on it for
+    deterministic test ordering); ``release()`` unblocks every current
+    and future call.  ``exc_on_release`` makes a blocked call raise
+    when unblocked instead of returning — the hang that ends in a
+    device error rather than a late result.
+    """
+
+    def __init__(self, fn, stall_after=0, exc=None, exc_on_release=None):
+        self._fn = fn
+        self._after = int(stall_after)
+        self._exc = exc
+        self._exc_on_release = exc_on_release
+        self.calls = 0
+        self.stalled = threading.Event()
+        self._released = threading.Event()
+
+    def release(self):
+        """Unblock all blocked and future calls (heal the device)."""
+        self._released.set()
+
+    def __call__(self, *args, **kwargs):
+        i = self.calls
+        self.calls += 1
+        if i >= self._after and not self._released.is_set():
+            if self._exc is not None:
+                raise self._exc
+            self.stalled.set()
+            self._released.wait()
+            self.stalled.clear()
+            if self._exc_on_release is not None:
+                raise self._exc_on_release
+        return self._fn(*args, **kwargs)
+
+
+@contextlib.contextmanager
+def transient_device_put_failures(failures, exc=None):
+    """Patch ``jax.device_put`` so its first ``failures`` calls raise
+    ``exc`` (default ``RuntimeError`` — the retryable transfer class),
+    then behave normally — the transient-transfer fault the serving
+    upload retry absorbs.  Yields the counting wrapper."""
+    import jax
+
+    exc = exc if exc is not None else RuntimeError(
+        "injected transient device_put failure")
+    wrapper = FlakyCallable(failures, fn=jax.device_put, exc=exc)
+    orig = jax.device_put
+    jax.device_put = wrapper
+    try:
+        yield wrapper
+    finally:
+        jax.device_put = orig
 
 
 class FlakyCallable:

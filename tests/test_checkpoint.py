@@ -67,6 +67,38 @@ def test_retry_flaky_then_success_and_exhaustion():
     assert bomb.calls == 1
 
 
+def test_retry_deadline_bounds_total_wall_clock():
+    """retry(deadline=) is an overall budget: a re-attempt whose backoff
+    sleep would overshoot it is abandoned immediately, so a retry loop
+    can never outlive its caller's timeout by sleeping."""
+    import time
+
+    # backoff (0.2 s) >> deadline (0.05 s): the first failure's sleep
+    # would overshoot -> raise NOW, no second attempt, no 0.2 s nap
+    dead = faults.FlakyCallable(10, value="never")
+    t0 = time.monotonic()
+    with pytest.raises(OSError):
+        ck.retry(dead, retries=50, backoff=0.2, jitter=0.0,
+                 deadline=0.05)()
+    assert time.monotonic() - t0 < 0.2
+    assert dead.calls == 1
+
+    # a roomy deadline changes nothing on the success path
+    flaky = faults.FlakyCallable(2, value="ok")
+    assert ck.retry(flaky, retries=5, backoff=0.001, deadline=30.0)() \
+        == "ok"
+    assert flaky.calls == 3
+
+    # deadline=0: strictly one attempt, never a sleep
+    one = faults.FlakyCallable(10, value="never")
+    with pytest.raises(OSError):
+        ck.retry(one, retries=5, backoff=0.001, deadline=0.0)()
+    assert one.calls == 1
+
+    with pytest.raises(ValueError):
+        ck.retry(lambda: None, deadline=-1.0)
+
+
 # ---------------------------------------------------------------------------
 # CheckpointManager: manifest, retention, corruption fallback, async
 # ---------------------------------------------------------------------------

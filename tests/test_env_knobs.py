@@ -1,0 +1,63 @@
+"""Guard: the MXNET_* knob surface stays declared and documented.
+
+Every ``MXNET_*`` environment variable referenced anywhere in
+``mxnet_tpu/`` source must be declared in ``config.FLAGS`` (one central
+row: parser, default, disposition, note) and mentioned in the docs —
+an undocumented knob added by a future PR fails here, not in a
+production postmortem.  ``docs/env_vars.md`` is the generated table;
+regenerate it with ``python -m mxnet_tpu.config``.
+"""
+import glob
+import os
+import re
+
+import mxnet_tpu.config as config
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_KNOB = re.compile(r"\bMXNET_[A-Z0-9_]+\b")
+
+
+def _source_knobs():
+    names = set()
+    for path in glob.glob(os.path.join(ROOT, "mxnet_tpu", "**", "*.py"),
+                          recursive=True):
+        with open(path, encoding="utf-8") as f:
+            names.update(_KNOB.findall(f.read()))
+    return names
+
+
+def _docs_text():
+    text = []
+    for path in glob.glob(os.path.join(ROOT, "docs", "*.md")) + \
+            [os.path.join(ROOT, "README.md")]:
+        with open(path, encoding="utf-8") as f:
+            text.append(f.read())
+    return "\n".join(text)
+
+
+def test_every_source_knob_is_declared_in_config():
+    undeclared = sorted(_source_knobs() - set(config.FLAGS))
+    assert not undeclared, (
+        "MXNET_* knobs referenced in mxnet_tpu/ source but not declared "
+        "in config.FLAGS (add a row with parser/default/disposition/"
+        "note): %s" % undeclared)
+
+
+def test_every_declared_knob_is_documented():
+    docs = _docs_text()
+    missing = sorted(k for k in config.FLAGS
+                     if k.startswith("MXNET_") and k not in docs)
+    assert not missing, (
+        "config.FLAGS knobs missing from docs/*.md and README.md "
+        "(regenerate docs/env_vars.md via python -m mxnet_tpu.config): "
+        "%s" % missing)
+
+
+def test_env_vars_doc_table_is_fresh():
+    with open(os.path.join(ROOT, "docs", "env_vars.md"),
+              encoding="utf-8") as f:
+        body = f.read()
+    missing = sorted(k for k in config.FLAGS if "`%s`" % k not in body)
+    assert not missing, (
+        "docs/env_vars.md table is stale — regenerate with "
+        "python -m mxnet_tpu.config; missing rows: %s" % missing)

@@ -181,3 +181,42 @@ def test_predictor_implicit_contract_warns_only_when_dtype_unpinned():
         pred = Predictor(lambda x, params: x + 1.0, [])
         list(pred.predict([b]))
     assert [x for x in w if "batch contract" in str(x.message)]
+
+
+def test_abandoned_stream_mid_drain_leaves_clean_state():
+    """Regression: a consumer that breaks mid-drain (GeneratorExit lands
+    on the yield inside one chunk's drain loop) must not strand the
+    unconsumed requests' in-flight gauge entries or leave their spans
+    open until some later postmortem — the drain path itself finalizes
+    them (serving.Predictor.predict drain finally)."""
+    import mxnet_tpu.telemetry as tel
+    import mxnet_tpu.tracing as tracing
+
+    pred = Predictor(lambda x, params: x * 2.0, [], chain=4,
+                     batch_shape=(4, 3), batch_dtype=np.float32)
+    batches = [np.full((4, 3), float(i), np.float32) for i in range(8)]
+    tel.enable()
+    tel.reset()
+    tracing.enable()
+    tracing.reset()
+    try:
+        gen = pred.predict(batches)
+        # chunk 1 dispatches after batch 4, chunk 2 after batch 8; the
+        # first next() is mid-drain of chunk 1 with 3 requests pending
+        first = next(gen)
+        np.testing.assert_allclose(first, batches[0] * 2.0)
+        gen.close()                       # client goes away mid-chunk
+        assert tel.SERVING_IN_FLIGHT.value() == 0
+        assert not tracing._active, "request spans left open"
+        evs = [e for e in tracing.chrome_trace_payload(
+            include_profiler=False)["traceEvents"]
+            if e.get("name") == "serving.request"]
+        assert len(evs) == 8, "every admitted request span must close"
+        abandoned = [e for e in evs
+                     if e.get("args", {}).get("abandoned")]
+        assert len(abandoned) == 3, abandoned
+    finally:
+        tracing.reset()
+        tracing.disable()
+        tel.reset()
+        tel.disable()

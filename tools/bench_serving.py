@@ -19,7 +19,18 @@ Timing follows docs/perf_notes.md methodology: the clock stops only
 after every output batch has been fetched to the host, which cannot
 complete before the device work has.
 
+A second mode, ``--load``, is the sustained open-loop harness for the
+async tier (docs/serving.md): Poisson arrivals at a swept target QPS
+against an AsyncPredictor, one BENCH-comparable JSON line per rate
+with p50/p99/p999 latency, shed rate, timeout rate, and goodput.
+Open-loop matters: a closed loop self-throttles when the server slows
+and hides exactly the overload regime the admission control exists
+for.
+
 Usage: python tools/bench_serving.py [--json docs/serving_bench.json]
+       python tools/bench_serving.py --load --qps 20,50,100 \
+           [--duration 5] [--deadline-ms 200] [--replicas 1] \
+           [--json docs/serving_load.json]
 """
 import argparse
 import json
@@ -35,6 +46,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 import mxnet_tpu as mx  # noqa: E402
 from mxnet_tpu.gluon.model_zoo import vision  # noqa: E402
 from mxnet_tpu.serving import Predictor, uint8_normalizer  # noqa: E402
+from mxnet_tpu.serving_async import (AsyncPredictor,  # noqa: E402
+                                     DeadlineExceeded, ServingError)
 
 
 def measure_link_bw(shape, chain=8, reps=2):
@@ -140,6 +153,121 @@ def run(batch=32, n_batches=32, chain=8, dtype="bfloat16", json_path=None):
     return results
 
 
+def _pctl(sorted_vals, q):
+    if not sorted_vals:
+        return None
+    i = min(len(sorted_vals) - 1, int(round(q * (len(sorted_vals) - 1))))
+    return sorted_vals[i]
+
+
+def _load_predictor(batch_rows, feat, replicas, chain):
+    """Small-MLP AsyncPredictor: the load harness measures queueing
+    dynamics (admission, deadlines, shed), not model FLOPs — a big model
+    would just move every sweep point into the same saturated regime."""
+    import jax
+
+    from mxnet_tpu.gluon import nn
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(64, activation="relu"), nn.Dense(8))
+    net.initialize()
+    example = np.random.rand(batch_rows, feat).astype(np.float32)
+    return AsyncPredictor.from_block(
+        net, example, replicas=replicas, chain=chain,
+        batch_window_ms=1.0), len(jax.devices())
+
+
+def run_load(qps_list, duration=5.0, batch_rows=8, feat=16, rows=1,
+             chain=8, replicas=1, deadline_ms=200.0, seed=0,
+             json_path=None):
+    """Open-loop Poisson load sweep against the async tier.
+
+    Per target QPS: submit ``rows``-row requests at exponential
+    inter-arrival times for ``duration`` seconds (never waiting on the
+    server — open loop), then join every future and report latency
+    percentiles over completions plus shed/timeout/error rates over
+    offered load.  One BENCH JSON line per rate.
+    """
+    from mxnet_tpu import telemetry as tel
+
+    tel.enable()
+    ap, n_devs = _load_predictor(batch_rows, feat, replicas, chain)
+    req = np.random.RandomState(seed).rand(rows, feat).astype(np.float32)
+    ap.predict(req, timeout=30)            # warm/compile off the clock
+    out = {"mode": "open-loop-poisson", "duration_s": duration,
+           "rows_per_request": rows, "batch_rows": batch_rows,
+           "chain": chain, "replicas": replicas, "devices": n_devs,
+           "deadline_ms": deadline_ms, "sweep": []}
+    try:
+        for qps in qps_list:
+            rng = np.random.RandomState(seed)
+            offered = shed = 0
+            inflight = []
+            start = time.monotonic()
+            next_t = start
+            end = start + duration
+            while next_t < end:
+                now = time.monotonic()
+                if now < next_t:
+                    time.sleep(next_t - now)
+                offered += 1
+                t0 = time.monotonic()
+                try:
+                    inflight.append(
+                        (ap.submit(req, deadline_ms=deadline_ms), t0))
+                except ServingError:
+                    shed += 1
+                next_t += rng.exponential(1.0 / qps)
+            lats, timeouts, errors = [], 0, 0
+            for fut, t0 in inflight:
+                try:
+                    fut.result(timeout=30)
+                    lats.append(fut.resolved_at - t0)
+                except DeadlineExceeded:
+                    timeouts += 1
+                except TimeoutError:
+                    # future unresolved after 30 s (e.g. --deadline-ms 0
+                    # past saturation): count it, keep the sweep's data
+                    timeouts += 1
+                    fut.cancel()
+                except ServingError:
+                    errors += 1
+            # settle before the next rate: leftover queued/claimed work
+            # from this rate must not contaminate the next measurement
+            settle_end = time.monotonic() + 10.0
+            while ap.stats()["inflight"] > 0 and \
+                    time.monotonic() < settle_end:
+                time.sleep(0.05)
+            lats.sort()
+            row = {
+                "target_qps": qps,
+                "offered": offered,
+                "offered_qps": round(offered / duration, 1),
+                "completed": len(lats),
+                "goodput_qps": round(len(lats) / duration, 1),
+                "shed": shed,
+                "shed_rate": round(shed / offered, 4),
+                "timeouts": timeouts,
+                "timeout_rate": round(timeouts / offered, 4),
+                "errors": errors,
+                "p50_ms": round(1e3 * _pctl(lats, 0.50), 2) if lats
+                else None,
+                "p99_ms": round(1e3 * _pctl(lats, 0.99), 2) if lats
+                else None,
+                "p999_ms": round(1e3 * _pctl(lats, 0.999), 2) if lats
+                else None,
+            }
+            out["sweep"].append(row)
+            print("BENCH_SERVING_LOAD " + json.dumps(row), flush=True)
+    finally:
+        ap.close(timeout=30)
+    if json_path:
+        with open(json_path, "w") as f:
+            json.dump(out, f, indent=1)
+        print("wrote", json_path)
+    return out
+
+
 if __name__ == "__main__":
     p = argparse.ArgumentParser()
     p.add_argument("--batch", type=int, default=32)
@@ -147,6 +275,21 @@ if __name__ == "__main__":
     p.add_argument("--chain", type=int, default=8)
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--json", default=None)
+    p.add_argument("--load", action="store_true",
+                   help="open-loop Poisson QPS sweep vs AsyncPredictor")
+    p.add_argument("--qps", default="20,50,100",
+                   help="comma-separated target QPS sweep (--load)")
+    p.add_argument("--duration", type=float, default=5.0)
+    p.add_argument("--deadline-ms", type=float, default=200.0)
+    p.add_argument("--replicas", type=int, default=1)
+    p.add_argument("--rows", type=int, default=1,
+                   help="rows per request (--load)")
     a = p.parse_args()
-    run(a.batch, a.n_batches, chain=a.chain, dtype=a.dtype,
-        json_path=a.json)
+    if a.load:
+        run_load([float(q) for q in a.qps.split(",")],
+                 duration=a.duration, chain=a.chain,
+                 replicas=a.replicas, deadline_ms=a.deadline_ms,
+                 rows=a.rows, json_path=a.json)
+    else:
+        run(a.batch, a.n_batches, chain=a.chain, dtype=a.dtype,
+            json_path=a.json)

@@ -176,6 +176,10 @@ def run(n_procs=2, dev_per_proc=4, json_path=None):
     losses = [ln for o in outs for ln in o.splitlines()
               if ln.startswith("MULTIHOST_LOSS")]
     result["collective_losses"] = losses
+    if not ok:
+        # raw worker output: callers (tests/test_multihost.py) classify
+        # environmental bootstrap/timeout failures vs real regressions
+        result["collective_outs"] = outs
     print("\n".join(losses) if ok else "COLLECTIVE FAILED:\n%s"
           % "\n".join(outs), flush=True)
 
