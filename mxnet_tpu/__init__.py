@@ -7,6 +7,23 @@ mx.cpu()/mx.gpu()/mx.tpu(), mx.io, mx.kvstore, ...).
 """
 import os as _os
 
+if _os.environ.get("MXNET_AOT", "0").lower() in ("1", "true", "yes",
+                                                 "on"):
+    # Serialized-executable mode (aot.py): jax 0.4.x XLA:CPU splits
+    # large modules across parallel-codegen object files and
+    # executable serialization captures only the entry module — the
+    # artifact then fails to load in every other process ("Symbols not
+    # found"), which an in-process save-time check cannot detect (the
+    # symbols resolve against the live process).  Forcing one codegen
+    # unit makes every artifact this process persists self-contained.
+    # Must land in the environment before XLA parses its flags, hence
+    # here at package import; runtime code quality is unchanged, only
+    # compile-time parallelism is.  No-op on non-CPU backends.
+    _flags = _os.environ.get("XLA_FLAGS", "")
+    if "xla_cpu_parallel_codegen_split_count" not in _flags:
+        _os.environ["XLA_FLAGS"] = \
+            (_flags + " --xla_cpu_parallel_codegen_split_count=1").strip()
+
 if _os.environ.get("MXNET_PLATFORM"):
     # Pin the jax backend before anything can initialize it.  Needed by
     # multi-process launchers (tools/launch.py): an accelerator plugin

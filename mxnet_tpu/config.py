@@ -103,6 +103,25 @@ FLAGS = {
                      "xla"),
         str, "honored",
         "directory backing the persistent compilation cache"),
+    "MXNET_AOT": (
+        "0", _pbool, "honored",
+        "ahead-of-time executable store (aot.py): jit'd hot paths "
+        "(Executor, CachedOp, ShardedTrainer.step, serving.Predictor) "
+        "lower+compile once and serialize the executable; later "
+        "processes deserialize instead of recompiling — kills the "
+        "~97 s bench.py cold start.  Per-site override via aot="),
+    "MXNET_AOT_DIR": (
+        os.path.join(os.path.expanduser("~"), ".cache", "mxnet_tpu",
+                     "aot"),
+        str, "honored",
+        "directory backing the AOT executable store (content-hash "
+        "keyed, digest-verified, version-gated; tools/prewarm.py "
+        "pre-populates and --check validates it)"),
+    "MXNET_AOT_MANIFEST": (
+        "1", _pbool, "honored",
+        "record every AOT-compiled executable's signature in the "
+        "store's manifest.jsonl so tools/prewarm.py --manifest can "
+        "rebuild and compile the whole workload ahead of rollout"),
     "MXNET_TRACE": (
         "0", _pbool, "honored",
         "hierarchical span tracing (tracing.py): step/request/checkpoint "
@@ -175,6 +194,17 @@ FLAGS = {
         "shed with "
         "Overloaded(reason='inflight') or block when backpressure is "
         "requested"),
+    "MXNET_SERVING_WARM_POOL": (
+        "0", _pint, "honored",
+        "AsyncPredictor default warm-pool size: N spare Predictor "
+        "replicas pre-built (through the AOT store when enabled) so a "
+        "replica ejection swaps a canary-verified spare in "
+        "automatically instead of waiting for operator heal()"),
+    "MXNET_SERVING_HEAL_PROBE": (
+        "0", _pfloat, "honored",
+        "seconds between auto-heal canary probes of ejected "
+        "AsyncPredictor replicas (0 = no probing): a probe dispatches "
+        "one known-good batch and re-admits the replica on success"),
     "DMLC_ROLE": ("worker", str, "honored", "dist kvstore role"),
     "DMLC_PS_ROOT_URI": ("", str, "honored", "dist kvstore server host"),
     "DMLC_PS_ROOT_PORT": ("9091", _pint, "honored",
@@ -276,6 +306,23 @@ def fusion_cost_table(table):
     from . import fusion_cost
 
     fusion_cost.set_cost_table(table)
+
+
+def enable_aot(store=True):
+    """Install the process-wide AOT executable store (same switch as
+    ``MXNET_AOT``/``MXNET_AOT_DIR``, callable after import): a store
+    directory path, ``True`` (default dir), or ``False`` to force AOT
+    off.  Per-site ``aot=`` arguments still override.
+
+    Call BEFORE the first compile when this process should *persist*
+    artifacts on CPU: enabling injects the codegen flag that keeps
+    serialized CPU executables self-contained, which XLA only honors
+    if its flags have not been parsed yet (``MXNET_AOT=1`` in the
+    environment gets it unconditionally right — the package bootstrap
+    sets the flag at import)."""
+    from . import aot
+
+    aot.set_store(store)
 
 
 def enable_telemetry(on=True):

@@ -34,11 +34,13 @@ __all__ = ["Executor"]
 
 class Executor:
     def __init__(self, symbol, ctx, args, args_grad, grad_req, aux_states,
-                 shared_exec=None, remat_policy=None, fusion=None):
+                 shared_exec=None, remat_policy=None, fusion=None,
+                 aot=None):
         import jax
 
         from .remat import resolve_policy
         from . import fusion_cost as _fc
+        from . import aot as _aot
 
         # validate eagerly so a typo'd policy fails at bind, not at the
         # first backward; None defers to MXNET_REMAT_POLICY
@@ -47,6 +49,10 @@ class Executor:
         # same contract for the fusion spec (None defers to MXNET_FUSION)
         fusion_plan = _fc.resolve_fusion(fusion)
         self._fusion = fusion
+        # AOT executable store (None defers to MXNET_AOT) — resolved at
+        # bind like the fusion plan, threaded below onto the jits
+        aot_store = _aot.resolve_aot(aot)
+        self._aot = aot
 
         self._symbol = symbol
         self._ctx = ctx or current_context()
@@ -126,6 +132,24 @@ class Executor:
             return outs, aux, grads
 
         self._jit_fwd_bwd = jax.jit(fwd_bwd)
+        if aot_store is not None:
+            # the graph-level decisions (fusion rewrites, remat policy)
+            # already reshape the lowered HLO, so they're in the key;
+            # the explicit tag is belt-and-braces for policy aliases
+            # that lower identically today but may not tomorrow
+            fp = "remat=%s|fusion=%s|fired=%s" % (
+                self._remat_policy or "", fusion if fusion is not None
+                else "", ",".join(map(str, self.fusion_fired)))
+            name = getattr(symbol, "name", "sym")
+            self._jit_fwd_infer = _aot.AOTFunction(
+                self._jit_fwd_infer, "executor:%s:fwd_infer" % name,
+                aot_store, fingerprint_extra=fp, manifest_kind="executor")
+            self._jit_fwd_train = _aot.AOTFunction(
+                self._jit_fwd_train, "executor:%s:fwd_train" % name,
+                aot_store, fingerprint_extra=fp, manifest_kind="executor")
+            self._jit_fwd_bwd = _aot.AOTFunction(
+                self._jit_fwd_bwd, "executor:%s:fwd_bwd" % name,
+                aot_store, fingerprint_extra=fp, manifest_kind="executor")
         self._cot_struct_cache = {}  # bound-shape key -> output structs
 
     # ------------------------------------------------------------------
@@ -199,8 +223,12 @@ class Executor:
             if out_structs is None:
                 import jax
 
+                from . import aot as _aot
+
+                # abstract eval must see the raw jit — a serialized
+                # executable cannot be traced
                 out_structs, _aux_structs = jax.eval_shape(
-                    self._jit_fwd_train, values, rng)
+                    _aot.unwrap(self._jit_fwd_train), values, rng)
                 self._cot_struct_cache[key] = out_structs
             cots = tuple(jnp.ones(o.shape, o.dtype) for o in out_structs)
         else:
@@ -276,7 +304,7 @@ class Executor:
         return Executor(self._symbol, self._ctx, new_args, new_grads,
                         self._grad_req, new_aux,
                         remat_policy=self._remat_policy,
-                        fusion=self._fusion)
+                        fusion=self._fusion, aot=self._aot)
 
     def set_monitor_callback(self, callback, monitor_all=False):
         self.monitor_callback = callback

@@ -374,11 +374,12 @@ class CachedOp:
     cached_op.cc via MXCreateCachedOpEx)."""
 
     def __init__(self, block, static_alloc=False, static_shape=False,
-                 remat_policy=None, fusion=None):
+                 remat_policy=None, fusion=None, aot=None):
         import jax
 
         from ..remat import resolve_policy
         from .. import fusion_cost as _fc
+        from .. import aot as _aot
 
         self._block = block
         self._jits = {}  # is_train -> jitted fn
@@ -399,6 +400,25 @@ class CachedOp:
         # which re-resolves per bind.
         _fc.resolve_fusion(fusion)
         self._fusion = fusion
+        # AOT executable store (hybridize(aot=...) or the MXNET_AOT
+        # default): validate now, resolve per jit creation so
+        # config.enable_aot after construction still applies
+        _aot.resolve_aot(aot)
+        self._aot = aot
+
+    def _wrap_aot(self, jit_fn, tag):
+        """AOT-wrap one freshly created jit (no-op when AOT is off)."""
+        from .. import aot as _aot
+
+        store = _aot.resolve_aot(self._aot)
+        if store is None:
+            return jit_fn
+        fp = "remat=%s|fusion=%s" % (self._remat_policy or "",
+                                     self._fusion if self._fusion
+                                     is not None else "")
+        return _aot.AOTFunction(
+            jit_fn, "cachedop:%s:%s" % (self._block.name, tag), store,
+            fingerprint_extra=fp, manifest_kind="cachedop")
 
     def _make_fn(self, is_train, n_inputs, n_params):
         block = self._block
@@ -495,7 +515,9 @@ class CachedOp:
                 from ..remat import apply_remat
 
                 fn_for_jit = apply_remat(pure, self._remat_policy)
-            self._jits[key] = (jax.jit(fn_for_jit), meta)
+            self._jits[key] = (self._wrap_aot(
+                jax.jit(fn_for_jit), "train" if is_train else "eval"),
+                meta)
         jit_fn, meta = self._jits[key]
         rng = _random.next_key()
         mode = "[train]" if is_train else "[eval]"
@@ -525,16 +547,23 @@ class CachedOp:
             # jit'd vjp of the same pure fn (parity: _backward_CachedOp)
             grad_key = ("grad", key)
             if grad_key not in self._jits:
+                from .. import aot as _aot
+
+                # the vjp traces THROUGH the forward — only the raw jit
+                # can inline under a trace, never a loaded executable
+                raw_fwd = _aot.unwrap(jit_fn)
+
                 def grad_fn(rng_, inputs_, params_, cots):
                     def f2(ins, ps):
-                        o, _aux = jit_fn(rng_, ins, ps)
+                        o, _aux = raw_fwd(rng_, ins, ps)
                         return o
 
                     _, vjp = jax.vjp(f2, inputs_, params_)
                     gin, gpar = vjp(cots)
                     return gin, gpar
 
-                self._jits[grad_key] = jax.jit(grad_fn)
+                self._jits[grad_key] = self._wrap_aot(
+                    jax.jit(grad_fn), "grad")
             grad_jit = self._jits[grad_key]
             param_nds = [p.data() for p in self._param_list]
 

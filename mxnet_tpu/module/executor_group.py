@@ -37,10 +37,11 @@ class DataParallelExecutorGroup:
                  param_names, for_training, inputs_need_grad,
                  shared_group=None, logger=None, fixed_param_names=None,
                  grad_req="write", state_names=None, group2ctxs=None,
-                 remat_policy=None, fusion=None):
+                 remat_policy=None, fusion=None, aot=None):
         self.symbol = symbol
         self.remat_policy = remat_policy
         self.fusion = fusion
+        self.aot = aot
         self.contexts = contexts
         self.workload = workload or [1] * len(contexts)
         self.for_training = for_training
@@ -96,6 +97,7 @@ class DataParallelExecutorGroup:
                                           shared_exec=shared,
                                           remat_policy=self.remat_policy,
                                           fusion=self.fusion,
+                                          aot=self.aot,
                                           **shapes)
             self.execs.append(exe)
 

@@ -100,13 +100,15 @@ class ShardedTrainer:
     def __init__(self, net, loss_fn, mesh=None, optimizer="sgd",
                  optimizer_params=None, batch_axis_spec="dp",
                  param_spec_fn=None, dtype=None, donate=True,
-                 remat_policy=None, fusion=None, on_nonfinite=None):
+                 remat_policy=None, fusion=None, on_nonfinite=None,
+                 aot=None, aot_spec=None):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from ..remat import resolve_policy
         from ..checkpoint import nonfinite_policy
         from .. import fusion_cost as _fc
+        from .. import aot as _aot
 
         self.net = net
         self.loss_fn = loss_fn
@@ -121,6 +123,15 @@ class ShardedTrainer:
         # applies to new-shape retraces — same contract as Executor.
         _fc.resolve_fusion(fusion)
         self._fusion = fusion
+        # AOT executable store (aot= or the MXNET_AOT default): the
+        # compiled step is serialized/loaded by content hash so a
+        # restarted trainer (rollout, preemption resume) skips the
+        # cold compile.  Validated now, resolved at _build like the
+        # fusion plan.  ``aot_spec`` names this model in the store's
+        # signature manifest so tools/prewarm.py can rebuild it.
+        _aot.resolve_aot(aot)
+        self._aot = aot
+        self._aot_spec = aot_spec
         # NaN/Inf step guard (None defers to MXNET_NONFINITE_POLICY):
         # "skip" compiles a select into the step so a non-finite loss
         # discards the whole update (params, optimizer state, moving
@@ -401,6 +412,18 @@ class ShardedTrainer:
 
         donate = (0, 1) if self._donate else ()
         self._step_fn = jax.jit(step, donate_argnums=donate)
+        from .. import aot as _aot
+
+        store = _aot.resolve_aot(self._aot)
+        if store is not None:
+            fp = "remat=%s|fusion=%s|opt=%s|donate=%s|guard=%s" % (
+                self._remat_policy or "",
+                self._fusion if self._fusion is not None else "",
+                self._opt_name, self._donate, guard_skip)
+            self._step_fn = _aot.AOTFunction(
+                self._step_fn, "sharded_step:%s" % self.net.name, store,
+                fingerprint_extra=fp, manifest_kind="trainer",
+                manifest_spec=self._aot_spec)
 
     def step(self, inputs, label):
         """Run one compiled train step. inputs: list of NDArray/jax arrays
@@ -433,6 +456,39 @@ class ShardedTrainer:
         finally:
             if sp is not None:
                 sp.end()
+
+    def prewarm(self, inputs, label):
+        """Compile — or load from the AOT store — the step executable
+        for these input shapes WITHOUT running a step (no state is
+        touched, no PRNG key is consumed, donated buffers stay live).
+
+        With ``aot=`` enabled this is the trainer half of the
+        ``tools/prewarm.py`` contract: run it ahead of rollout and the
+        first real ``step`` starts at warm-cache speed.  Returns the
+        acquisition info dict (``status`` hit/compiled/warm/fallback,
+        ``seconds``), or ``{"status": "disabled"}`` when AOT is off
+        (plain jit has no executable cache to pre-populate)."""
+        if not isinstance(inputs, (list, tuple)):
+            inputs = [inputs]
+        raw_in = [x._data if isinstance(x, NDArray) else x for x in inputs]
+        raw_label = label._data if isinstance(label, NDArray) else label
+        if self.param_arrays is None:
+            self._lazy_init(example_inputs=raw_in)
+        if self._step_fn is None:
+            self._build(len(raw_in))
+        from .. import aot as _aot
+
+        if not isinstance(self._step_fn, _aot.AOTFunction):
+            return {"label": "sharded_step:%s" % self.net.name,
+                    "status": "disabled"}
+        # an aval-identical dummy key: snapshot the stream, split once,
+        # restore — prewarm must not shift the training PRNG sequence
+        snap = _random.get_key_data()
+        rng = _random.next_key()
+        _random.set_key_data(snap)
+        return self._step_fn.prewarm(
+            self.param_arrays, self.opt_state, tuple(raw_in), raw_label,
+            rng)
 
     def _step_inner(self, raw_in, raw_label):
         rng = _random.next_key()

@@ -69,9 +69,11 @@ class Predictor:
 
     def __init__(self, forward, params, chain=8, preprocess=None,
                  postprocess=None, batch_shape=None, batch_dtype=None,
-                 device=None):
+                 device=None, aot=None, aot_spec=None):
         import jax
         from jax import lax
+
+        from . import aot as _aot
 
         assert chain >= 1
         self._chain = int(chain)
@@ -116,6 +118,20 @@ class Predictor:
             return outs
 
         self._jit_chain = jax.jit(chained)
+        # AOT executable store (aot= or the MXNET_AOT default): a
+        # freshly spawned replica deserializes the chain executable
+        # instead of recompiling it — the warm-pool/restart path.  The
+        # device rides in the signature (one executable per replica
+        # device), so per-device replicas each hit their own entry.
+        self._aot_spec = aot_spec
+        store = _aot.resolve_aot(aot)
+        if store is not None:
+            self._jit_one = _aot.AOTFunction(
+                self._jit_one, "predictor:one", store,
+                manifest_kind="predictor", manifest_spec=aot_spec)
+            self._jit_chain = _aot.AOTFunction(
+                self._jit_chain, "predictor:chain", store,
+                manifest_kind="predictor", manifest_spec=aot_spec)
         # serving batch contract.  Pass batch_shape (or build via
         # from_block, which seeds it from the example input) so a
         # ragged FIRST request pads up to the intended size; with
@@ -143,9 +159,44 @@ class Predictor:
         """The jax device this replica's params are committed to."""
         return self._dev
 
+    def prewarm(self):
+        """Compile — or load from the AOT store — this replica's
+        dispatch executables without serving a request.
+
+        Requires a pinned batch contract (``batch_shape``/
+        ``batch_dtype`` or :meth:`from_block`): the compiled program is
+        shape-specialized, so there is nothing to pre-build for an
+        implicit contract.  Returns a list of acquisition info dicts
+        (one per executable) — ``tools/prewarm.py`` and the
+        serving warm pool aggregate these."""
+        import jax
+
+        from . import aot as _aot
+        from .base import MXNetError
+
+        if self._batch_shape is None or self._batch_dtype is None:
+            raise MXNetError(
+                "Predictor.prewarm() needs a pinned batch contract "
+                "(pass batch_shape=/batch_dtype= or build via "
+                "from_block)")
+        infos = []
+        zeros = np.zeros(self._batch_shape, self._batch_dtype)
+        arr = jax.device_put(zeros, self._dev)
+        if self._chain == 1:
+            # chain-1 dispatch only ever uses the single-batch program
+            if isinstance(self._jit_one, _aot.AOTFunction):
+                infos.append(self._jit_one.prewarm(arr, self._params))
+        elif isinstance(self._jit_chain, _aot.AOTFunction):
+            infos.append(self._jit_chain.prewarm(
+                tuple([arr] * self._chain), self._params))
+        if not infos:
+            infos.append({"label": "predictor", "status": "disabled"})
+        return infos
+
     @classmethod
     def from_block(cls, net, example_input, chain=8, preprocess=None,
-                   postprocess=None, device=None):
+                   postprocess=None, device=None, aot=None,
+                   aot_spec=None):
         """Build from a gluon HybridBlock: traces the block's forward the
         same way CachedOp does (moving stats frozen — inference).
 
@@ -188,7 +239,8 @@ class Predictor:
         pred = cls(forward, param_arrays, chain=chain,
                    preprocess=preprocess, postprocess=postprocess,
                    batch_shape=tuple(x_nd.shape),
-                   batch_dtype=np.dtype(x_nd.dtype), device=device)
+                   batch_dtype=np.dtype(x_nd.dtype), device=device,
+                   aot=aot, aot_spec=aot_spec)
         return pred, jnp.asarray(x_nd._data)
 
     def _upload(self, b, request_id=None):

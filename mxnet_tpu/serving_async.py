@@ -205,7 +205,7 @@ class _Request:
 
 class _Replica:
     __slots__ = ("pred", "idx", "healthy", "busy_since", "thread",
-                 "reason")
+                 "reason", "ejected_at", "probing", "last_probe")
 
     def __init__(self, pred, idx):
         self.pred = pred
@@ -214,6 +214,9 @@ class _Replica:
         self.busy_since = None     # monotonic start of current dispatch
         self.thread = None
         self.reason = None
+        self.ejected_at = None     # monotonic time of last ejection
+        self.probing = False       # a heal probe/replacement in flight
+        self.last_probe = None     # monotonic time of last heal probe
 
 
 # ---------------------------------------------------------------------------
@@ -323,7 +326,8 @@ class AsyncPredictor:
                  max_inflight=None, batch_window_ms=2.0, max_retries=1,
                  slo_ms=None, shed_error_budget=0.1, shed_burn_threshold=2.0,
                  shed_window_s=30.0, shed_hist=None, stall_timeout_s=None,
-                 sweep_interval_s=0.01):
+                 sweep_interval_s=0.01, warm_pool=None, spare_factory=None,
+                 heal_probe_s=None):
         preds = list(replicas) if isinstance(replicas, (list, tuple)) \
             else [replicas]
         if not preds:
@@ -383,6 +387,28 @@ class AsyncPredictor:
         self._closed = False
         self._ewma_chunk_s = None     # measured seconds per dispatch
 
+        # warm pool: N spare replicas pre-built (through the AOT store
+        # when the factory enables it) so an ejection installs a
+        # canary-verified spare instead of waiting for operator heal();
+        # a periodic heal probe (heal_probe_s) re-admits ejected
+        # replicas whose fault was transient.
+        if warm_pool is None:
+            warm_pool = _config.get("MXNET_SERVING_WARM_POOL")
+        warm_pool = int(warm_pool)
+        if warm_pool > 0 and spare_factory is None:
+            raise ValueError(
+                "warm_pool=%d needs spare_factory= (a callable "
+                "returning a contract-matching serving.Predictor); "
+                "from_block builds one automatically" % warm_pool)
+        self._spare_factory = spare_factory
+        self._spares = []
+        for _ in range(warm_pool):
+            self._spares.append(self._build_spare())
+        _telemetry.SERVING_WARM_POOL_SPARES.set(len(self._spares))
+        if heal_probe_s is None:
+            heal_probe_s = _config.get("MXNET_SERVING_HEAL_PROBE")
+        self._heal_probe_s = float(heal_probe_s) if heal_probe_s else None
+
         _telemetry.SERVING_REPLICAS_HEALTHY.set(len(self._replicas))
         for rep in self._replicas:
             self._start_worker(rep)
@@ -396,21 +422,52 @@ class AsyncPredictor:
 
     @classmethod
     def from_block(cls, net, example_input, replicas=1, chain=8,
-                   preprocess=None, postprocess=None, **kwargs):
+                   preprocess=None, postprocess=None, aot=None,
+                   aot_spec=None, **kwargs):
         """Build ``replicas`` Predictor replicas from a gluon block,
         placed round-robin over the mesh devices (one per device when
-        ``replicas`` <= device count), and wrap them.  ``kwargs`` go to
-        :class:`AsyncPredictor`."""
+        ``replicas`` <= device count), and wrap them.  The same builder
+        becomes the warm pool's ``spare_factory`` (spares continue the
+        round-robin placement), so ``warm_pool=N`` works out of the box;
+        with ``aot=`` each replica and spare loads its serialized
+        executable from the store instead of recompiling.  ``kwargs``
+        go to :class:`AsyncPredictor`."""
         import jax
 
         devs = jax.devices()
-        preds = []
-        for i in range(int(replicas)):
+        counter = [0]
+
+        def build():
+            i = counter[0]
+            counter[0] += 1
             pred, _ = Predictor.from_block(
                 net, example_input, chain=chain, preprocess=preprocess,
-                postprocess=postprocess, device=devs[i % len(devs)])
-            preds.append(pred)
+                postprocess=postprocess, device=devs[i % len(devs)],
+                aot=aot, aot_spec=aot_spec)
+            return pred
+
+        preds = [build() for _ in range(int(replicas))]
+        kwargs.setdefault("spare_factory", build)
         return cls(preds, **kwargs)
+
+    def _build_spare(self):
+        """One warm-pool spare: built by the factory, contract-checked,
+        and pre-warmed through the AOT store when available (best
+        effort — a spare that could not pre-compile still works, it
+        just pays the compile at install)."""
+        pred = self._spare_factory()
+        if tuple(pred.batch_shape or ()) != self._contract_shape or \
+                np.dtype(pred.batch_dtype) != self._contract_dtype:
+            raise ValueError(
+                "spare_factory built a replica with contract %r/%r, "
+                "pool contract is %r/%r"
+                % (pred.batch_shape, pred.batch_dtype,
+                   self._contract_shape, self._contract_dtype))
+        try:
+            pred.prewarm()
+        except Exception:
+            pass  # AOT off or unpinnable: the spare compiles on install
+        return pred
 
     # -- admission -------------------------------------------------------
 
@@ -615,6 +672,11 @@ class AsyncPredictor:
             while True:
                 if not self._running or not rep.healthy:
                     return None
+                if rep.thread is not threading.current_thread():
+                    # superseded: a heal installed a fresh worker while
+                    # this one was stuck in a stalled device call — two
+                    # consumers must not race on one replica
+                    return None
                 if any(r.state == "queued" for r in self._queue):
                     break
                 self._cond.wait(0.05)
@@ -795,9 +857,12 @@ class AsyncPredictor:
         """Distinguish a sick replica from a poisoned request: dispatch
         one known-good (all-zeros) contract batch.  True = the device
         still answers, so the failed chunk's payload was at fault."""
+        return self._canary_pred(rep.pred)
+
+    def _canary_pred(self, pred):
         try:
             canary = np.zeros(self._contract_shape, self._contract_dtype)
-            list(rep.pred.predict([canary]))
+            list(pred.predict([canary]))
             return True
         except Exception:
             return False
@@ -838,12 +903,123 @@ class AsyncPredictor:
             return
         rep.healthy = False
         rep.reason = reason
+        rep.ejected_at = time.monotonic()
+        rep.last_probe = None
         _telemetry.SERVING_REPLICA_EJECTIONS.inc(reason=reason)
         _telemetry.SERVING_REPLICAS_HEALTHY.set(
             self._healthy_count_locked())
         _logger.error("ejecting replica %d (%s): %s", rep.idx, reason,
                       exc)
+        # warm pool: hand the slot a pre-built spare (canary-verified
+        # off-lock in a healer thread) instead of waiting for an
+        # operator heal() — replica ejection then self-heals
+        if self._spares and self._running and not rep.probing:
+            rep.probing = True
+            threading.Thread(
+                target=self._replace_replica, args=(rep,),
+                name="serving-healer-%d" % rep.idx, daemon=True).start()
         self._cond.notify_all()
+
+    def _replace_replica(self, rep):
+        """Warm-pool healer: canary a spare and install it into the
+        ejected slot.  The canary dispatch runs OFF the lock (it is a
+        real device call); install/readmit happens under it."""
+        with self._cond:
+            spare = self._spares.pop() if self._spares else None
+            _telemetry.SERVING_WARM_POOL_SPARES.set(len(self._spares))
+        consumed = False   # spare installed or dropped -> pool owes one
+        try:
+            ok = spare is not None and self._canary_pred(spare)
+            with self._cond:
+                rep.probing = False
+                rep.last_probe = time.monotonic()
+                if not self._running or spare is None:
+                    if spare is not None:
+                        self._spares.append(spare)
+                        _telemetry.SERVING_WARM_POOL_SPARES.set(
+                            len(self._spares))
+                    return
+                if not ok:
+                    # the spare itself fails the canary (device-level
+                    # fault): drop it — re-pooling a sick spare would
+                    # make every later ejection unhealable.  The pool
+                    # still refills below: a transient blip must not
+                    # permanently drain it while the factory is healthy.
+                    consumed = True
+                    _logger.error(
+                        "warm-pool spare failed its canary; replica %d "
+                        "stays ejected", rep.idx)
+                    return
+                if rep.healthy:
+                    # operator heal() won the race: keep the spare
+                    self._spares.append(spare)
+                    _telemetry.SERVING_WARM_POOL_SPARES.set(
+                        len(self._spares))
+                    return
+                consumed = True
+                rep.pred = spare
+                rep.healthy = True
+                rep.reason = None
+                _telemetry.SERVING_AUTOHEALS.inc(mode="warm_pool")
+                _telemetry.SERVING_REPLICAS_HEALTHY.set(
+                    self._healthy_count_locked())
+                _logger.warning(
+                    "replica %d re-admitted from the warm pool after a "
+                    "successful canary dispatch", rep.idx)
+                # unconditional: the old worker may still be alive, blocked
+                # inside the stalled device call — it exits via the
+                # supersession check in _take_chunk, and a healthy replica
+                # must have a live consumer NOW, not when that call returns
+                self._start_worker(rep)
+                self._cond.notify_all()
+        except Exception:
+            with self._cond:
+                rep.probing = False
+            _logger.exception("warm-pool replacement for replica %d "
+                              "failed", rep.idx)
+            return
+        finally:
+            # replenish the pool off-lock whenever a spare was consumed
+            # (installed OR dropped) — best effort: a failing factory
+            # leaves the pool smaller, it never breaks serving
+            if consumed and self._spare_factory is not None:
+                try:
+                    new_spare = self._build_spare()
+                except Exception:
+                    new_spare = None
+                    _logger.exception("warm-pool refill failed")
+                if new_spare is not None:
+                    with self._cond:
+                        if self._running:
+                            self._spares.append(new_spare)
+                            _telemetry.SERVING_WARM_POOL_SPARES.set(
+                                len(self._spares))
+
+    def _probe_replica(self, rep):
+        """Auto-heal probe: canary the *ejected* replica itself (off
+        the lock) and re-admit it on success — heals transient faults
+        (a released stall, a recovered device) without spending a
+        spare."""
+        ok = self._canary_passes(rep)
+        with self._cond:
+            rep.probing = False
+            rep.last_probe = time.monotonic()
+            if not ok or not self._running or rep.healthy:
+                return
+            rep.healthy = True
+            rep.reason = None
+            _telemetry.SERVING_AUTOHEALS.inc(mode="probe")
+            _telemetry.SERVING_REPLICAS_HEALTHY.set(
+                self._healthy_count_locked())
+            _logger.warning(
+                "replica %d re-admitted after a successful heal-probe "
+                "canary dispatch", rep.idx)
+            # unconditional: the old worker may still be alive, blocked
+            # inside the stalled device call — it exits via the
+            # supersession check in _take_chunk, and a healthy replica
+            # must have a live consumer NOW, not when that call returns
+            self._start_worker(rep)
+            self._cond.notify_all()
 
     def _requeue_or_fail_locked(self, reqs, cause, rep_idx):
         """Route a failed/stalled dispatch's requests to healthy
@@ -892,8 +1068,11 @@ class AsyncPredictor:
                     continue
                 rep.healthy = True
                 rep.reason = None
-                if rep.thread is None or not rep.thread.is_alive():
-                    self._start_worker(rep)
+                # unconditional: the old worker may still be alive, blocked
+                # inside the stalled device call — it exits via the
+                # supersession check in _take_chunk, and a healthy replica
+                # must have a live consumer NOW, not when that call returns
+                self._start_worker(rep)
             _telemetry.SERVING_REPLICAS_HEALTHY.set(
                 self._healthy_count_locked())
             self._cond.notify_all()
@@ -944,6 +1123,21 @@ class AsyncPredictor:
                     self._claimed.discard(req)
                     self._finish_locked(
                         req, exc=DeadlineExceeded("dispatch"))
+            if self._heal_probe_s is not None and self._running:
+                for rep in self._replicas:
+                    if rep.healthy or rep.probing:
+                        continue
+                    since = rep.last_probe if rep.last_probe is not None \
+                        else rep.ejected_at
+                    if since is None or now - since < self._heal_probe_s:
+                        continue
+                    # one probe in flight per replica; the canary is a
+                    # device call, so it runs off the sweeper thread
+                    rep.probing = True
+                    threading.Thread(
+                        target=self._probe_replica, args=(rep,),
+                        name="serving-heal-probe-%d" % rep.idx,
+                        daemon=True).start()
         if self._shedder is not None:
             self._shedder.update(now)
 
@@ -1029,6 +1223,7 @@ class AsyncPredictor:
                 "claimed": len(self._claimed),
                 "healthy_replicas": self._healthy_count_locked(),
                 "replicas": len(self._replicas),
+                "spares": len(self._spares),
                 "ewma_dispatch_s": self._ewma_chunk_s,
                 "shedding": (self._shedder.shedding
                              if self._shedder else False),

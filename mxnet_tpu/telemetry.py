@@ -510,6 +510,33 @@ COMPILE_CACHE_MISSES = counter(
     "mxnet_tpu_compile_cache_misses_total",
     "Persistent compilation-cache misses.")
 
+# AOT executable store (aot.py) — together with the persistent-cache
+# counters above this is the whole compile-cache picture: the XLA cache
+# skips the backend compile, the AOT store skips trace+compile and
+# survives as a deployable artifact.
+AOT_CACHE_HITS = counter(
+    "mxnet_tpu_aot_cache_hits_total",
+    "AOT executable-store hits (serialized executable deserialized; "
+    "no XLA compile).")
+AOT_CACHE_MISSES = counter(
+    "mxnet_tpu_aot_cache_misses_total",
+    "AOT executable-store misses (compiled once, then persisted).")
+AOT_SAVES = counter(
+    "mxnet_tpu_aot_saves_total",
+    "Executables serialized into the AOT store.")
+AOT_FALLBACKS = counter(
+    "mxnet_tpu_aot_fallbacks_total",
+    "AOT paths degraded to plain jit, by reason (acquire/deserialize/"
+    "persist/dispatch) — fallbacks cost a compile, never numerics.",
+    ("reason",))
+AOT_LOAD_SECONDS = histogram(
+    "mxnet_tpu_aot_load_seconds",
+    "Wall time to lower + load a stored executable on an AOT hit "
+    "(the warm-start cost the cold compile is replaced by).")
+AOT_COMPILE_SECONDS = histogram(
+    "mxnet_tpu_aot_compile_seconds",
+    "Wall time of AOT-path XLA compiles (misses).")
+
 # checkpointing
 CHECKPOINT_SAVE_SECONDS = histogram(
     "mxnet_tpu_checkpoint_save_seconds",
@@ -586,6 +613,14 @@ SERVING_REPLICAS_HEALTHY = gauge(
 SERVING_REQUEST_RETRIES = counter(
     "mxnet_tpu_serving_request_retries_total",
     "Requests requeued onto a healthy replica after an ejection.")
+SERVING_AUTOHEALS = counter(
+    "mxnet_tpu_serving_autoheals_total",
+    "Ejected replicas re-admitted automatically after a successful "
+    "canary dispatch (mode: warm_pool = pre-built spare installed, "
+    "probe = the ejected replica itself recovered).", ("mode",))
+SERVING_WARM_POOL_SPARES = gauge(
+    "mxnet_tpu_serving_warm_pool_spares",
+    "Pre-built spare replicas available to heal the next ejection.")
 
 # device memory (sampled per train step by tracing.sample_device_memory)
 DEVICE_MEMORY_BYTES_IN_USE = gauge(

@@ -637,3 +637,146 @@ def test_from_block_multi_replica_devices():
         np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-5)
     finally:
         ap.close()
+
+
+# ---------------------------------------------------------------------------
+# warm pool + auto-heal probes (PR 8)
+# ---------------------------------------------------------------------------
+
+def _wait_for(cond, timeout=10.0, tick=None):
+    t0 = time.time()
+    while time.time() - t0 < timeout:
+        if cond():
+            return True
+        if tick is not None:
+            tick()
+        time.sleep(0.02)
+    return False
+
+
+def test_warm_pool_replaces_ejected_replica(telemetry_on):
+    # chaos: the replica's compiled chain raises AND its canary fails
+    # (device-level fault) -> ejection -> the pre-built spare is
+    # canary-verified and installed without any operator heal()
+    ap = make_ap(warm_pool=1, spare_factory=make_replica)
+    try:
+        assert ap.stats()["spares"] == 1
+        rep = ap._replicas[0]
+        rep.pred._jit_chain = faults.StallingCallable(
+            rep.pred._jit_chain, exc=RuntimeError("device died"))
+        with pytest.raises(ReplicaFailed):
+            ap.submit(rows(1.0)).result(timeout=5)
+        assert _wait_for(lambda: ap.stats()["healthy_replicas"] == 1)
+        # the replacement serves; the pool refilled itself
+        out = ap.submit(rows(2.0)).result(timeout=10)
+        np.testing.assert_allclose(out, rows(2.0) * 2.0)
+        assert _wait_for(lambda: ap.stats()["spares"] == 1)
+        assert tel.SERVING_AUTOHEALS.value(mode="warm_pool") == 1
+    finally:
+        ap.close()
+
+
+def test_warm_pool_drops_a_spare_that_fails_its_canary(telemetry_on):
+    # a sick spare must never be installed (or re-pooled): the replica
+    # stays ejected and the service reports unhealthy rather than
+    # routing requests into a black hole
+    def sick_replica():
+        pred = make_replica()
+        pred._jit_chain = faults.StallingCallable(
+            pred._jit_chain, exc=RuntimeError("spare DOA"))
+        return pred
+
+    ap = make_ap(warm_pool=1, spare_factory=sick_replica)
+    try:
+        rep = ap._replicas[0]
+        rep.pred._jit_chain = faults.StallingCallable(
+            rep.pred._jit_chain, exc=RuntimeError("device died"))
+        with pytest.raises(ReplicaFailed):
+            ap.submit(rows(1.0)).result(timeout=5)
+        assert _wait_for(lambda: not ap._replicas[0].probing)
+        assert ap.stats()["healthy_replicas"] == 0
+        assert tel.SERVING_AUTOHEALS.value(mode="warm_pool") == 0
+        with pytest.raises(Overloaded):
+            ap.submit(rows(1.0))
+    finally:
+        ap.close()
+
+
+def test_heal_probe_readmits_after_transient_fault(telemetry_on):
+    # chaos: replica fails (canary too), gets ejected, then the device
+    # recovers (release) — the periodic canary probe re-admits it with
+    # no warm pool and no operator intervention
+    ap = make_ap(heal_probe_s=0.01)
+    try:
+        rep = ap._replicas[0]
+        wrapper = faults.StallingCallable(rep.pred._jit_chain,
+                                          exc=RuntimeError("flaky"))
+        rep.pred._jit_chain = wrapper
+        with pytest.raises(ReplicaFailed):
+            ap.submit(rows(1.0)).result(timeout=5)
+        assert ap.stats()["healthy_replicas"] == 0
+        # still sick: a probe fires and fails, replica stays out
+        ap.sweep()
+        assert _wait_for(lambda: not ap._replicas[0].probing)
+        assert ap.stats()["healthy_replicas"] == 0
+        wrapper.release()          # device recovers
+        assert _wait_for(lambda: ap.stats()["healthy_replicas"] == 1,
+                         tick=ap.sweep)
+        assert tel.SERVING_AUTOHEALS.value(mode="probe") == 1
+        out = ap.submit(rows(3.0)).result(timeout=10)
+        np.testing.assert_allclose(out, rows(3.0) * 2.0)
+    finally:
+        ap.close()
+
+
+def test_warm_pool_requires_factory():
+    with pytest.raises(ValueError, match="spare_factory"):
+        AsyncPredictor([make_replica()], warm_pool=1)
+
+
+def test_warm_pool_spare_contract_mismatch_fails_fast():
+    def wrong():
+        return Predictor(lambda x, p: x * 2.0, [], chain=CHAIN,
+                         batch_shape=(B + 1, 3), batch_dtype=np.float32)
+
+    with pytest.raises(ValueError, match="contract"):
+        AsyncPredictor([make_replica()], warm_pool=1, spare_factory=wrong)
+
+
+def test_healed_replica_serves_while_old_worker_still_stalled(telemetry_on):
+    # the stall watchdog ejects a replica whose worker thread is
+    # BLOCKED inside the device call; the warm-pool healer installs a
+    # spare — a fresh worker must start immediately (the stuck thread
+    # cannot consume), and when the stall finally releases, the
+    # superseded thread must exit instead of double-serving
+    ap = make_ap(warm_pool=1, spare_factory=make_replica,
+                 stall_timeout_s=0.05)
+    try:
+        rep = ap._replicas[0]
+        wrapper = stall(rep.pred)
+        f1 = ap.submit(rows(1.0))
+        assert wrapper.stalled.wait(5)         # worker is now stuck
+        stuck_thread = rep.thread
+        # watchdog fires after stall_timeout_s -> ejection
+        assert _wait_for(lambda: ap.stats()["healthy_replicas"] == 0,
+                         tick=ap.sweep)
+        # ...then the warm-pool healer installs the spare
+        assert _wait_for(lambda: ap.stats()["healthy_replicas"] == 1)
+        # the healed slot has a NEW worker even though the old thread
+        # is still alive inside the stalled call
+        assert rep.thread is not stuck_thread
+        assert stuck_thread.is_alive()
+        # the stalled request itself failed typed at ejection (no
+        # healthy retry target existed in that instant) — the warm
+        # pool heals the REPLICA, not an already-failed request
+        with pytest.raises(ReplicaFailed):
+            f1.result(10)
+        out = ap.submit(rows(5.0)).result(timeout=10)
+        np.testing.assert_allclose(out, rows(5.0) * 2.0)
+        wrapper.release()                      # old device call returns
+        stuck_thread.join(timeout=5)
+        assert not stuck_thread.is_alive()     # superseded -> exited
+        out = ap.submit(rows(6.0)).result(timeout=10)
+        np.testing.assert_allclose(out, rows(6.0) * 2.0)
+    finally:
+        ap.close()

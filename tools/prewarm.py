@@ -1,0 +1,309 @@
+"""Pre-warm the AOT executable store: compile and persist every
+executable a workload needs BEFORE rollout, so a restarting trainer or
+a freshly spawned serving replica starts at warm-cache speed.
+
+Given a model spec (the registry below) — or the signature manifest the
+trainer/Predictor append to on their first compile — this builds the
+exact callables the runtime jits and runs their ``prewarm`` entry
+points through the store (``mxnet_tpu.aot``)::
+
+    python tools/prewarm.py --model bench_resnet50 [--store DIR]
+    python tools/prewarm.py --manifest [--store DIR]
+    python tools/prewarm.py --check [--store DIR] [--max-age-days 90]
+
+``--check`` mirrors ``autotune.py --check``: it validates the store
+(schema, payload digests, environment staleness, manifest) and exits
+nonzero on a malformed store — CI-friendly.  ``--json`` emits one
+machine-parsable summary line on stdout (``bench.py BENCH_PREWARM=1``
+consumes it to report ``cold_start_seconds``).
+
+Model specs are intentionally the *same builders the benchmarks use*
+(``bench.build_trainer``), so the content-hash keys match what the real
+process looks up.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# jax 0.4.x XLA:CPU splits large modules across parallel-codegen object
+# files and executable serialization only captures the entry module — a
+# deserialized ResNet-50-sized executable then aborts with "Symbols not
+# found" (the AOT layer degrades it to a recompile, loudly).  Forcing a
+# single codegen unit makes the serialized artifact self-contained.
+# Must be in the environment BEFORE XLA first compiles, hence here at
+# CLI start and not inside mxnet_tpu.  Runtime performance of the
+# compiled program is unchanged; only compile-time parallelism is.
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_cpu_parallel_codegen_split_count" not in _flags:
+    os.environ["XLA_FLAGS"] = \
+        (_flags + " --xla_cpu_parallel_codegen_split_count=1").strip()
+
+
+def log(msg):
+    print("[prewarm] %s" % msg, file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# model-spec registry: name -> builder(store, batch) yielding info dicts
+# ---------------------------------------------------------------------------
+
+MODELS = {}
+
+
+def model(name, doc):
+    def deco(fn):
+        fn.doc = doc
+        MODELS[name] = fn
+        return fn
+    return deco
+
+
+@model("tiny_mlp", "2-layer MLP trainer + predictor at toy shapes "
+                   "(seconds; exercises every path — used by the tests)")
+def _tiny_mlp(store, batch=None):
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, gluon, parallel
+    from mxnet_tpu.serving import Predictor
+
+    batch = int(batch or 4)
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(8, activation="relu"))
+        net.add(gluon.nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    trainer = parallel.ShardedTrainer(
+        net, lambda o, l: loss_fn(o, l), mesh=None, optimizer="sgd",
+        aot=store, aot_spec="tiny_mlp")
+    x = nd.array(np.zeros((batch, 16), np.float32))
+    y = nd.array(np.zeros((batch,), np.float32))
+    yield trainer.prewarm([x], y)
+    pred, _ = Predictor.from_block(net, np.zeros((batch, 16), np.float32),
+                                   chain=2, aot=store,
+                                   aot_spec="tiny_mlp")
+    for info in pred.prewarm():
+        yield info
+
+
+@model("bench_resnet50", "the bench.py trainer-of-record (ResNet-50 "
+                         "bf16/fp32 fused step; BENCH_BATCH honored)")
+def _bench_resnet50(store, batch=None):
+    import bench
+
+    trainer, x, y, _b, _on_tpu = bench.build_trainer(
+        batch=int(batch) if batch else None, aot=store,
+        aot_spec="bench_resnet50")
+    yield trainer.prewarm([x], y)
+
+
+@model("resnet18_serving", "ResNet-18 serving replica (Predictor "
+                           "chain=2) — the CPU-measurable cold-start "
+                           "probe for the serving tier")
+def _resnet18_serving(store, batch=None):
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.serving import Predictor
+
+    batch = int(batch or 8)
+    net = vision.resnet18_v1(classes=1000)
+    net.initialize(mx.init.Xavier())
+    x = np.zeros((batch, 3, 224, 224), np.float32)
+    pred, _ = Predictor.from_block(net, x, chain=2, aot=store,
+                                   aot_spec="resnet18_serving")
+    for info in pred.prewarm():
+        yield info
+
+
+@model("resnet50_serving", "the serving tier of record (perf_notes "
+                           "'Small-batch serving'): ResNet-50 bs32 "
+                           "uint8 input, chain=8, device-side top-5")
+def _resnet50_serving(store, batch=None):
+    import numpy as np
+
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo import vision
+    from mxnet_tpu.serving import Predictor, uint8_normalizer
+
+    import jax
+
+    def top5(logits):
+        _v, i = jax.lax.top_k(logits, 5)
+        return i
+
+    batch = int(batch or 32)
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize(mx.init.Xavier())
+    x = np.zeros((batch, 3, 224, 224), np.uint8)
+    on_tpu = any(d.platform != "cpu" for d in jax.devices())
+    prep = uint8_normalizer() if on_tpu \
+        else uint8_normalizer(dtype="float32")
+    pred, _ = Predictor.from_block(
+        net, x, chain=8, preprocess=prep,
+        postprocess=top5, aot=store, aot_spec="resnet50_serving")
+    for info in pred.prewarm():
+        yield info
+
+
+# ---------------------------------------------------------------------------
+# modes
+# ---------------------------------------------------------------------------
+
+
+def _resolve_store(path):
+    from mxnet_tpu import aot
+
+    if path:
+        return aot.AOTStore(path)
+    return aot.default_store()
+
+
+def _run_specs(store, specs, batch):
+    infos = []
+    for name in specs:
+        if name not in MODELS:
+            raise SystemExit(
+                "unknown model spec %r; registered: %s"
+                % (name, ", ".join(sorted(MODELS))))
+        log("building %s ..." % name)
+        t0 = time.perf_counter()
+        for info in MODELS[name](store, batch=batch):
+            info = dict(info or {})
+            info["spec"] = name
+            infos.append(info)
+            log("  %-28s %-9s %6.1fs%s"
+                % (info.get("label", "?"), info.get("status", "?"),
+                   info.get("seconds", 0.0),
+                   "  (compile %.1fs)" % info["compile_seconds"]
+                   if info.get("compile_seconds") else ""))
+        log("%s done in %.1fs" % (name, time.perf_counter() - t0))
+    return infos
+
+
+def run_prewarm(args):
+    store = _resolve_store(args.store)
+    log("store: %s" % store.path)
+    t0 = time.perf_counter()
+    infos = _run_specs(store, args.model, args.batch)
+    total = time.perf_counter() - t0
+    compiled = [i for i in infos if i.get("status") == "compiled"]
+    hits = [i for i in infos if i.get("status") == "hit"]
+    fallbacks = [i for i in infos
+                 if i.get("status") in ("fallback", "disabled")]
+    # the cold cost this store now absorbs: measured compile seconds
+    # for fresh entries, recorded compile seconds for ones already
+    # present — so warm reruns still report what cold would have cost
+    cold = sum(i.get("compile_seconds") or 0.0 for i in infos)
+    log("%d executables: %d compiled, %d already warm, %d fallbacks "
+        "(%.1fs total)" % (len(infos), len(compiled), len(hits),
+                           len(fallbacks), total))
+    if fallbacks:
+        log("WARNING: %d executable(s) could not use the AOT store"
+            % len(fallbacks))
+    if args.json:
+        print(json.dumps({
+            "store": store.path,
+            "entries": infos,
+            "compiled": len(compiled),
+            "hits": len(hits),
+            "fallbacks": len(fallbacks),
+            "cold_seconds": round(cold, 2),
+            "total_seconds": round(total, 2),
+        }))
+    return 0 if not fallbacks else 2
+
+
+def run_manifest(args):
+    store = _resolve_store(args.store)
+    entries, problems = store.manifest_entries()
+    for msg in problems:
+        print("MALFORMED: %s" % msg, file=sys.stderr)
+    if not entries and not problems:
+        log("manifest at %s is empty — run the workload once with "
+            "MXNET_AOT=1 (or prewarm --model) to record signatures"
+            % store.manifest_path())
+    specs, unknown = [], []
+    for e in entries:
+        spec = e.get("spec")
+        if spec and spec in MODELS:
+            if spec not in specs:
+                specs.append(spec)
+        else:
+            unknown.append(e)
+    for e in unknown:
+        log("skip manifest entry %s (%s): spec %r is not in this "
+            "CLI's registry — prewarm it from its own entry point"
+            % (e.get("key", "?")[:12], e.get("label"), e.get("spec")))
+    infos = _run_specs(store, specs, args.batch)
+    if args.json:
+        print(json.dumps({"store": store.path, "specs": specs,
+                          "skipped": len(unknown),
+                          "entries": infos}))
+    if problems:
+        return 1
+    return 0 if all(i.get("status") in ("compiled", "hit", "warm")
+                    for i in infos) else 2
+
+
+def run_check(args):
+    store = _resolve_store(args.store)
+    problems, stale = store.check(max_age_days=args.max_age_days)
+    entries = store.entries()
+    manifest, _ = store.manifest_entries()
+    print("%s: %d executables, %d manifest signatures"
+          % (store.path, len(entries), len(manifest)))
+    for key, meta in entries:
+        print("  %s  %-28s %s  %.1fs compile"
+              % (key[:12], meta.get("label", "?"),
+                 (meta.get("fingerprint") or {}).get("backend", "?"),
+                 meta.get("compile_seconds") or 0.0))
+    for msg in stale:
+        print("STALE: %s" % msg)
+    for msg in problems:
+        print("MALFORMED: %s" % msg, file=sys.stderr)
+    return 1 if problems else 0
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Compile + persist a workload's executables into "
+                    "the AOT store ahead of rollout (or --check the "
+                    "store's integrity)")
+    p.add_argument("--store", help="store directory (default: "
+                                   "MXNET_AOT_DIR)")
+    p.add_argument("--model", action="append",
+                   help="model spec to prewarm (repeatable): %s"
+                        % ", ".join(sorted(MODELS)))
+    p.add_argument("--manifest", action="store_true",
+                   help="prewarm every spec recorded in the store's "
+                        "signature manifest")
+    p.add_argument("--check", action="store_true",
+                   help="validate the store instead of compiling; "
+                        "nonzero exit on a malformed store")
+    p.add_argument("--batch", type=int,
+                   help="override the spec's batch size")
+    p.add_argument("--json", action="store_true",
+                   help="emit one JSON summary line on stdout")
+    p.add_argument("--max-age-days", type=float, default=90.0,
+                   help="--check: flag entries older than this")
+    args = p.parse_args(argv)
+    if args.check:
+        return run_check(args)
+    if args.manifest:
+        return run_manifest(args)
+    if not args.model:
+        p.error("pick a mode: --model NAME (see --help for the "
+                "registry), --manifest, or --check")
+    return run_prewarm(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
