@@ -35,7 +35,7 @@ def log(msg):
 
 
 def build_trainer(batch=None, remat_policy=None, aot=None,
-                  aot_spec="bench_resnet50"):
+                  aot_spec="bench_resnet50", mesh=None, layout=None):
     """The benchmark-of-record configuration: ResNet-50 v1, bf16
     compute + fp32 master (on accelerator), momentum SGD, one fused XLA
     program per step, synthetic bs-`batch` data.  Shared by bench.py,
@@ -48,6 +48,11 @@ def build_trainer(batch=None, remat_policy=None, aot=None,
     mxnet_tpu.remat.list_policies().  ``aot`` (or the MXNET_AOT env
     default) enables the serialized-executable store, so a prewarmed
     machine skips the ~97 s step-0 compile (tools/prewarm.py).
+    ``mesh``/``layout`` (or MXNET_MESH / MXNET_LAYOUT) select a named
+    sharding topology + per-parameter layout (docs/sharding.md); the
+    defaults stay single-device, and the emitted BENCH JSON records
+    mesh_shape/layout so the throughput trajectory is attributable to
+    topology.
 
     Returns (trainer, x, y, batch, on_tpu)."""
     import jax
@@ -68,7 +73,8 @@ def build_trainer(batch=None, remat_policy=None, aot=None,
     net.initialize(mx.init.Xavier())
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
     trainer = parallel.ShardedTrainer(
-        net, lambda o, l: loss_fn(o, l), mesh=None, optimizer="sgd",
+        net, lambda o, l: loss_fn(o, l), mesh=mesh, layout=layout,
+        optimizer="sgd",
         optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
         dtype=jax.numpy.bfloat16 if on_tpu else None,
         remat_policy=remat_policy, aot=aot, aot_spec=aot_spec)
@@ -76,6 +82,8 @@ def build_trainer(batch=None, remat_policy=None, aot=None,
     rng = np.random.RandomState(0)
     x = nd.array(rng.rand(batch, 3, 224, 224).astype(np.float32))
     y = nd.array(rng.randint(0, 1000, batch).astype(np.float32))
+    if trainer.mesh is not None:
+        x, y = trainer.shard_batch(x, y)
     return trainer, x, y, batch, on_tpu
 
 
@@ -161,6 +169,10 @@ def main():
         "vs_baseline": round(ips / baseline, 3),
         "warmup_seconds": round(warmup_secs, 2),
         "warmup_step_seconds": warmup_step_secs,
+        # topology attribution (docs/sharding.md): {} / null =
+        # single-device, the historical BENCH_r* configuration
+        "mesh_shape": trainer.mesh_shape,
+        "layout": trainer.layout_name,
     }
     if prewarm_info is not None:
         # cold = trace+compile paid by the prewarm subprocess (or

@@ -1,0 +1,193 @@
+"""Decoder-only transformer language model (Gluon HybridBlock).
+
+The LLM-shaped workload the parallel stack has been waiting for
+(ROADMAP "New workload"): where bench.py exercises conv/BN hot paths,
+this model is embeddings + causal attention + FFN matmuls — the profile
+that makes the dp × fsdp × tp mesh earn its keep.  Parameter names are
+chosen to match the ``fsdp_tp`` spec-rule layout
+(mxnet_tpu/parallel/layout.py): ``proj_q/proj_k/proj_v`` and ``ffn_up``
+are column-parallel over tp, ``attn_out``/``ffn_down`` row-parallel,
+``embed``/``head`` split over fsdp × tp — resolve the layout against
+``lm.collect_params()`` and every parameter matches exactly one rule
+(asserted by tests/test_sharding_layouts.py).
+
+Train it sharded::
+
+    from mxnet_tpu import parallel, gluon
+    lm = TransformerLM(vocab_size=32000, d_model=512, n_heads=8,
+                       n_layers=8)
+    lm.initialize(mx.init.Xavier())
+    trainer = parallel.ShardedTrainer(
+        lm, lm_loss, mesh="dp=2,fsdp=2,tp=2", layout="fsdp_tp",
+        optimizer="adam")
+
+``tools/bench_lm.py`` wraps exactly that into a BENCH-JSON benchmark
+(tokens/s + MFU).  Eager/traced execution only (the attention math uses
+concrete shapes) — like the other examples, not the symbolic Module
+path.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from _common import force_platform_from_env  # noqa: E402
+
+import mxnet_tpu as mx  # noqa: E402
+from mxnet_tpu import gluon  # noqa: E402
+from mxnet_tpu.gluon import nn  # noqa: E402
+
+__all__ = ["TransformerLM", "DecoderBlock", "lm_loss_fn"]
+
+
+class DecoderBlock(gluon.HybridBlock):
+    """Pre-norm decoder block: LN -> causal MHA -> residual -> LN ->
+    FFN -> residual."""
+
+    def __init__(self, d_model, n_heads, d_ff, **kwargs):
+        super().__init__(**kwargs)
+        if d_model % n_heads:
+            raise ValueError("d_model (%d) must divide by n_heads (%d)"
+                             % (d_model, n_heads))
+        self._n_heads = n_heads
+        self._d_head = d_model // n_heads
+        with self.name_scope():
+            self.ln1 = nn.LayerNorm(prefix="ln1_")
+            self.proj_q = nn.Dense(d_model, flatten=False, use_bias=False,
+                                   prefix="proj_q_")
+            self.proj_k = nn.Dense(d_model, flatten=False, use_bias=False,
+                                   prefix="proj_k_")
+            self.proj_v = nn.Dense(d_model, flatten=False, use_bias=False,
+                                   prefix="proj_v_")
+            self.attn_out = nn.Dense(d_model, flatten=False,
+                                     use_bias=False, prefix="attn_out_")
+            self.ln2 = nn.LayerNorm(prefix="ln2_")
+            self.ffn_up = nn.Dense(d_ff, flatten=False, activation="relu",
+                                   prefix="ffn_up_")
+            self.ffn_down = nn.Dense(d_model, flatten=False,
+                                     prefix="ffn_down_")
+
+    def _attend(self, F, x):
+        B, T, D = x.shape
+        H, dh = self._n_heads, self._d_head
+
+        def split_heads(a):  # (B, T, D) -> (B*H, T, dh)
+            return a.reshape((B, T, H, dh)).transpose(
+                (0, 2, 1, 3)).reshape((B * H, T, dh))
+
+        q = split_heads(self.proj_q(x))
+        k = split_heads(self.proj_k(x))
+        v = split_heads(self.proj_v(x))
+        scores = F.batch_dot(q, k, transpose_b=True) * (dh ** -0.5)
+        pos = F.arange(T)
+        causal = F.broadcast_greater_equal(pos.reshape((T, 1)),
+                                           pos.reshape((1, T)))
+        scores = F.where(causal.reshape((1, T, T)), scores,
+                         F.ones_like(scores) * -1e30)
+        att = F.softmax(scores, axis=-1)
+        out = F.batch_dot(att, v)  # (B*H, T, dh)
+        out = out.reshape((B, H, T, dh)).transpose(
+            (0, 2, 1, 3)).reshape((B, T, D))
+        return self.attn_out(out)
+
+    def hybrid_forward(self, F, x):
+        x = x + self._attend(F, self.ln1(x))
+        return x + self.ffn_down(self.ffn_up(self.ln2(x)))
+
+
+class TransformerLM(gluon.HybridBlock):
+    """Token + learned-position embeddings, ``n_layers`` decoder blocks,
+    final LayerNorm, untied LM head.  Input (batch, seq) token ids ->
+    (batch, seq, vocab) logits."""
+
+    def __init__(self, vocab_size, d_model=256, n_heads=4, n_layers=2,
+                 d_ff=None, max_len=512, **kwargs):
+        super().__init__(**kwargs)
+        d_ff = d_ff or 4 * d_model
+        self._cfg = dict(vocab_size=vocab_size, d_model=d_model,
+                         n_heads=n_heads, n_layers=n_layers, d_ff=d_ff,
+                         max_len=max_len)
+        with self.name_scope():
+            self.embed = nn.Embedding(vocab_size, d_model,
+                                      prefix="embed_")
+            self.pos_embed = nn.Embedding(max_len, d_model,
+                                          prefix="pos_embed_")
+            self._blocks = []
+            for i in range(n_layers):
+                blk = DecoderBlock(d_model, n_heads, d_ff,
+                                   prefix="h%d_" % i)
+                self.register_child(blk, "h%d" % i)
+                self._blocks.append(blk)
+            self.ln_f = nn.LayerNorm(prefix="ln_f_")
+            self.head = nn.Dense(vocab_size, flatten=False,
+                                 use_bias=False, prefix="head_")
+
+    @property
+    def config(self):
+        return dict(self._cfg)
+
+    def flops_per_token(self, seq_len=None):
+        """Train FLOPs/token: the standard 6N dense term plus — when
+        ``seq_len`` is given — the quadratic attention term
+        ``12 * n_layers * d_model * seq_len`` (fwd+bwd QK^T and att·V
+        matmuls), the PaLM-appendix accounting the MFU gauge
+        cross-checks."""
+        c = self._cfg
+        n_params = (c["vocab_size"] * c["d_model"] * 2          # embed+head
+                    + c["max_len"] * c["d_model"]
+                    + c["n_layers"] * (4 * c["d_model"] ** 2
+                                       + 2 * c["d_model"] * c["d_ff"]))
+        flops = 6 * n_params
+        if seq_len:
+            flops += 12 * c["n_layers"] * c["d_model"] * int(seq_len)
+        return flops
+
+    def hybrid_forward(self, F, tokens):
+        B, T = tokens.shape
+        if T > self._cfg["max_len"]:
+            raise ValueError("sequence length %d > max_len %d"
+                             % (T, self._cfg["max_len"]))
+        pos = F.arange(T)
+        x = F.broadcast_add(self.embed(tokens),
+                            self.pos_embed(pos).reshape(
+                                (1, T, self._cfg["d_model"])))
+        for blk in self._blocks:
+            x = blk(x)
+        return self.head(self.ln_f(x))
+
+
+def lm_loss_fn(vocab_size):
+    """Next-token softmax-CE adapter for ShardedTrainer: flattens
+    (B, T, V) logits against (B, T) label ids."""
+    ce = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def loss(logits, labels):
+        B, T, V = logits.shape
+        return ce(logits.reshape((B * T, V)), labels.reshape((B * T,)))
+
+    return loss
+
+
+if __name__ == "__main__":
+    # tiny smoke run: one eager forward + one sharded train step
+    import numpy as np
+
+    force_platform_from_env()
+    from mxnet_tpu import nd, parallel
+
+    lm = TransformerLM(vocab_size=128, d_model=64, n_heads=4, n_layers=2,
+                       max_len=64)
+    lm.initialize(mx.init.Xavier())
+    rng = np.random.RandomState(0)
+    tokens = nd.array(rng.randint(0, 128, (4, 32)).astype(np.float32))
+    labels = nd.array(rng.randint(0, 128, (4, 32)).astype(np.float32))
+    logits = lm(tokens)
+    print("logits:", logits.shape)
+    trainer = parallel.ShardedTrainer(
+        lm, lm_loss_fn(128), mesh=None, optimizer="adam",
+        optimizer_params={"learning_rate": 1e-3})
+    for i in range(3):
+        print("step %d loss %.4f" % (i, float(trainer.step([tokens],
+                                                           labels))))

@@ -78,6 +78,20 @@ FLAGS = {
     "MXNET_ENABLE_GPU_P2P": ("1", _pbool, "n/a", "ICI replaces P2P"),
     "MXNET_UPDATE_ON_KVSTORE": (
         "1", _pbool, "honored", "Module/Trainer update placement"),
+    "MXNET_MESH": (
+        "", str, "honored",
+        "default device-mesh spec for ShardedTrainer/bench front-ends: "
+        "'axis=size' pairs over dp/fsdp/pp/ep/sp/mp/tp, e.g. "
+        "'dp=2,fsdp=2,tp=2', or 'auto' (all local devices on dp); "
+        "'' = no mesh (single-device semantics).  Resolved by "
+        "parallel.mesh.resolve_mesh; explicit mesh= arguments win"),
+    "MXNET_LAYOUT": (
+        "", str, "honored",
+        "default parameter-sharding layout name for ShardedTrainer: a "
+        "registered spec-rule layout (data_parallel/fsdp/fsdp_tp or "
+        "parallel.layout.register_layout additions); '' = pick the "
+        "canonical layout for the mesh's axes (fsdp_tp when tp is "
+        "present, fsdp for an fsdp axis, else data_parallel)"),
     "MXNET_REMAT_POLICY": (
         "", str, "honored",
         "default activation-remat policy for Executor/CachedOp/"

@@ -7,7 +7,10 @@ reference lacked (tensor parallelism, ring-attention sequence parallelism,
 microbatched pipeline parallelism).
 """
 from .mesh import (make_mesh, local_mesh, init_distributed, MeshConfig,  # noqa: F401
-                   shard_map)
+                   shard_map, parse_mesh, resolve_mesh, require_axes,
+                   mesh_shape, MESH_AXES, DATA_AXES)
+from .layout import (SpecRule, Layout, register_layout, get_layout,  # noqa: F401
+                     list_layouts, resolve_layout, default_layout_for)
 from .train import ShardedTrainer  # noqa: F401
 from .ring_attention import (ring_attention, ring_attention_sharded,  # noqa: F401
                              local_attention)

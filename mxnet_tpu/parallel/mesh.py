@@ -14,7 +14,20 @@ import os
 import numpy as np
 
 __all__ = ["make_mesh", "init_distributed", "local_mesh", "MeshConfig",
-           "shard_map"]
+           "shard_map", "parse_mesh", "resolve_mesh", "require_axes",
+           "mesh_shape", "MESH_AXES", "DATA_AXES"]
+
+# Canonical axis order, outermost first: dp neighbors sit farthest apart
+# (cheapest axis to cross hosts / DCN), fsdp next (parameter shards want
+# fast all-gathers but span more devices than tp), and mp/tp ride the
+# innermost — fastest — ICI dimension, the standard layout recipe.
+MESH_AXES = ("dp", "fsdp", "pp", "ep", "sp", "mp", "tp")
+
+# Axes the *batch* dimension shards over.  fsdp is a data axis too: FSDP
+# splits the batch like dp and additionally shards parameters/optimizer
+# state along the same axis (ZeRO-3 discipline), which is what cuts the
+# per-device state bytes.
+DATA_AXES = ("dp", "fsdp")
 
 
 def shard_map(f, mesh=None, in_specs=None, out_specs=None, check_vma=None,
@@ -45,13 +58,117 @@ def shard_map(f, mesh=None, in_specs=None, out_specs=None, check_vma=None,
 class MeshConfig:
     """Named axis sizes for a parallelism layout."""
 
-    def __init__(self, dp=1, tp=1, pp=1, sp=1, ep=1):
+    def __init__(self, dp=1, tp=1, pp=1, sp=1, ep=1, fsdp=1):
         self.dp, self.tp, self.pp, self.sp, self.ep = dp, tp, pp, sp, ep
+        self.fsdp = fsdp
 
     def axes(self):
         return {k: v for k, v in
-                (("dp", self.dp), ("tp", self.tp), ("pp", self.pp),
-                 ("sp", self.sp), ("ep", self.ep)) if v > 1} or {"dp": 1}
+                (("dp", self.dp), ("fsdp", self.fsdp), ("tp", self.tp),
+                 ("pp", self.pp), ("sp", self.sp), ("ep", self.ep))
+                if v > 1} or {"dp": 1}
+
+
+def parse_mesh(spec):
+    """Parse a mesh spec string like ``"dp=2,fsdp=2,tp=2"`` into an axis
+    dict (the ``mesh=`` / ``MXNET_MESH`` surface syntax).
+
+    Also accepts a dict / :class:`MeshConfig` (returned as axes) and
+    ``None``/``""`` (returns None).  Axis names are validated against
+    :data:`MESH_AXES`; sizes must be positive ints.  ``"auto"`` maps the
+    local device count onto a single ``dp`` axis."""
+    if spec is None or spec == "":
+        return None
+    if isinstance(spec, MeshConfig):
+        return spec.axes()
+    if isinstance(spec, dict):
+        axes = dict(spec)
+    else:
+        if not isinstance(spec, str):
+            raise ValueError("mesh spec must be a 'dp=2,fsdp=2' string, "
+                             "dict, or MeshConfig; got %r" % (spec,))
+        if spec.strip() == "auto":
+            import jax
+
+            return {"dp": len(jax.devices())}
+        axes = {}
+        for part in spec.split(","):
+            part = part.strip()
+            if not part:
+                continue
+            if "=" not in part:
+                raise ValueError("bad mesh spec %r: each entry must be "
+                                 "axis=size (e.g. 'dp=2,fsdp=2')" % (spec,))
+            name, _, size = part.partition("=")
+            axes[name.strip()] = size.strip()
+    out = {}
+    for name, size in axes.items():
+        if name not in MESH_AXES:
+            raise ValueError("unknown mesh axis %r (supported: %s)"
+                             % (name, list(MESH_AXES)))
+        try:
+            n = int(size)
+        except (TypeError, ValueError):
+            n = -1
+        if n < 1:
+            raise ValueError("mesh axis %s=%r must be a positive int"
+                             % (name, size))
+        out[name] = n
+    return out or None
+
+
+def resolve_mesh(mesh=None, devices=None):
+    """Resolve the ``mesh=`` argument every front-end accepts.
+
+    * a ``jax.sharding.Mesh`` — used as-is;
+    * a spec string / dict / :class:`MeshConfig` — built via
+      :func:`make_mesh`;
+    * ``None`` — the ``MXNET_MESH`` env default ('' = no mesh, returns
+      None: single-device semantics).
+    """
+    from jax.sharding import Mesh
+
+    if isinstance(mesh, Mesh):
+        return mesh
+    if mesh is None:
+        from .. import config as _config
+
+        mesh = _config.get("MXNET_MESH") or None
+        if mesh is None:
+            return None
+    axes = parse_mesh(mesh)
+    if axes is None:
+        return None
+    return make_mesh(axes, devices)
+
+
+def mesh_shape(mesh):
+    """``{axis: size}`` of a Mesh (``{}`` for None) — the BENCH-JSON /
+    checkpoint-manifest serialization of a topology."""
+    if mesh is None:
+        return {}
+    return {str(a): int(s) for a, s in zip(mesh.axis_names,
+                                           mesh.devices.shape)}
+
+
+def require_axes(mesh, axes, who="this module"):
+    """Loud validation that ``mesh`` carries every named axis.
+
+    The parallel engines (moe/pipeline/ring/ulysses) declare the axes
+    they consume through this instead of assuming a bare axis-0 device
+    list; a missing axis fails here with the consuming module named,
+    not deep inside shard_map placement."""
+    if isinstance(axes, str):
+        axes = (axes,)
+    have = tuple(mesh.axis_names) if mesh is not None else ()
+    missing = [a for a in axes if a not in have]
+    if missing:
+        raise ValueError(
+            "%s needs mesh axis(es) %s but the mesh has %s — build the "
+            "mesh with make_mesh({'%s': N, ...}) or mesh='%s=N'"
+            % (who, missing, list(have) or "no axes", missing[0],
+               missing[0]))
+    return mesh
 
 
 def init_distributed(coordinator=None, num_processes=None, process_id=None):
@@ -91,22 +208,29 @@ def make_mesh(axes=None, devices=None):
         devices = jax.devices()
     if axes is None:
         axes = {"dp": len(devices)}
-    canonical = ("dp", "pp", "ep", "sp", "mp", "tp")
-    order = [a for a in canonical if a in axes]
+    order = [a for a in MESH_AXES if a in axes]
     # an unknown axis name must be loud, not silently dropped (r5: a
     # {'dp':4,'xx':2} request used to yield a dp-only mesh and the
     # caller's PartitionSpec('xx') failed far away at placement time)
-    unknown = [a for a in axes if a not in canonical]
+    unknown = [a for a in axes if a not in MESH_AXES]
     if unknown:
         raise ValueError("unknown mesh axis names %s (supported: %s)"
-                         % (unknown, list(canonical)))
+                         % (unknown, list(MESH_AXES)))
     sizes = [axes[a] for a in order]
     n = int(np.prod(sizes))
     if n > len(devices):
         raise ValueError("mesh needs %d devices, only %d available"
                          % (n, len(devices)))
     dev_array = np.asarray(devices[:n]).reshape(sizes)
-    return Mesh(dev_array, tuple(order))
+    mesh = Mesh(dev_array, tuple(order))
+    from .. import telemetry as _telemetry
+
+    # topology gauge: one series per axis of the most recent mesh (a
+    # no-op with telemetry off — same one-branch contract as every
+    # other call site)
+    for a, s in zip(order, sizes):
+        _telemetry.MESH_DEVICES.set(int(s), axis=a)
+    return mesh
 
 
 def local_mesh(dp=None):

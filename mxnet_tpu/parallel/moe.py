@@ -129,11 +129,26 @@ def moe_ffn_sharded(mesh, x, gate_w, w_in, w_out, axis_name="ep",
     x: (total_tokens, d_model) — token dim sharded over axis_name
     w_in: (n_experts, d_model, d_hidden), w_out: (n_experts, d_hidden,
     d_model) — expert dim sharded; gate_w replicated.
-    Returns ``(out, aux_loss)`` like :func:`moe_ffn`."""
+    Returns ``(out, aux_loss)`` like :func:`moe_ffn`.
+
+    Declares its mesh consumption: the ``axis_name`` axis (default
+    'ep') must exist on ``mesh`` — composing with a dp/fsdp/tp training
+    mesh means building ONE mesh carrying all the axes and handing each
+    engine its own (loud :func:`mesh.require_axes` failure otherwise,
+    not a shard_map placement error three layers deep)."""
     from jax.sharding import PartitionSpec as P
 
-    from .mesh import shard_map
+    from .mesh import shard_map, require_axes
+    from .. import telemetry as _telemetry
 
+    require_axes(mesh, axis_name, who="moe_ffn_sharded")
+    if _telemetry.enabled():
+        # dispatch + return all_to_all, each ~ the routed token payload
+        # (capacity_factor bounds it; host-side estimate, docs/
+        # observability.md "collective bytes")
+        _telemetry.COLLECTIVE_BYTES.inc(
+            2 * int(x.nbytes * capacity_factor), axis=axis_name,
+            op="all_to_all")
     _check_top_k(top_k, gate_w.shape[-1])
     fn = shard_map(
         functools.partial(moe_ffn, axis_name=axis_name,

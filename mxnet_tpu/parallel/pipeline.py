@@ -64,11 +64,29 @@ def pipeline_forward(stage_fn, x_microbatches, axis_name="pp"):
 
 def gpipe_loss(mesh, stage_fn, loss_fn, x, num_micro, axis_name="pp"):
     """Convenience: split batch into microbatches, pipeline them, average
-    loss on the last stage, psum back to all stages."""
+    loss on the last stage, psum back to all stages.
+
+    Declares its mesh consumption (the ``axis_name`` stage ring —
+    default 'pp'): a mesh without it fails loudly here, so the pipeline
+    composes with dp/fsdp/tp training meshes by carrying its own named
+    axis instead of assuming the whole device list."""
     import jax
     import jax.numpy as jnp
     from jax import lax
     from jax.sharding import PartitionSpec as P
+
+    from .mesh import require_axes
+    from .. import telemetry as _telemetry
+
+    require_axes(mesh, axis_name, who="gpipe_loss")
+    if _telemetry.enabled():
+        n_stage = int(dict(zip(mesh.axis_names,
+                               mesh.devices.shape))[axis_name])
+        # one microbatch activation hops the ring per tick
+        mb_bytes = int(x.nbytes) // max(1, int(num_micro))
+        _telemetry.COLLECTIVE_BYTES.inc(
+            mb_bytes * (int(num_micro) + n_stage - 1), axis=axis_name,
+            op="ppermute")
 
     def inner(xb):
         mbs = xb.reshape((num_micro, xb.shape[0] // num_micro)

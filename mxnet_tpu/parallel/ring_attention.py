@@ -151,14 +151,29 @@ def local_attention(q, k, v, causal=False, scale=None):
     return o / jnp.moveaxis(l, -3, -2)
 
 
-def ring_attention_sharded(mesh, q, k, v, axis_name="sp", causal=False):
+def ring_attention_sharded(mesh, q, k, v, axis_name="sp", causal=False,
+                           batch_axis=None):
     """Convenience wrapper: shard_map ring_attention over `mesh` with the
-    sequence dim of q/k/v sharded along `axis_name`."""
+    sequence dim of q/k/v sharded along `axis_name`.
+
+    Declares its mesh consumption: the sequence ring rides
+    ``axis_name`` (default 'sp'); pass ``batch_axis='dp'`` to *also*
+    shard the batch dim over the mesh's data axis — the ring then
+    composes with the training mesh instead of assuming the whole
+    device list is its ring."""
     from jax.sharding import PartitionSpec as P
 
-    from .mesh import shard_map
+    from .mesh import shard_map, require_axes
+    from .. import telemetry as _telemetry
 
-    spec = P(None, axis_name, None, None)
+    axes = (axis_name,) if batch_axis is None else (axis_name, batch_axis)
+    require_axes(mesh, axes, who="ring_attention_sharded")
+    if _telemetry.enabled():
+        # every K/V block visits every ring position once: per-device
+        # traffic over a full rotation = the (global) K+V payload
+        _telemetry.COLLECTIVE_BYTES.inc(
+            int(k.nbytes) + int(v.nbytes), axis=axis_name, op="ppermute")
+    spec = P(batch_axis, axis_name, None, None)
     fn = shard_map(
         functools.partial(ring_attention, axis_name=axis_name, causal=causal),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,

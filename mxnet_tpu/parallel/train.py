@@ -87,21 +87,31 @@ class ShardedTrainer:
     net : gluon.HybridBlock (initialized)
     loss_fn : callable(F_outputs NDArray, label NDArray) -> scalar NDArray,
         traced along with the net.
-    mesh : jax.sharding.Mesh (axes from parallel.mesh.make_mesh)
+    mesh : jax.sharding.Mesh, an ``"dp=2,fsdp=2,tp=2"`` spec string /
+        axis dict / MeshConfig (built via parallel.mesh.make_mesh), or
+        None — the ``MXNET_MESH`` env default ('' = single device)
     optimizer : 'sgd' | 'adam'
+    layout : spec-rule layout naming the per-parameter PartitionSpecs
+        (parallel.layout registry: 'data_parallel' | 'fsdp' | 'fsdp_tp'
+        | a Layout object | a user-registered name).  None defers to
+        ``MXNET_LAYOUT``, else the canonical layout for the mesh's axes
+        (fsdp_tp when tp present, fsdp for fsdp, else data_parallel).
+        Resolved once against the parameter names/shapes at bind time
+        and cached; optimizer state is sharded like its parameter.
     batch_axis_spec : mesh axis name(s) the batch dim is sharded over
-        (default 'dp' — data parallelism; grads psum over it implicitly)
-    param_spec_fn : optional callable(name, shape) -> PartitionSpec for
-        tensor-parallel parameter sharding (default: fully replicated)
+        (default None = the layout's data axes present in the mesh —
+        ('dp', 'fsdp') when both exist; grads psum over them implicitly)
+    param_spec_fn : optional callable(name, shape) -> PartitionSpec —
+        the pre-layout escape hatch; when given it wins over ``layout``
     dtype : compute dtype for activations (bf16 default on TPU; params and
         optimizer state stay fp32 — the MultiPrecision recipe)
     """
 
     def __init__(self, net, loss_fn, mesh=None, optimizer="sgd",
-                 optimizer_params=None, batch_axis_spec="dp",
+                 optimizer_params=None, batch_axis_spec=None,
                  param_spec_fn=None, dtype=None, donate=True,
                  remat_policy=None, fusion=None, on_nonfinite=None,
-                 aot=None, aot_spec=None):
+                 aot=None, aot_spec=None, layout=None):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -109,6 +119,8 @@ class ShardedTrainer:
         from ..checkpoint import nonfinite_policy
         from .. import fusion_cost as _fc
         from .. import aot as _aot
+        from .mesh import resolve_mesh
+        from . import layout as _layout
 
         self.net = net
         self.loss_fn = loss_fn
@@ -144,7 +156,22 @@ class ShardedTrainer:
         self._ckpt_manager = None
         self._ckpt_period = 0
         self._pending_restore = None
-        self.mesh = mesh
+        # mesh= accepts a Mesh, a "dp=2,fsdp=2" spec, a dict, or None
+        # (the MXNET_MESH env default; '' = single device)
+        self.mesh = resolve_mesh(mesh)
+        # spec-rule layout: the Layout OBJECT resolves now (fail fast on
+        # an unregistered name); the per-parameter resolution needs
+        # materialized shapes and happens once in _shard_params.  An
+        # explicit param_spec_fn is the pre-layout escape hatch and wins.
+        self._layout = None
+        self._layout_res = None
+        if self.mesh is not None and param_spec_fn is None:
+            self._layout = _layout.resolve_layout(layout, self.mesh)
+        elif isinstance(layout, str):
+            _layout.get_layout(layout)  # typo'd name fails fast anyway
+        self._collective_plan = []
+        self._param_shardings = None
+        self._opt_shardings = None
         self._params = [p for p in net.collect_params().values()]
         self._trainable = [p.grad_req != "null" for p in self._params]
         opts = dict(optimizer_params or {})
@@ -222,11 +249,47 @@ class ShardedTrainer:
             self._apply_restore(ckpt)
 
     # -- sharding placement ----------------------------------------------
+    @property
+    def mesh_shape(self):
+        """``{axis: size}`` of the trainer's mesh (``{}`` single-device)
+        — the BENCH-JSON / checkpoint-manifest topology record."""
+        from .mesh import mesh_shape
+
+        return mesh_shape(self.mesh)
+
+    @property
+    def layout_name(self):
+        """Name of the active parameter layout (``"param_spec_fn"`` for
+        the legacy callable path, None when no mesh)."""
+        if self._layout is not None:
+            return self._layout.name
+        if self._param_spec_fn is not None:
+            return "param_spec_fn"
+        return None
+
+    def layout_resolution(self):
+        """The cached per-parameter :class:`LayoutResolution` (resolved
+        at bind time; None for the legacy/no-mesh paths) — inspect with
+        ``.describe()``."""
+        return self._layout_res
+
+    def _resolve_layout_specs(self):
+        """Resolve the layout against the materialized param shapes —
+        once; the Layout caches by (params, mesh) so trainer No. 2 on
+        the same model reuses it."""
+        if self._layout is None or self._layout_res is not None:
+            return
+        params = [(p.name, tuple(arr.shape))
+                  for p, arr in zip(self._params, self.param_arrays)]
+        self._layout_res = self._layout.resolve(params, self.mesh)
+
     def _param_sharding(self, P, NamedSharding, p, arr):
         if self._param_spec_fn is not None:
             spec = self._param_spec_fn(p.name, arr.shape)
             if spec is not None:
                 return NamedSharding(self.mesh, spec)
+        elif self._layout_res is not None:
+            return NamedSharding(self.mesh, self._layout_res.spec(p.name))
         return NamedSharding(self.mesh, P())  # replicated
 
     @staticmethod
@@ -244,21 +307,115 @@ class ShardedTrainer:
             sh, np.asarray(arr))
 
     def _shard_params(self, jax, NamedSharding, P):
+        self._resolve_layout_specs()
+        self._param_shardings = []
         new_arrays = []
         for p, arr in zip(self._params, self.param_arrays):
             sh = self._param_sharding(P, NamedSharding, p, arr)
+            self._param_shardings.append(sh)
             new_arrays.append(self._global_put(jax, arr, sh))
         self.param_arrays = new_arrays
+        # optimizer state shards LIKE ITS PARAMETER (the ZeRO discipline
+        # that makes fsdp cut state bytes, not just weight bytes): the
+        # m/v/mom leaf lists align with the trainable params by index,
+        # and scalar leaves (adam's t) replicate.
+        train_sh = [sh for sh, t in zip(self._param_shardings,
+                                        self._trainable) if t]
+        repl = NamedSharding(self.mesh, P())
+        if self._opt_name == "sgd":
+            opt_sh = {"mom": None if self.opt_state["mom"] is None
+                      else list(train_sh)}
+        else:
+            opt_sh = {"m": list(train_sh), "v": list(train_sh), "t": repl}
+        self._opt_shardings = opt_sh
         self.opt_state = jax.tree_util.tree_map(
-            lambda a: self._global_put(
-                jax, a, NamedSharding(self.mesh, P())), self.opt_state)
+            lambda a, sh: self._global_put(jax, a, sh),
+            self.opt_state, opt_sh)
+        self._build_collective_plan()
+        self._record_state_bytes(jax)
+
+    def _build_collective_plan(self):
+        """Host-side per-step collective payload accounting (telemetry
+        satellite): over each data axis a parameter's gradient either
+        full-psums (parameter replicated along that axis) or
+        reduce_scatters (parameter sharded along it — the GSPMD grad
+        reduction IS the scatter, never a psum on top); fsdp-sharded
+        params additionally regather forward (all_gather).  tp
+        activation collectives depend on the traced graph and are not
+        estimated here (the explicit engines — moe, ring, ulysses —
+        count their own)."""
+        batch_axes = self._batch_axes()
+        if isinstance(batch_axes, str):
+            batch_axes = (batch_axes,)
+        psum = {ax: 0 for ax in batch_axes}
+        rs = {ax: 0 for ax in batch_axes}
+        ag = 0
+        for arr, sh, t in zip(self.param_arrays, self._param_shardings,
+                              self._trainable):
+            axes = set()
+            for entry in sh.spec:
+                axes.update((entry,) if isinstance(entry, str)
+                            else tuple(entry or ()))
+            if "fsdp" in axes:
+                ag += arr.nbytes
+            if not t:
+                continue
+            for ax in batch_axes:
+                if ax in axes:
+                    rs[ax] += arr.nbytes
+                else:
+                    psum[ax] += arr.nbytes
+        plan = [(ax, "psum", b) for ax, b in psum.items() if b]
+        plan += [(ax, "reduce_scatter", b) for ax, b in rs.items() if b]
+        if ag:
+            plan.append(("fsdp", "all_gather", ag))
+        self._collective_plan = plan
+
+    def _record_state_bytes(self, jax):
+        """Per-device params + opt-state bytes actually resident, from
+        the addressable shards (works where the backend allocator
+        reports no HBM stats — the CPU harness): the measured fsdp
+        memory win next to the PR 5 watermark gauges."""
+        if not _telemetry.enabled():
+            return
+        per_dev = {}
+        leaves = list(self.param_arrays) + \
+            jax.tree_util.tree_leaves(self.opt_state)
+        for arr in leaves:
+            for s in getattr(arr, "addressable_shards", ()):
+                d = str(s.device)
+                per_dev[d] = per_dev.get(d, 0) + int(s.data.nbytes)
+        for d, b in per_dev.items():
+            _telemetry.TRAIN_STATE_BYTES.set(b, device=d)
+
+    def _batch_axes(self):
+        """Mesh axes the batch dim shards over: the explicit
+        batch_axis_spec if given, else the layout's data axes present in
+        the mesh (('dp', 'fsdp') under fsdp layouts), else whatever
+        DATA_AXES the mesh carries (legacy param_spec_fn path)."""
+        if self._batch_spec is not None:
+            return self._batch_spec
+        if self.mesh is None:
+            return ()
+        if self._layout is not None:
+            return self._layout.batch_axes(self.mesh)
+        from .mesh import DATA_AXES
+
+        return tuple(a for a in self.mesh.axis_names if a in DATA_AXES)
 
     def _batch_sharding(self):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         if self.mesh is None:
             return None
-        return NamedSharding(self.mesh, P(self._batch_spec))
+        axes = self._batch_axes()
+        if isinstance(axes, str):
+            spec = P(axes)
+        elif not axes:
+            spec = P()
+        else:
+            spec = P(tuple(axes) if len(axes) > 1 else axes[0])
+        return NamedSharding(self.mesh, spec)
 
     def shard_batch(self, *arrays):
         """Place per-host batch arrays onto the mesh (dp-sharded).
@@ -411,7 +568,18 @@ class ShardedTrainer:
             return new_params, new_state, loss
 
         donate = (0, 1) if self._donate else ()
-        self._step_fn = jax.jit(step, donate_argnums=donate)
+        jit_kw = {}
+        if self.mesh is not None and self._param_shardings is not None:
+            # pin the output shardings to the input placement: without
+            # this GSPMD may pick a different layout for the updated
+            # state, and step N+1 would silently re-place (or retrace)
+            # every buffer it was just donated
+            from jax.sharding import NamedSharding, PartitionSpec as SP
+
+            jit_kw["out_shardings"] = (
+                list(self._param_shardings), self._opt_shardings,
+                NamedSharding(self.mesh, SP()))
+        self._step_fn = jax.jit(step, donate_argnums=donate, **jit_kw)
         from .. import aot as _aot
 
         store = _aot.resolve_aot(self._aot)
@@ -544,6 +712,10 @@ class ShardedTrainer:
             if tel and loss_host.size == 1:
                 _telemetry.TRAIN_LOSS.set(float(loss_host.reshape(())))
         if tel:
+            # per-axis collective payload attribution (host-side plan
+            # built at placement; see _build_collective_plan)
+            for ax, op, b in self._collective_plan:
+                _telemetry.COLLECTIVE_BYTES.inc(b, axis=ax, op=op)
             # measured here so that under any loss-syncing policy (the
             # default) the window covers device execution, not just the
             # async dispatch; with policy "off" steady-state steps still
@@ -659,7 +831,15 @@ class ShardedTrainer:
         arrays["rng"] = key_data
         meta = {"kind": "sharded_trainer", "step": int(gstep),
                 "optimizer": self._opt_name,
-                "param_names": [p.name for p in self._params]}
+                "param_names": [p.name for p in self._params],
+                # the saving topology: arrays in the .npz are FULL
+                # (host-gathered) so a restore under a different mesh
+                # shape resplits them (reshard-on-load; _apply_restore
+                # detects and counts the topology change)
+                "mesh_axes": self.mesh_shape,
+                "layout": self.layout_name}
+        if self._layout_res is not None:
+            meta["param_specs"] = self._layout_res.spec_strings()
         return (int(gstep) if step is None else int(step)), arrays, {}, meta
 
     def save_checkpoint(self, manager, step=None, block=None):
@@ -710,6 +890,22 @@ class ShardedTrainer:
     def _apply_restore(self, ckpt):
         import jax
 
+        # reshard-on-load: manifests record the saving topology; when
+        # the restoring trainer's mesh/layout differ, _put_like below
+        # resplits every full array onto the NEW sharding — same
+        # digest-verified values, different placement (elastic resume).
+        saved_axes = ckpt.meta.get("mesh_axes")
+        saved_layout = ckpt.meta.get("layout")
+        if saved_axes is not None and (
+                dict(saved_axes) != self.mesh_shape
+                or saved_layout != self.layout_name):
+            import logging
+
+            logging.getLogger("mxnet_tpu.parallel").info(
+                "resharding checkpoint step %d: saved mesh=%s layout=%r "
+                "-> restoring mesh=%s layout=%r", ckpt.step, saved_axes,
+                saved_layout, self.mesh_shape, self.layout_name)
+            _telemetry.CHECKPOINT_RESHARDS.inc()
         n_ckpt = sum(1 for k in ckpt.arrays if k.startswith("param:"))
         if n_ckpt != len(self.param_arrays):
             raise MXNetError(

@@ -86,14 +86,14 @@ def ulysses_attention(q, k, v, axis_name="sp", causal=False, scale=None,
 
 
 @_functools.lru_cache(maxsize=32)
-def _sharded_fn(mesh, axis_name, causal, use_flash):
+def _sharded_fn(mesh, axis_name, causal, use_flash, batch_axis=None):
     """jit+shard_map program per (mesh, axis, causal, flash) — Mesh is
     hashable, so equal meshes share the compiled program and the cache
     is bounded (per-step make_mesh() callers neither retrace nor leak)."""
     import jax
     from jax.sharding import PartitionSpec as P
 
-    spec = P(None, axis_name)
+    spec = P(batch_axis, axis_name)
     # check_vma=False: pallas_call outputs don't carry varying-mesh-axes
     # metadata (same reason ring_attention_sharded uses check_vma=False)
     from .mesh import shard_map
@@ -106,14 +106,31 @@ def _sharded_fn(mesh, axis_name, causal, use_flash):
 
 
 def ulysses_attention_sharded(mesh, q, k, v, axis_name="sp",
-                              causal=False, use_flash=False):
+                              causal=False, use_flash=False,
+                              batch_axis=None):
     """Convenience wrapper: shard (batch, seq, heads, dim) inputs along
     `axis_name` over `mesh` and run ulysses_attention under shard_map
-    (mirror of ring_attention_sharded)."""
+    (mirror of ring_attention_sharded).
+
+    Declares its mesh consumption like the ring: ``axis_name`` must be
+    a mesh axis; ``batch_axis='dp'`` additionally shards the batch dim
+    so the engine composes with a dp × sp training mesh."""
     import jax
     from jax.sharding import NamedSharding, PartitionSpec as P
 
-    spec = P(None, axis_name)
-    fn = _sharded_fn(mesh, axis_name, bool(causal), bool(use_flash))
+    from .mesh import require_axes
+    from .. import telemetry as _telemetry
+
+    axes = (axis_name,) if batch_axis is None else (axis_name, batch_axis)
+    require_axes(mesh, axes, who="ulysses_attention_sharded")
+    if _telemetry.enabled():
+        # the standard DeepSpeed-Ulysses accounting: 4 all-to-alls of
+        # activation size (q, k, v in; output back)
+        _telemetry.COLLECTIVE_BYTES.inc(
+            int(q.nbytes) + int(k.nbytes) + int(v.nbytes)
+            + int(q.nbytes), axis=axis_name, op="all_to_all")
+    spec = P(batch_axis, axis_name)
+    fn = _sharded_fn(mesh, axis_name, bool(causal), bool(use_flash),
+                     batch_axis)
     put = lambda x: jax.device_put(x, NamedSharding(mesh, spec))
     return fn(put(q), put(k), put(v))

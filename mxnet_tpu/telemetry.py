@@ -492,6 +492,31 @@ TRAIN_MFU = gauge(
     "Model FLOPs utilization: step_flops / step_seconds / peak_flops "
     "(peak from set_peak_flops, MXNET_PEAK_TFLOPS, or docs/"
     "mfu_probe.json).")
+# mesh / sharding (parallel.mesh + parallel.train; see docs/sharding.md)
+MESH_DEVICES = gauge(
+    "mxnet_tpu_mesh_devices",
+    "Devices per named axis of the most recently constructed mesh "
+    "(parallel.mesh.make_mesh).", ("axis",))
+COLLECTIVE_BYTES = counter(
+    "mxnet_tpu_collective_bytes_total",
+    "Estimated payload bytes moved by mesh collectives, by axis and op "
+    "(psum = per-step gradient reduction over the data axes, "
+    "all_gather = fsdp parameter regathers, ppermute = ring-attention "
+    "K/V hops, all_to_all = MoE dispatch / Ulysses re-shard).  "
+    "Host-side accounting from array sizes at dispatch, not NIC "
+    "counters — exact for payload attribution, not wire overhead.",
+    ("axis", "op"))
+TRAIN_STATE_BYTES = gauge(
+    "mxnet_tpu_train_state_bytes",
+    "Per-device parameter + optimizer-state bytes actually resident "
+    "after ShardedTrainer placement (addressable-shard accounting): "
+    "the fsdp-vs-replicated memory win, readable on backends whose "
+    "allocator reports no HBM stats.", ("device",))
+CHECKPOINT_RESHARDS = counter(
+    "mxnet_tpu_checkpoint_reshards_total",
+    "Checkpoint restores whose saved mesh topology/layout differed "
+    "from the restoring trainer's (arrays were resplit onto the new "
+    "topology on load — elastic resume).")
 FUSION_REWRITES = counter(
     "mxnet_tpu_fusion_rewrites_total",
     "Graph-fusion rewrites fired at bind/hybridize/trace time, by "

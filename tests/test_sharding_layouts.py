@@ -1,0 +1,328 @@
+"""Named sharding layouts: dp x fsdp x tp mesh + spec-rule registry +
+reshard-on-load (docs/sharding.md).
+
+Tier-1 guards for the PR 9 tentpole:
+* spec resolution is TOTAL over the two benchmark models — every
+  parameter of bench_resnet50 and the transformer LM matches exactly
+  one rule, with no silent replication and no divisibility fallbacks;
+* a checkpoint saved under one mesh shape resumes BIT-FOR-BIT (params
+  + opt-state + PRNG stream) under a different mesh shape;
+* the fsdp layout measurably cuts per-device parameter+opt-state bytes
+  vs data_parallel (the train_state_bytes watermark gauge);
+* bench_lm emits a tokens_per_sec BENCH JSON line under fsdp_tp.
+
+All on the virtual 8-device CPU mesh (conftest).  Kept lean for the
+tier-1 budget: resolution tests use abstract shape evaluation (no
+compiles); only the reshard/step tests compile, on tiny nets.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon, parallel, telemetry
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.parallel import layout as playout
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples"))
+
+
+# ---------------------------------------------------------------------------
+# mesh parsing / resolution
+# ---------------------------------------------------------------------------
+
+def test_parse_and_resolve_mesh():
+    assert parallel.parse_mesh("dp=2,fsdp=2,tp=2") == \
+        {"dp": 2, "fsdp": 2, "tp": 2}
+    assert parallel.parse_mesh("") is None
+    assert parallel.parse_mesh({"dp": 4}) == {"dp": 4}
+    with pytest.raises(ValueError, match="unknown mesh axis"):
+        parallel.parse_mesh("dp=2,bogus=2")
+    with pytest.raises(ValueError, match="positive int"):
+        parallel.parse_mesh("dp=zero")
+    m = parallel.resolve_mesh("dp=2,fsdp=2,tp=2")
+    assert parallel.mesh_shape(m) == {"dp": 2, "fsdp": 2, "tp": 2}
+    # canonical order: dp outermost, tp innermost
+    assert tuple(m.axis_names) == ("dp", "fsdp", "tp")
+    assert parallel.resolve_mesh(m) is m
+    with pytest.raises(ValueError, match="needs mesh axis"):
+        parallel.require_axes(m, ("ep",), who="test")
+
+
+def test_resolve_mesh_env_default(monkeypatch):
+    monkeypatch.delenv("MXNET_MESH", raising=False)
+    assert parallel.resolve_mesh(None) is None
+    monkeypatch.setenv("MXNET_MESH", "dp=4,fsdp=2")
+    m = parallel.resolve_mesh(None)
+    assert parallel.mesh_shape(m) == {"dp": 4, "fsdp": 2}
+    # explicit arg wins over env
+    assert parallel.mesh_shape(parallel.resolve_mesh("dp=2")) == {"dp": 2}
+
+
+# ---------------------------------------------------------------------------
+# spec-rule registry
+# ---------------------------------------------------------------------------
+
+def test_layout_registry_basics(monkeypatch):
+    assert {"data_parallel", "fsdp", "fsdp_tp"} <= \
+        set(parallel.list_layouts())
+    with pytest.raises(MXNetError, match="unknown layout"):
+        parallel.get_layout("nope")
+    # ordered first-match-wins + strict no-silent-replication
+    from jax.sharding import PartitionSpec as P
+
+    lay = playout.Layout("t", [
+        playout.SpecRule("mats", r"_weight$", ("fsdp",), min_rank=2),
+    ])
+    m = parallel.resolve_mesh("dp=2,fsdp=2")
+    with pytest.raises(MXNetError, match="matched no rule"):
+        lay.resolve([("x_weight", (8, 8)), ("x_bias", (8,))], m)
+    res = lay.resolve([("x_weight", (8, 8))], m)
+    assert res.spec("x_weight") == P("fsdp")
+    assert res.rule("x_weight") == "mats"
+    # duplicate registration is loud; overwrite is explicit
+    with pytest.raises(MXNetError, match="already registered"):
+        parallel.register_layout(playout.Layout("fsdp", []))
+    # env default resolution + canonical pick by mesh axes
+    monkeypatch.delenv("MXNET_LAYOUT", raising=False)
+    assert parallel.resolve_layout(None, m).name == "fsdp"
+    tp = parallel.resolve_mesh("dp=2,tp=2")
+    assert parallel.resolve_layout(None, tp).name == "fsdp_tp"
+    assert parallel.resolve_layout(
+        None, parallel.resolve_mesh("dp=8")).name == "data_parallel"
+    monkeypatch.setenv("MXNET_LAYOUT", "data_parallel")
+    assert parallel.resolve_layout(None, m).name == "data_parallel"
+    monkeypatch.setenv("MXNET_LAYOUT", "typo")
+    with pytest.raises(MXNetError, match="unknown layout"):
+        parallel.resolve_layout(None, m)
+
+
+def test_layout_degradations_are_recorded():
+    """A mesh without the spec's axis and an indivisible dim both
+    degrade to unsharded — recorded in the resolution report, never
+    silently."""
+    from jax.sharding import PartitionSpec as P
+
+    lay = parallel.get_layout("fsdp")
+    dp_only = parallel.resolve_mesh("dp=4")
+    res = lay.resolve([("w_weight", (8, 8))], dp_only)
+    assert res.spec("w_weight") == P(None)
+    assert res.dropped_axes["w_weight"] == ["fsdp"]
+    m = parallel.resolve_mesh("dp=2,fsdp=4")
+    res = lay.resolve([("odd_bias", (10,))], m)
+    assert res.spec("odd_bias") == P(None)
+    assert res.fallbacks["odd_bias"] == [0]
+
+
+def _param_shapes(net, example_shape):
+    """(name, shape) for every parameter, via abstract shape eval —
+    no compile, no device compute (the trainer's own deferred-shape
+    path)."""
+    from mxnet_tpu.gluon.block import _abstract_eval_forward
+
+    try:
+        for p in net.collect_params().values():
+            p.data()
+    except Exception:
+        x = nd.array(np.zeros(example_shape, np.float32))
+        _abstract_eval_forward(net, [x])
+    return [(p.name, tuple(p.data().shape))
+            for p in net.collect_params().values()]
+
+
+def test_spec_resolution_total_over_bench_models():
+    """Every parameter of the two benchmark models matches exactly one
+    rule — no unmatched params (resolve raises), no divisibility
+    fallbacks, no dropped axes on the canonical meshes."""
+    from transformer_lm import TransformerLM
+
+    mesh = parallel.resolve_mesh("dp=2,fsdp=2,tp=2")
+
+    # bench_resnet50 under fsdp (bench.py model of record)
+    from mxnet_tpu.gluon.model_zoo import vision
+
+    net = vision.resnet50_v1(classes=1000)
+    net.initialize(mx.init.Xavier())
+    params = _param_shapes(net, (1, 3, 64, 64))
+    assert len(params) > 200
+    res = parallel.get_layout("fsdp").resolve(params, mesh)
+    assert set(res.specs) == {n for n, _ in params}
+    assert not res.fallbacks, res.fallbacks
+    assert not res.dropped_axes, res.dropped_axes
+    matched_rules = set(res.rules.values())
+    assert matched_rules <= {"matrix_dim0", "vector", "scalar"}
+
+    # transformer LM under fsdp_tp: the transformer-specific rules do
+    # the matching — nothing falls through to the generic matrix rule
+    lm = TransformerLM(vocab_size=256, d_model=64, n_heads=4,
+                       n_layers=2, max_len=64)
+    lm.initialize(mx.init.Xavier())
+    lm_params = _param_shapes(lm, (2, 16))
+    res = parallel.get_layout("fsdp_tp").resolve(lm_params, mesh)
+    assert set(res.specs) == {n for n, _ in lm_params}
+    assert not res.fallbacks and not res.dropped_axes
+    fired = set(res.rules.values())
+    assert {"attn_qkv", "attn_out", "ffn_up", "ffn_down", "embedding",
+            "lm_head"} <= fired
+    assert "matrix_fsdp" not in fired, [
+        n for n, r in res.rules.items() if r == "matrix_fsdp"]
+    # resolution is cached: bind twice, resolve once
+    assert parallel.get_layout("fsdp_tp").resolve(lm_params, mesh) is res
+
+
+# ---------------------------------------------------------------------------
+# trainer integration
+# ---------------------------------------------------------------------------
+
+def _tiny_trainer(mesh, layout=None, seed=3):
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(32, activation="relu", in_units=16),
+            nn.Dense(8, in_units=32))
+    net.initialize()
+    loss_fn = gluon.loss.L2Loss()
+    return parallel.ShardedTrainer(
+        net, lambda o, l: loss_fn(o, l), mesh=mesh, layout=layout,
+        optimizer="adam", optimizer_params={"learning_rate": 0.05})
+
+
+def test_fsdp_cuts_per_device_state_bytes():
+    """The acceptance gauge: fsdp halves resident param+opt bytes per
+    device vs data_parallel at the same device count — read from the
+    train_state_bytes watermark (placement-time, no compile)."""
+    telemetry.enable()
+    try:
+        telemetry.reset()
+        _tiny_trainer("dp=4", "data_parallel")
+        dp = {d["device"]: telemetry.TRAIN_STATE_BYTES.value(**d)
+              for d in telemetry.TRAIN_STATE_BYTES.series_labels()}
+        telemetry.reset()
+        _tiny_trainer("dp=2,fsdp=2", "fsdp")
+        fs = {d["device"]: telemetry.TRAIN_STATE_BYTES.value(**d)
+              for d in telemetry.TRAIN_STATE_BYTES.series_labels()}
+    finally:
+        telemetry.disable()
+    assert dp and fs
+    # replicated: every device holds the full state; fsdp=2: about half
+    # (adam: 3x param bytes all shard; small replicated remainder)
+    assert max(fs.values()) < max(dp.values()) * 0.62, (dp, fs)
+    # same device count on both meshes — an apples-to-apples comparison
+    assert len(dp) == len(fs) == 4
+
+
+def test_collective_and_mesh_telemetry():
+    """Per-axis collective payload counters + the mesh_devices gauge
+    (satellite: docs/observability.md catalog)."""
+    telemetry.enable()
+    try:
+        telemetry.reset()
+        t = _tiny_trainer("dp=2,fsdp=2", "fsdp")
+        assert telemetry.MESH_DEVICES.value(axis="dp") == 2
+        assert telemetry.MESH_DEVICES.value(axis="fsdp") == 2
+        rng = np.random.RandomState(0)
+        X = nd.array(rng.rand(8, 16).astype(np.float32))
+        Y = nd.array(rng.rand(8, 8).astype(np.float32))
+        xs, ys = t.shard_batch(X, Y)
+        t.step([xs], ys)
+        psum = telemetry.COLLECTIVE_BYTES.value(axis="dp", op="psum")
+        ag = telemetry.COLLECTIVE_BYTES.value(axis="fsdp",
+                                              op="all_gather")
+        assert psum > 0 and ag > 0
+        # payloads scale with the model: grads psum == trainable bytes
+        grad_bytes = sum(a.nbytes for a, tr in zip(t.param_arrays,
+                                                   t._trainable) if tr)
+        assert psum == grad_bytes
+    finally:
+        telemetry.disable()
+
+
+def test_reshard_on_load_bit_for_bit(tmp_path):
+    """Save under dp=4, resume under dp=2,fsdp=2: params, opt-state and
+    the PRNG stream restore bit-for-bit, and the continued loss
+    trajectory matches the uninterrupted dp=4 run."""
+    import jax
+
+    from mxnet_tpu import random as mxrand
+    from mxnet_tpu.checkpoint import CheckpointManager
+
+    rng = np.random.RandomState(0)
+    X = nd.array(rng.rand(16, 16).astype(np.float32))
+    Y = nd.array(rng.rand(16, 8).astype(np.float32))
+
+    t1 = _tiny_trainer("dp=4", "data_parallel")
+    xs, ys = t1.shard_batch(X, Y)
+    for _ in range(2):
+        t1.step([xs], ys)
+    m1 = CheckpointManager(str(tmp_path), async_save=False)
+    t1.save_checkpoint(m1)
+    cont_dp = [float(t1.step([xs], ys)) for _ in range(2)]
+
+    telemetry.enable()
+    try:
+        telemetry.reset()
+        t2 = _tiny_trainer("dp=2,fsdp=2", "fsdp")
+        m2 = CheckpointManager(str(tmp_path), async_save=False)
+        resumed = t2.attach_checkpoint_manager(
+            m2, auto_resume=True, install_signal_handler=False)
+        assert resumed == 2
+        assert telemetry.CHECKPOINT_RESHARDS.value() == 1
+    finally:
+        telemetry.disable()
+    ckpt = m2.load()
+    assert ckpt.meta["mesh_axes"] == {"dp": 4}
+    assert ckpt.meta["layout"] == "data_parallel"
+    for i, arr in enumerate(t2.param_arrays):
+        assert np.array_equal(np.asarray(arr),
+                              ckpt.arrays["param:%04d" % i]), i
+    for i, leaf in enumerate(jax.tree_util.tree_leaves(t2.opt_state)):
+        assert np.array_equal(np.asarray(leaf),
+                              ckpt.arrays["opt:%04d" % i]), i
+    assert np.array_equal(np.asarray(mxrand.get_key_data()),
+                          ckpt.arrays["rng"])
+    # fsdp placement really happened (not a replicated fallback)
+    shards = t2.param_arrays[0].addressable_shards
+    assert shards[0].data.shape != t2.param_arrays[0].shape
+    xs2, ys2 = t2.shard_batch(X, Y)
+    cont_fsdp = [float(t2.step([xs2], ys2)) for _ in range(2)]
+    np.testing.assert_allclose(cont_dp, cont_fsdp, rtol=1e-5)
+
+
+def test_trainer_rejects_unknown_layout():
+    with pytest.raises(MXNetError, match="unknown layout"):
+        _tiny_trainer("dp=4", "not_a_layout")
+
+
+# ---------------------------------------------------------------------------
+# bench_lm (acceptance: tokens_per_sec BENCH JSON under fsdp_tp)
+# ---------------------------------------------------------------------------
+
+def test_bench_lm_emits_tokens_per_sec_json(capsys):
+    import json
+
+    import bench_lm
+
+    try:
+        rc = bench_lm.main(["--mesh", "dp=2,fsdp=2,tp=2",
+                            "--layout", "fsdp_tp", "--steps", "2",
+                            "--warmup", "1", "--vocab", "64",
+                            "--d-model", "32", "--n-heads", "2",
+                            "--n-layers", "1", "--seq", "16",
+                            "--batch", "8"])
+    finally:
+        telemetry.disable()  # bench_lm enables the registry globally
+        telemetry.reset()
+    assert rc == 0
+    out = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(out)
+    assert rec["metric"] == "transformer_lm_train_tokens_per_sec"
+    assert rec["tokens_per_sec"] > 0
+    assert rec["mesh_shape"] == {"dp": 2, "fsdp": 2, "tp": 2}
+    assert rec["layout"] == "fsdp_tp"
+    assert rec["unit"] == "tokens/sec"

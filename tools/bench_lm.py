@@ -1,0 +1,172 @@
+"""Benchmark: transformer-LM training throughput (tokens/sec + MFU).
+
+The second hot-path profile next to bench.py's ResNet-50 (ROADMAP "New
+workload"): a decoder-only LM (examples/transformer_lm.py) trained by
+ShardedTrainer over a named dp x fsdp x tp mesh with a spec-rule layout
+(docs/sharding.md).  Emits ONE BENCH JSON line on stdout carrying
+``tokens_per_sec``, ``mfu`` (model-FLOPs accounting over the PR 4 peak
+gauge), and the ``mesh_shape``/``layout`` the number was measured under
+— so the perf trajectory is attributable to topology.
+
+    # 8-virtual-device CPU harness, canonical LLM layout:
+    XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python tools/bench_lm.py --mesh dp=2,fsdp=2,tp=2 --layout fsdp_tp
+
+    # real chip (defaults scale up on accelerator backends):
+    python tools/bench_lm.py --mesh fsdp=4,tp=2
+
+Progress goes to stderr; stdout is the parsed JSON line only.
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+for p in (REPO, os.path.join(REPO, "examples")):
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+_T0 = time.time()
+
+
+def log(msg):
+    print("[bench_lm %6.1fs] %s" % (time.time() - _T0, msg),
+          file=sys.stderr, flush=True)
+
+
+def build_lm_trainer(mesh=None, layout=None, vocab=None, d_model=None,
+                     n_heads=None, n_layers=None, seq=None, batch=None,
+                     optimizer="adam"):
+    """The LM benchmark-of-record configuration, shared with the tier-1
+    smoke test (tests/test_sharding_layouts.py) so the committed BENCH
+    numbers describe the exact program the suite guards.
+
+    Returns (trainer, tokens, labels, cfg_dict)."""
+    import jax
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd, parallel
+    from transformer_lm import TransformerLM, lm_loss_fn
+
+    on_tpu = any(d.platform != "cpu" for d in jax.devices())
+    # accelerator defaults vs CPU smoke defaults (bench.py discipline:
+    # the CPU harness proves the program, the chip proves the number)
+    vocab = vocab or (32000 if on_tpu else 256)
+    d_model = d_model or (512 if on_tpu else 64)
+    n_heads = n_heads or (8 if on_tpu else 4)
+    n_layers = n_layers or (8 if on_tpu else 2)
+    seq = seq or (512 if on_tpu else 32)
+    batch = batch or (32 if on_tpu else 8)
+
+    lm = TransformerLM(vocab_size=vocab, d_model=d_model, n_heads=n_heads,
+                       n_layers=n_layers, max_len=max(seq, 64))
+    lm.initialize(mx.init.Xavier())
+    trainer = parallel.ShardedTrainer(
+        lm, lm_loss_fn(vocab), mesh=mesh, layout=layout,
+        optimizer=optimizer, optimizer_params={"learning_rate": 1e-3},
+        dtype=jax.numpy.bfloat16 if on_tpu else None)
+    rng = np.random.RandomState(0)
+    tokens = nd.array(rng.randint(0, vocab, (batch, seq))
+                      .astype(np.float32))
+    labels = nd.array(rng.randint(0, vocab, (batch, seq))
+                      .astype(np.float32))
+    cfg = dict(vocab=vocab, d_model=d_model, n_heads=n_heads,
+               n_layers=n_layers, seq=seq, batch=batch, on_tpu=on_tpu,
+               flops_per_token=lm.flops_per_token(seq_len=seq))
+    return trainer, tokens, labels, cfg
+
+
+def run(mesh=None, layout=None, steps=20, warmup=2, **model_kw):
+    import jax
+
+    from mxnet_tpu import telemetry
+
+    telemetry.enable()  # MFU gauge + collective/state-bytes accounting
+    trainer, tokens, labels, cfg = build_lm_trainer(
+        mesh=mesh, layout=layout, **model_kw)
+    if not cfg["on_tpu"]:
+        steps = min(steps, 3)
+        warmup = min(warmup, 1)
+    log("devices=%d mesh=%s layout=%s model=%s"
+        % (len(jax.devices()), trainer.mesh_shape, trainer.layout_name,
+           {k: cfg[k] for k in ("vocab", "d_model", "n_heads", "n_layers",
+                                "seq", "batch")}))
+    xs, ys = trainer.shard_batch(tokens, labels)
+
+    warmup_step_secs = []
+    for i in range(max(warmup, 1)):
+        t_s = time.perf_counter()
+        loss = trainer.step([xs], ys)
+        jax.block_until_ready(loss)
+        warmup_step_secs.append(round(time.perf_counter() - t_s, 3))
+        log("warmup step %d done (loss=%.4f, %.1fs)"
+            % (i, float(loss), warmup_step_secs[-1]))
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = trainer.step([xs], ys)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    log("%d steps in %.3fs (loss=%.4f)" % (steps, dt, float(loss)))
+
+    tokens_per_step = cfg["batch"] * cfg["seq"]
+    tps = tokens_per_step * steps / dt
+    # MFU two ways: the XLA cost-analysis gauge (exact program FLOPs)
+    # when a peak is known, else the 6N analytic accounting only
+    peak = telemetry.peak_flops()
+    step_secs = dt / steps
+    model_flops = cfg["flops_per_token"] * tokens_per_step
+    mfu = None
+    # on the CPU harness the docs/mfu_probe.json peak describes the
+    # chip, not this host — only report MFU when the peak matches the
+    # backend (or the operator pinned one via MXNET_PEAK_TFLOPS)
+    if peak and (cfg["on_tpu"] or os.environ.get("MXNET_PEAK_TFLOPS")):
+        mfu = round(model_flops / step_secs / peak, 4)
+    result = {
+        "metric": "transformer_lm_train_tokens_per_sec",
+        "value": round(tps, 2),
+        "unit": "tokens/sec",
+        "tokens_per_sec": round(tps, 2),
+        "mfu": mfu,
+        "model_flops_per_step": model_flops,
+        "mesh_shape": trainer.mesh_shape,
+        "layout": trainer.layout_name,
+        "batch": cfg["batch"],
+        "seq_len": cfg["seq"],
+        "warmup_step_seconds": warmup_step_secs,
+    }
+    return result
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--mesh", default=None,
+                   help="mesh spec, e.g. dp=2,fsdp=2,tp=2 (default: "
+                        "MXNET_MESH, else single device)")
+    p.add_argument("--layout", default=None,
+                   help="layout name (default: MXNET_LAYOUT, else the "
+                        "canonical layout for the mesh axes)")
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--vocab", type=int, default=None)
+    p.add_argument("--d-model", type=int, default=None)
+    p.add_argument("--n-heads", type=int, default=None)
+    p.add_argument("--n-layers", type=int, default=None)
+    p.add_argument("--seq", type=int, default=None)
+    p.add_argument("--batch", type=int, default=None)
+    a = p.parse_args(argv)
+    result = run(mesh=a.mesh, layout=a.layout, steps=a.steps,
+                 warmup=a.warmup, vocab=a.vocab, d_model=a.d_model,
+                 n_heads=a.n_heads, n_layers=a.n_layers, seq=a.seq,
+                 batch=a.batch)
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -65,8 +65,15 @@ def collective_worker(rank, n_procs, dev_per_proc, port):
     assert len(devs) == n_procs * dev_per_proc, \
         "global mesh sees %d devices" % len(devs)
 
-    # dp spans the hosts (DCN), tp the intra-host devices (ICI)
-    mesh = parallel.make_mesh({"dp": n_procs, "tp": dev_per_proc}, devs)
+    # default: dp spans the hosts (DCN), tp the intra-host devices
+    # (ICI); --mesh overrides via the env relay (validated upstream)
+    axes = parallel.parse_mesh(os.environ.get("MXTPU_MESH_SPEC")) or \
+        {"dp": n_procs, "tp": dev_per_proc}
+    mesh = parallel.make_mesh(axes, devs)
+    local = [d for d in mesh.devices.flat if d.process_index == rank]
+    print("MULTIHOST_MESH rank=%d axes=%s local_devices=%d" % (
+        rank, json.dumps(parallel.mesh_shape(mesh), sort_keys=True),
+        len(local)), flush=True)
 
     mx.random.seed(7)      # identical replicated params on every host
     np.random.seed(7)
@@ -74,9 +81,11 @@ def collective_worker(rank, n_procs, dev_per_proc, port):
     net.add(nn.Dense(32, activation="relu"), nn.Dense(8))
     net.initialize()
 
+    tp_size = axes.get("tp", 0)
+
     def spec_fn(name, shape):
-        if name.endswith("weight") and len(shape) == 2 \
-                and shape[0] % dev_per_proc == 0:
+        if tp_size and name.endswith("weight") and len(shape) == 2 \
+                and shape[0] % tp_size == 0:
             return P("tp", None)
         return None
 
@@ -144,10 +153,74 @@ def ps_worker(rank, port, n_workers):
 # ---------------------------------------------------------------------------
 
 
-def run(n_procs=2, dev_per_proc=4, json_path=None):
+# mirror of parallel.mesh.MESH_AXES — local copy keeps the orchestrator
+# free of the jax import (workers validate again through parse_mesh)
+_MESH_AXES = ("dp", "fsdp", "pp", "ep", "sp", "mp", "tp")
+
+
+def _parse_mesh_arg(spec):
+    """Lightweight 'dp=2,tp=4' parse for the orchestrator (no jax)."""
+    axes = {}
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, size = part.partition("=")
+        name = name.strip()
+        if name not in _MESH_AXES or not size.strip().isdigit():
+            raise SystemExit("bad --mesh entry %r (axis=size over %s)"
+                             % (part, list(_MESH_AXES)))
+        axes[name] = int(size)
+    return axes
+
+
+def _print_host_layout(axes, n_procs, dev_per_proc):
+    """The resolved per-host view of --mesh: which axes span hosts (DCN)
+    vs stay intra-host (ICI), and each rank's global device slice."""
+    total = 1
+    for v in axes.values():
+        total *= v
+    if total != n_procs * dev_per_proc:
+        raise SystemExit(
+            "--mesh %s needs %d devices; topology has %d procs x %d = %d"
+            % (axes, total, n_procs, dev_per_proc,
+               n_procs * dev_per_proc))
+    order = [a for a in _MESH_AXES if a in axes]
+    # device ids are laid out row-major in canonical axis order, hosts
+    # own contiguous dev_per_proc blocks: an axis group touches ids
+    # {i, i+stride, ..., i+(size-1)*stride}, so it stays inside one
+    # host block only when its whole extent (stride * size) fits the
+    # block — e.g. dp=4,tp=2 over 2x4 hosts has dp stride 2 but group
+    # {0,2,4,6}, which crosses the host boundary
+    stride = total
+    spans = []
+    for a in order:
+        size = axes[a]
+        extent = stride          # = stride(after) * size
+        stride //= size
+        spans.append((a, size, "hosts/DCN" if extent > dev_per_proc
+                      and size > 1 else "local/ICI"))
+    print("mesh %s over %d hosts x %d devices:"
+          % (",".join("%s=%d" % (a, axes[a]) for a in order), n_procs,
+             dev_per_proc), flush=True)
+    for a, size, where in spans:
+        print("  axis %-4s size %d  (%s)" % (a, size, where), flush=True)
+    for r in range(n_procs):
+        print("  rank %d: global devices [%d..%d]"
+              % (r, r * dev_per_proc, (r + 1) * dev_per_proc - 1),
+              flush=True)
+
+
+def run(n_procs=2, dev_per_proc=4, json_path=None, mesh=None):
     result = {"n_procs": n_procs, "dev_per_proc": dev_per_proc,
               "topology": "dp(%d hosts over DCN) x tp(%d local devices)"
                           % (n_procs, dev_per_proc)}
+    if mesh:
+        axes = _parse_mesh_arg(mesh)
+        _print_host_layout(axes, n_procs, dev_per_proc)
+        result["mesh"] = axes
+        result["topology"] = mesh
+        os.environ["MXTPU_MESH_SPEC"] = mesh  # relay to workers
 
     # --- 1. jax.distributed collective step ---
     port = _free_port()
@@ -236,6 +309,11 @@ if __name__ == "__main__":
     p.add_argument("--n-procs", type=int, default=2)
     p.add_argument("--dev-per-proc", type=int, default=4)
     p.add_argument("--json", default=None)
+    p.add_argument("--mesh", default=None,
+                   help="mesh spec for the collective drill, e.g. "
+                        "'dp=2,tp=4' (product must equal n_procs x "
+                        "dev_per_proc); prints the resolved per-host "
+                        "layout before launching")
     a = p.parse_args()
-    r = run(a.n_procs, a.dev_per_proc, a.json)
+    r = run(a.n_procs, a.dev_per_proc, a.json, mesh=a.mesh)
     sys.exit(0 if r["ok"] else 1)
