@@ -543,6 +543,21 @@ def resolve_aot(spec):
 # ---------------------------------------------------------------------------
 
 
+def multi_device_deserialization_safe():
+    """Whether this process may DESERIALIZE multi-device executables.
+
+    jax 0.4.x mis-deserializes multi-device CPU executables — the same
+    bug :func:`mxnet_tpu.config.compile_cache_safe` version-gates the
+    persistent compile cache for.  Measured here too: an AOT-loaded
+    8-virtual-device sharded train step returns *wrong losses* (single-
+    device artifacts round-trip fine, so only multi-device loads are
+    gated).  Saves still happen: the store stays correct, this process
+    just recompiles, and a fixed jax gets the hits back."""
+    from . import config as _config
+
+    return _config.compile_cache_safe()
+
+
 def unwrap(fn):
     """The raw ``jax.jit`` callable behind ``fn`` (identity for plain
     jits).  Trace-time consumers (``jax.eval_shape``, vjp-of-jit) must
@@ -656,7 +671,22 @@ class AOTFunction:
                                  extra=self._extra)
             if info is not None:
                 info["key"] = key
-            compiled = self._try_load(key)
+            # multi-device arguments (a "," joined device list in any
+            # leaf sig) + an affected jax line: loading would return a
+            # silently-wrong executable — treat as a miss and recompile
+            gated = any("," in (s[3] or "") for s in sig[0]) and \
+                not multi_device_deserialization_safe()
+            if gated:
+                _warn_once(
+                    "desergate:" + self.label,
+                    "AOT %s: multi-device executable loads are disabled "
+                    "on this jax (0.4.x multi-device CPU "
+                    "deserialization bug; see "
+                    "aot.multi_device_deserialization_safe) — "
+                    "compiling instead" % self.label)
+                if info is not None:
+                    info["deser_gated"] = True
+            compiled = None if gated else self._try_load(key)
             if compiled is not None:
                 if tel:
                     _telemetry.AOT_CACHE_HITS.inc()

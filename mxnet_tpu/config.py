@@ -167,6 +167,27 @@ FLAGS = {
         "", str, "honored",
         "accelerator peak TFLOP/s for the MFU gauge (overrides the "
         "docs/mfu_probe.json ceiling; '' = probe artifact or no MFU)"),
+    "MXNET_ASYNC_METRICS": (
+        "0", _pbool, "honored",
+        "non-blocking train-step metrics (parallel/train.py): step() "
+        "never syncs on the loss; device-resident accumulators are "
+        "pulled by a bounded background fetch and TRAIN_LOSS/heartbeat "
+        "consume the last completed fetch.  Hard syncs remain only at "
+        "checkpoint/drain boundaries.  Per-trainer override via "
+        "async_metrics="),
+    "MXNET_STEPS_PER_CALL": (
+        "1", _pint, "honored",
+        "K-step fused train loop: ShardedTrainer.step_many runs K "
+        "pre-staged microbatches as ONE XLA call (lax.scan over a "
+        "donated carry), amortizing per-step dispatch.  1 = one program "
+        "per step (the historical path).  Per-trainer override via "
+        "steps_per_call="),
+    "MXNET_DEVICE_PREFETCH": (
+        "2", _pint, "honored",
+        "default depth of io.DevicePrefetcher: batches whose host->HBM "
+        "upload (sharded over the layout's data axes) is staged ahead "
+        "of the consuming train step; 0 disables the wrapper "
+        "(DataLoader device_prefetch= / io/prefetch.py)"),
     "MXNET_NONFINITE_POLICY": (
         "warn", str, "honored",
         "default step-guard policy for NaN/Inf losses & gradient norms: "

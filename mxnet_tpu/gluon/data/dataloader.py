@@ -59,10 +59,17 @@ class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, pin_device_id=0,
-                 prefetch=None, thread_pool=False, timeout=120):
+                 prefetch=None, thread_pool=False, timeout=120,
+                 device_prefetch=None):
         self._dataset = dataset
         self._pin_memory = pin_memory
         self._timeout = timeout
+        # device_prefetch bridges this loader to io.DevicePrefetcher:
+        # the NEXT batch's host->HBM upload overlaps the current train
+        # step.  Accepts a ShardedTrainer (stage via its shard_batch /
+        # layout data axes), a callable put(batch), or True (plain
+        # device_put); depth comes from MXNET_DEVICE_PREFETCH.
+        self._device_prefetch = device_prefetch
 
         if batch_sampler is None:
             if batch_size is None:
@@ -95,8 +102,43 @@ class DataLoader:
                     yield self._batchify_fn(
                         [self._dataset[idx] for idx in batch])
 
-            return same_process_iter()
-        return _MultiWorkerIter(self)
+            it = same_process_iter()
+        else:
+            it = _MultiWorkerIter(self)
+        dp = self._device_prefetch
+        if dp is None or dp is False or dp == 0:
+            return it
+        from ...io.prefetch import DevicePrefetcher
+
+        if dp is True:
+            kw = {}
+        elif isinstance(dp, int):  # an int reads as a depth (the
+            # MXNET_DEVICE_PREFETCH unit), not a trainer
+            kw = {"depth": dp}
+        elif hasattr(dp, "shard_batch"):
+            kw = {"trainer": dp}
+        elif callable(dp):
+            kw = {"put": dp}
+        else:
+            raise ValueError(
+                "device_prefetch= accepts True, a depth int, a "
+                "ShardedTrainer, or a put(batch) callable; got %r"
+                % (dp,))
+
+        def staged():
+            # prefetcher built INSIDE the generator (first next()), so
+            # an iterator that is never advanced never starts a
+            # producer thread; the finally releases the thread and its
+            # staged device buffers on break/exception/GC instead of
+            # leaking one blocked producer per __iter__ call
+            pf = DevicePrefetcher(it, **kw)
+            try:
+                for batch in pf:
+                    yield batch
+            finally:
+                pf.close()
+
+        return staged()
 
     def __len__(self):
         return len(self._batch_sampler)

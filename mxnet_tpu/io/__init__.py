@@ -2,3 +2,4 @@
 from .io import (DataDesc, DataBatch, DataIter, NDArrayIter, CSVIter,  # noqa: F401
                  MNISTIter, ImageRecordIter, ResizeIter, PrefetchingIter,
                  LibSVMIter)
+from .prefetch import DevicePrefetcher  # noqa: F401

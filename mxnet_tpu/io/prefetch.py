@@ -1,0 +1,159 @@
+"""Overlapped device prefetch: stage the NEXT batch's host->HBM upload
+against the CURRENT step's compute.
+
+Reference counterpart: the dependency engine's write-dependency overlap
+(`Engine::PushAsync`) plus ``io.PrefetchingIter`` — the reference's
+iterators hand off to a background thread so decode/copy and compute
+never serialize.  TPU-native: ``device_put`` is itself asynchronous, so
+the win here is moving the *host-side* staging (numpy materialization,
+sharding layout, the ``shard_batch`` call) off the training loop's
+critical path and issuing the upload one-plus batches early; by the
+time ``step`` dispatches, the batch's device buffers are already in
+flight on the transfer engine.
+
+    loader = gluon.data.DataLoader(ds, batch_size=64, num_workers=2)
+    with DevicePrefetcher(loader, trainer=trainer) as batches:
+        for x, y in batches:
+            trainer.step([x], y)
+
+The wrapper is front-end agnostic: ``trainer=`` stages through
+``ShardedTrainer.shard_batch`` (the layout's data axes), ``put=`` takes
+any callable, and the default is a plain ``jax.device_put`` per
+element.  Depth comes from ``MXNET_DEVICE_PREFETCH`` (0 disables — the
+wrapper degrades to a passthrough iterator).
+"""
+from __future__ import annotations
+
+import queue as _queue
+import threading as _threading
+
+from .. import telemetry as _telemetry
+from .. import tracing as _tracing
+
+__all__ = ["DevicePrefetcher"]
+
+_END = object()
+
+
+def _default_put(batch):
+    """Plain per-element device upload (no mesh: single-device)."""
+    import jax
+
+    from ..ndarray.ndarray import NDArray
+
+    def one(x):
+        raw = x._data if isinstance(x, NDArray) else x
+        return jax.device_put(raw)
+
+    if isinstance(batch, (tuple, list)):
+        return type(batch)(one(x) for x in batch)
+    return one(batch)
+
+
+class DevicePrefetcher:
+    """Iterate ``source``, staging each batch onto device ``depth``
+    batches ahead of the consumer on a background thread.
+
+    Batches flow through unchanged in ORDER and COUNT; only their
+    placement moves earlier — swapping the wrapper in/out cannot change
+    training numerics.  Exceptions raised by ``source`` or the staging
+    callable surface at the consumer's ``next()`` call, after all
+    previously staged batches were delivered.
+    """
+
+    def __init__(self, source, put=None, trainer=None, depth=None):
+        from .. import config as _config
+
+        if depth is None:
+            depth = _config.get("MXNET_DEVICE_PREFETCH")
+        self._depth = max(0, int(depth))
+        if put is not None:
+            self._put = put
+        elif trainer is not None:
+            # stage through the trainer's layout (data-axes sharding);
+            # non-tuple batches are treated as a single array
+            def put_via_trainer(batch):
+                if isinstance(batch, (tuple, list)):
+                    return type(batch)(trainer.shard_batch(*batch))
+                return trainer.shard_batch(batch)[0]
+
+            self._put = put_via_trainer
+        else:
+            self._put = _default_put
+        self._source = iter(source)
+        self._q = None
+        self._thread = None
+        self._closed = False
+        self._done = False
+        if self._depth > 0:
+            self._q = _queue.Queue(maxsize=self._depth)
+            self._thread = _threading.Thread(
+                target=self._run, name="mxnet_tpu-device-prefetch",
+                daemon=True)
+            self._thread.start()
+
+    # -- producer ---------------------------------------------------------
+    def _run(self):
+        try:
+            for batch in self._source:
+                self._q.put(("ok", self._put(batch)))
+                if self._closed:
+                    return
+        except BaseException as e:  # surfaced at the consumer's next()
+            self._q.put(("err", e))
+        else:
+            self._q.put((None, _END))
+
+    # -- consumer ---------------------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._q is None:  # depth 0: passthrough, stage inline
+            return self._put(next(self._source))
+        if self._done:
+            # the producer exited (end or error already delivered):
+            # keep raising StopIteration instead of blocking on a
+            # queue nothing will ever feed again
+            raise StopIteration
+        try:
+            kind, item = self._q.get_nowait()
+        except _queue.Empty:
+            # the train loop beat the pipeline to the handoff: the
+            # input path, not the chip, bounds this step
+            if _telemetry.enabled():
+                _telemetry.PREFETCH_STALLS.inc()
+            _tracing.instant("prefetch:stall")
+            kind, item = self._q.get()
+        if kind == "err":
+            self._done = True
+            raise item
+        if item is _END:
+            self._done = True
+            raise StopIteration
+        return item
+
+    def close(self):
+        """Stop the producer and release staged batches.  The producer
+        re-checks ``_closed`` after each handoff, so draining the queue
+        unblocks it at most one batch later; staged device buffers are
+        dropped for GC."""
+        self._closed = True
+        self._done = True
+        if self._q is not None:
+            for _ in range(self._depth + 2):
+                try:
+                    while True:
+                        self._q.get_nowait()
+                except _queue.Empty:
+                    pass
+                if self._thread is None or not self._thread.is_alive():
+                    break
+                self._thread.join(timeout=0.05)
+        self._source = iter(())
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self.close()

@@ -15,6 +15,8 @@ from __future__ import annotations
 import logging
 import time
 
+import numpy as np
+
 from .. import metric as _metric
 from .. import ndarray
 from .. import telemetry as _telemetry
@@ -196,6 +198,7 @@ class BaseModule:
         nbatch = 0
         tel = _telemetry.enabled()
         tr_on = _tracing.enabled()
+        prev_dispatch_end = None
         while batch is not None:
             if checkpoint_manager is not None and \
                     checkpoint_manager.preempted:
@@ -206,20 +209,36 @@ class BaseModule:
                                 args={"epoch": epoch, "batch": nbatch}) \
                 if tr_on else None
             t_batch0 = time.perf_counter() if tel else None
+            if tel and prev_dispatch_end is not None:
+                # dispatch-to-dispatch idle: host time this loop spent
+                # outside forward/backward/update (batch lookahead,
+                # metric update, callbacks) — the same gauge the
+                # ShardedTrainer hot path exports
+                _telemetry.HOST_GAP_SECONDS.observe(
+                    max(0.0, t_batch0 - prev_dispatch_end), loop="module")
             if monitor is not None:
                 monitor.tic()
             self.forward_backward(batch)
             apply_update = True
             if on_nonfinite != "off":
-                outs = [o.asnumpy() for o in self.get_outputs()]
+                # device-side reduction when the subclass offers one
+                # (Module): syncs one boolean instead of transferring
+                # every output array to the host per batch
+                fin = getattr(self, "_outputs_finite", None)
+                if fin is not None:
+                    probe = np.float32(0.0 if fin() else np.nan)
+                else:
+                    probe = [o.asnumpy() for o in self.get_outputs()]
                 apply_update = _ckpt.check_finite(
-                    outs, on_nonfinite,
+                    probe, on_nonfinite,
                     what="outputs (epoch %d batch %d)" % (epoch, nbatch),
                     logger=self.logger)
             if apply_update:
                 self.update()
             else:
                 _telemetry.TRAIN_SKIPPED_STEPS.inc(loop="module")
+            if tel:
+                prev_dispatch_end = time.perf_counter()
             try:
                 upcoming = next(it)
                 self.prepare(upcoming, sparse_row_id_fn=sparse_row_id_fn)

@@ -392,6 +392,26 @@ class Module(BaseModule):
     def update_metric(self, eval_metric, labels, pre_sliced=False):
         self._exec_group.update_metric(eval_metric, labels, pre_sliced)
 
+    def _outputs_finite(self):
+        """Device-side probe for the fit non-finite guard: reduce every
+        float output to ONE boolean on device and sync only that,
+        instead of transferring full output arrays to the host each
+        batch (the per-batch ``asnumpy`` the guard used to pay)."""
+        import jax.numpy as jnp
+
+        flags = []
+        for o in self.get_outputs():
+            data = getattr(o, "_data", o)
+            if jnp.issubdtype(data.dtype, jnp.floating) or \
+                    jnp.issubdtype(data.dtype, jnp.complexfloating):
+                flags.append(jnp.all(jnp.isfinite(data)))
+        if not flags:
+            return True
+        ok = flags[0]
+        for f in flags[1:]:
+            ok = jnp.logical_and(ok, f)
+        return bool(ok)
+
     def _sync_params_from_devices(self):
         self._exec_group.get_params(self._arg_params, self._aux_params)
         if self._kvstore and self._update_on_kvstore:

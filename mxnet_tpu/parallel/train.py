@@ -15,7 +15,9 @@ back to the Parameter objects on demand.
 from __future__ import annotations
 
 import functools
+import queue as _queue
 import signal as _signal
+import threading as _threading
 import time as _time
 
 import numpy as np
@@ -29,6 +31,83 @@ from .. import tracing as _tracing
 from ..gluon import block as _block_mod
 
 __all__ = ["ShardedTrainer", "sgd_init", "adam_init"]
+
+
+# device-resident metric accumulator: one f32 vector riding the compiled
+# step's donated carry, transferred to the host only at flush boundaries
+# (every ``metrics_every`` steps) instead of per step.  Layout:
+#   [0] sum of FINITE losses   [1] steps accumulated
+#   [2] non-finite loss count  [3] loss of the newest step (raw)
+_M_LOSS_SUM, _M_STEPS, _M_NONFINITE, _M_LAST = range(4)
+_METRICS_WIDTH = 4
+
+
+class _MetricFetcher:
+    """Bounded background device->host metric pull.
+
+    jax arrays are futures: ``np.asarray`` here blocks until the device
+    values land, so the *dispatch* thread never does — the reference
+    dependency engine's read-dependency resolution, reduced to one
+    consumer thread.  The queue bound doubles as backpressure: once
+    ``depth`` flushes are in flight, the next submit blocks the
+    dispatch loop until the chip catches up, so the host can never run
+    unboundedly ahead of device execution.
+    """
+
+    def __init__(self, apply_fn, depth=2):
+        self._apply = apply_fn
+        self.error = None  # first fetch/apply failure (drain re-raises)
+        self._q = _queue.Queue(maxsize=max(1, int(depth)))
+        self._thread = _threading.Thread(
+            target=self._run, name="mxnet_tpu-metric-fetch", daemon=True)
+        self._thread.start()
+
+    def submit(self, step, n_steps, acc):
+        self._q.put((step, n_steps, acc))
+        if _telemetry.enabled():
+            _telemetry.ASYNC_FETCH_INFLIGHT.set(self._q.qsize())
+
+    def wait(self):
+        """Block until every submitted fetch has completed AND been
+        applied (the drain barrier)."""
+        self._q.join()
+
+    def close(self):
+        self._q.put(None)
+        self._thread.join(timeout=5.0)
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            try:
+                if item is None:
+                    return
+                step, n_steps, acc = item
+                sp = _tracing.begin(
+                    "step:fetch", args={"step": step, "steps": n_steps}) \
+                    if _tracing.enabled() else None
+                try:
+                    host = np.asarray(acc)  # blocks on device completion
+                    self._apply(step, n_steps, host, async_mode=True)
+                except Exception as e:
+                    # never let a poisoned fetch kill the thread: wait()
+                    # would deadlock with no consumer left.  The first
+                    # error is kept for the next drain boundary.
+                    if self.error is None:
+                        self.error = e
+                    if sp is not None:
+                        sp.end(error=True)
+                        sp = None
+                finally:
+                    if sp is not None:
+                        sp.end()
+            finally:
+                self._q.task_done()
+                if _telemetry.enabled():
+                    _telemetry.ASYNC_FETCH_INFLIGHT.set(
+                        max(0, self._q.qsize()))
+                    if item is not None:
+                        _telemetry.ASYNC_METRIC_FETCHES.inc()
 
 
 # ---- functional optimizers (pytree-level, fused into the step) ----------
@@ -105,18 +184,40 @@ class ShardedTrainer:
         the pre-layout escape hatch; when given it wins over ``layout``
     dtype : compute dtype for activations (bf16 default on TPU; params and
         optimizer state stay fp32 — the MultiPrecision recipe)
+    async_metrics : non-blocking step dispatch (None = the
+        ``MXNET_ASYNC_METRICS`` env default).  ``step`` returns device
+        arrays without syncing; loss/skip-count/heartbeat values are
+        pulled by a bounded background fetch thread and consumed one
+        flush late.  Hard syncs remain only at checkpoint boundaries
+        and :meth:`drain`.  Under the ``"raise"`` non-finite policy the
+        error surfaces at the next ``step``/``drain`` call after the
+        fetch lands instead of inside the offending step.
+    steps_per_call : K>1 enables :meth:`step_many` — K pre-staged
+        microbatches run as ONE compiled ``lax.scan`` program with the
+        params/opt-state/metrics carry donated (None = the
+        ``MXNET_STEPS_PER_CALL`` env default).  Numerics are bit-for-bit
+        identical to K sequential ``step`` calls.
+    metrics_every : transfer the device-resident metric accumulator
+        (loss sum / step count / non-finite count / last loss) to the
+        host every N steps (default: once per dispatch call).
+    fetch_depth : bound on in-flight background fetches; a full queue
+        backpressures dispatch so the host can never run unboundedly
+        ahead of the chip (default 2).
     """
 
     def __init__(self, net, loss_fn, mesh=None, optimizer="sgd",
                  optimizer_params=None, batch_axis_spec=None,
                  param_spec_fn=None, dtype=None, donate=True,
                  remat_policy=None, fusion=None, on_nonfinite=None,
-                 aot=None, aot_spec=None, layout=None):
+                 aot=None, aot_spec=None, layout=None,
+                 async_metrics=None, steps_per_call=None,
+                 metrics_every=None, fetch_depth=2):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         from ..remat import resolve_policy
         from ..checkpoint import nonfinite_policy
+        from .. import config as _config
         from .. import fusion_cost as _fc
         from .. import aot as _aot
         from .mesh import resolve_mesh
@@ -149,6 +250,33 @@ class ShardedTrainer:
         # discards the whole update (params, optimizer state, moving
         # stats) and keeps the previous state
         self._on_nonfinite = nonfinite_policy(on_nonfinite)
+        # host-overlap knobs (ISSUE 10 — the dependency-engine overlap):
+        # async_metrics moves every loss/metric host read off the
+        # dispatch path onto a bounded fetch thread; steps_per_call=K
+        # fuses K microbatch steps into one lax.scan program
+        # (step_many).  Both default from the MXNET_* env knobs.
+        self._async = _config.get("MXNET_ASYNC_METRICS") \
+            if async_metrics is None else bool(async_metrics)
+        k = _config.get("MXNET_STEPS_PER_CALL") \
+            if steps_per_call is None else int(steps_per_call)
+        if k < 1:
+            raise MXNetError("steps_per_call must be >= 1; got %d" % k)
+        self.steps_per_call = k
+        # flush the device accumulator every N steps; default = one
+        # flush per dispatch call (per step when K=1 — the historical
+        # per-step loss cadence, just non-blocking under async)
+        self._metrics_every_explicit = metrics_every is not None
+        self._metrics_every = max(1, int(metrics_every)) \
+            if metrics_every is not None else k
+        self._fetch_depth = max(1, int(fetch_depth))
+        self._fetcher = None
+        self._pending_exc = None
+        self._metrics_acc = None
+        self._metrics_pending = 0
+        self._last_dispatch_end = None
+        self._step_k_fn = None
+        self._step_core = None
+        self._last_rng = None
         self.global_step = 0
         self.skipped_steps = 0
         self._step_flops = None  # one-time XLA cost attribution (telemetry)
@@ -243,10 +371,26 @@ class ShardedTrainer:
             dev = jax.devices()[0]
             self.opt_state = jax.tree_util.tree_map(
                 lambda a: jax.device_put(a, dev), self.opt_state)
+        # the device-resident metric accumulator rides the step carry
+        # (donated in/out); replicated so every shard agrees
+        self._metrics_acc = self._fresh_metrics()
         if self._pending_restore is not None:
             # checkpoint attached before shapes were known: apply now
             ckpt, self._pending_restore = self._pending_restore, None
             self._apply_restore(ckpt)
+
+    def _fresh_metrics(self):
+        """A zeroed, committed metric-accumulator buffer (a new one is
+        needed after every flush: the previous buffer was donated to
+        the fetch)."""
+        import jax
+
+        z = np.zeros((_METRICS_WIDTH,), np.float32)
+        if self.mesh is not None:
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            return self._global_put(jax, z, NamedSharding(self.mesh, P()))
+        return jax.device_put(z, jax.devices()[0])
 
     # -- sharding placement ----------------------------------------------
     @property
@@ -512,7 +656,7 @@ class ShardedTrainer:
         pidx = self._param_index
         guard_skip = self._on_nonfinite == "skip"
 
-        def step(param_arrays, opt_state, inputs, label, rng):
+        def step(param_arrays, opt_state, inputs, label, rng, metrics):
             def lf(train_params):
                 full = []
                 ti = 0
@@ -550,9 +694,9 @@ class ShardedTrainer:
             for p, v in zip(aux_meta["params"], aux):
                 i = pidx[id(p)]
                 new_params[i] = v.astype(new_params[i].dtype)
-            if guard_skip:
-                import jax.numpy as jnp
+            import jax.numpy as jnp
 
+            if guard_skip:
                 # non-finite guard fused into the step: a NaN/Inf loss
                 # selects the PREVIOUS params/opt-state/moving-stats, so
                 # one poisoned batch cannot corrupt training state (the
@@ -565,9 +709,35 @@ class ShardedTrainer:
                 new_state = jax.tree_util.tree_map(
                     lambda n, o: jnp.where(keep, n, o), new_state,
                     opt_state)
-            return new_params, new_state, loss
+            # device-resident metric accumulation (no host sync): the
+            # vector is donated in/out, so across steps the running
+            # sums never leave HBM until a flush boundary
+            finite = jnp.isfinite(loss)
+            new_metrics = metrics + jnp.stack(
+                [jnp.where(finite, loss, 0.0), jnp.ones((), jnp.float32),
+                 jnp.where(finite, 0.0, 1.0), jnp.zeros((), jnp.float32)])
+            new_metrics = new_metrics.at[_M_LAST].set(loss)
+            return new_params, new_state, loss, new_metrics
 
-        donate = (0, 1) if self._donate else ()
+        self._step_core = step
+        self._step_fn = self._jit_and_wrap(
+            step, "sharded_step:%s" % self.net.name,
+            self._aot_fingerprint(guard_skip))
+
+    def _aot_fingerprint(self, guard_skip):
+        return "remat=%s|fusion=%s|opt=%s|donate=%s|guard=%s" % (
+            self._remat_policy or "",
+            self._fusion if self._fusion is not None else "",
+            self._opt_name, self._donate, guard_skip)
+
+    def _jit_and_wrap(self, fn, label, fp_extra):
+        """jit (donated params/opt/metrics, outputs pinned to the input
+        placement) + optional AOT-store wrap — shared by the single-step
+        and K-step builds so the sharding/donation contract cannot
+        drift between them."""
+        import jax
+
+        donate = (0, 1, 5) if self._donate else (5,)
         jit_kw = {}
         if self.mesh is not None and self._param_shardings is not None:
             # pin the output shardings to the input placement: without
@@ -576,26 +746,65 @@ class ShardedTrainer:
             # every buffer it was just donated
             from jax.sharding import NamedSharding, PartitionSpec as SP
 
+            repl = NamedSharding(self.mesh, SP())
             jit_kw["out_shardings"] = (
                 list(self._param_shardings), self._opt_shardings,
-                NamedSharding(self.mesh, SP()))
-        self._step_fn = jax.jit(step, donate_argnums=donate, **jit_kw)
+                repl, repl)
+        jitted = jax.jit(fn, donate_argnums=donate, **jit_kw)
         from .. import aot as _aot
 
         store = _aot.resolve_aot(self._aot)
         if store is not None:
-            fp = "remat=%s|fusion=%s|opt=%s|donate=%s|guard=%s" % (
-                self._remat_policy or "",
-                self._fusion if self._fusion is not None else "",
-                self._opt_name, self._donate, guard_skip)
-            self._step_fn = _aot.AOTFunction(
-                self._step_fn, "sharded_step:%s" % self.net.name, store,
-                fingerprint_extra=fp, manifest_kind="trainer",
-                manifest_spec=self._aot_spec)
+            jitted = _aot.AOTFunction(
+                jitted, label, store, fingerprint_extra=fp_extra,
+                manifest_kind="trainer", manifest_spec=self._aot_spec)
+        return jitted
+
+    def _build_k(self, n_inputs):
+        """Compile the K-step fused train loop: ``lax.scan`` over K
+        pre-staged microbatches with the params/opt-state/metrics carry
+        donated — per-step Python dispatch, signature hashing, and
+        executor launch are paid once per K steps.  The scan body IS
+        the single-step program, so numerics match K sequential steps
+        bit-for-bit.  Keyed into the AOT store separately from the
+        single-step executable (``k=`` rides the fingerprint)."""
+        import jax
+        import jax.numpy as jnp
+
+        step_core = self._step_core
+        K = self.steps_per_call
+
+        def step_k(param_arrays, opt_state, inputs_k, labels_k, keys,
+                   metrics):
+            # stack INSIDE the program: the K pre-staged microbatches
+            # keep their individual shardings at the call boundary and
+            # XLA sees one fused loop over the stacked [K, ...] views
+            stacked = tuple(jnp.stack([ink[j] for ink in inputs_k])
+                            for j in range(n_inputs))
+            labels = jnp.stack(labels_k)
+
+            def body(carry, xs):
+                p, s, m = carry
+                ins, lab, key = xs
+                p, s, loss, m = step_core(p, s, ins, lab, key, m)
+                return (p, s, m), loss
+
+            (p, s, m), losses = jax.lax.scan(
+                body, (param_arrays, opt_state, metrics),
+                (stacked, labels, keys))
+            return p, s, losses, m
+
+        self._step_k_fn = self._jit_and_wrap(
+            step_k, "sharded_step_k:%s" % self.net.name,
+            self._aot_fingerprint(self._on_nonfinite == "skip")
+            + "|k=%d" % K)
 
     def step(self, inputs, label):
         """Run one compiled train step. inputs: list of NDArray/jax arrays
-        (already shard_batch'ed for mesh runs); returns loss (jax scalar)."""
+        (already shard_batch'ed for mesh runs); returns loss (a jax
+        scalar — a device *future*: reading it with ``float()``/
+        ``np.asarray`` blocks until the step finishes, which the
+        trainer itself never does under ``async_metrics``)."""
         if not isinstance(inputs, (list, tuple)):
             inputs = [inputs]
         raw_in = [x._data if isinstance(x, NDArray) else x for x in inputs]
@@ -620,6 +829,61 @@ class ShardedTrainer:
             # a trainer crash suppress an unrelated serving/fit bundle.
             _tracing.record_crash("exception-step", e,
                                   extra={"layer": "ShardedTrainer.step"})
+            raise
+        finally:
+            if sp is not None:
+                sp.end()
+
+    def step_many(self, batches):
+        """Run ``steps_per_call`` train steps as ONE fused XLA call.
+
+        ``batches``: sequence of exactly ``steps_per_call`` pairs
+        ``(inputs, label)`` — inputs a list of NDArray/jax arrays,
+        already ``shard_batch``'ed for mesh runs (io.DevicePrefetcher
+        stages exactly this).  The microbatches run under ``lax.scan``
+        with the params/opt-state/metrics carry donated; the PRNG keys
+        are consumed from the framework stream host-side, so the loss/
+        param/opt trajectory is bit-for-bit identical to sequential
+        :meth:`step` calls.  Returns the per-microbatch loss vector
+        (device array, shape ``[K]``)."""
+        K = self.steps_per_call
+        if len(batches) != K:
+            raise MXNetError(
+                "step_many needs exactly steps_per_call=%d batches; "
+                "got %d" % (K, len(batches)))
+        if K == 1:
+            inputs, label = batches[0]
+            import jax.numpy as jnp
+
+            return jnp.reshape(self.step(inputs, label), (1,))
+        raws = []
+        for inputs, label in batches:
+            if not isinstance(inputs, (list, tuple)):
+                inputs = [inputs]
+            raw_in = tuple(x._data if isinstance(x, NDArray) else x
+                           for x in inputs)
+            raw_label = label._data if isinstance(label, NDArray) else label
+            raws.append((raw_in, raw_label))
+        n_in = len(raws[0][0])
+        if any(len(r[0]) != n_in for r in raws):
+            raise MXNetError("step_many batches disagree on input arity")
+        if self.param_arrays is None:
+            self._lazy_init(example_inputs=list(raws[0][0]))
+        if self._step_fn is None:
+            self._build(n_in)
+        if self._step_k_fn is None:
+            self._build_k(n_in)
+        sp = _tracing.begin("ShardedTrainer.step_many",
+                            args={"step": self.global_step + 1, "k": K}) \
+            if _tracing.enabled() else None
+        try:
+            return self._step_many_inner(raws)
+        except Exception as e:
+            if sp is not None:
+                sp.end(error=True)
+                sp = None
+            _tracing.record_crash("exception-step", e,
+                                  extra={"layer": "ShardedTrainer.step_many"})
             raise
         finally:
             if sp is not None:
@@ -656,14 +920,56 @@ class ShardedTrainer:
         _random.set_key_data(snap)
         return self._step_fn.prewarm(
             self.param_arrays, self.opt_state, tuple(raw_in), raw_label,
-            rng)
+            rng, self._fresh_metrics())
 
     def _step_inner(self, raw_in, raw_label):
+        # HOT PATH (see _dispatch_commit for the no-host-sync contract)
         rng = _random.next_key()
+        self._last_rng = rng
+        return self._dispatch_commit(
+            self._step_fn, "ShardedTrainer.step",
+            (tuple(raw_in), raw_label, rng), 1, raw_in, raw_label)
+
+    def _step_many_inner(self, raws):
+        # HOT PATH — same contract as _step_inner
+        import jax.numpy as jnp
+
+        K = len(raws)
+        # one PRNG key per microbatch, consumed from the stream in step
+        # order: the scan sees exactly the key sequence K sequential
+        # step() calls would have drawn (bit-for-bit parity)
+        keys = jnp.stack([_random.next_key() for _ in range(K)])
+        self._last_rng = keys[0]
+        return self._dispatch_commit(
+            self._step_k_fn, "ShardedTrainer.step_many",
+            (tuple(r[0] for r in raws), tuple(r[1] for r in raws), keys),
+            K, raws[0][0], raws[0][1])
+
+    def _dispatch_commit(self, fn, label, call_args, n, raw_in,
+                         raw_label):
+        """The one dispatch+commit sequence both the single-step and the
+        fused K-step paths run — the invariants (single-assignment
+        snapshot, PRNG-in-snapshot, signal-mask ordering) live in
+        exactly one place.
+
+        HOT PATH.  No unconditional host sync lives here (or in
+        _flush_metrics/_account): every loss/metric host read happens
+        in _consume_metrics_sync (sync mode) or on the fetch thread
+        (async mode) — guarded by
+        tests/test_async_train.py::test_hot_path_has_no_host_sync.
+        """
+        self._raise_pending()
         from .. import profiler as _profiler
 
         tel = _telemetry.enabled()
         t_step0 = _time.perf_counter() if tel else None
+        if tel and self._last_dispatch_end is not None:
+            # dispatch-to-dispatch idle: host time spent OUTSIDE step
+            # dispatch (data wait, metric bookkeeping) — the quantity
+            # async dispatch + device prefetch exist to shrink
+            _telemetry.HOST_GAP_SECONDS.observe(
+                max(0.0, t_step0 - self._last_dispatch_end),
+                loop="sharded")
         # With a checkpoint manager attached, SIGTERM/SIGINT are masked
         # across dispatch+commit: donation invalidates the previous
         # committed snapshot's buffers the moment the jitted step is
@@ -676,53 +982,189 @@ class ShardedTrainer:
             _signal.pthread_sigmask(
                 _signal.SIG_BLOCK, {_signal.SIGTERM, _signal.SIGINT})
         try:
-            new_params, new_state, loss = _profiler.timed_call(
-                "ShardedTrainer.step", self._step_fn,
-                (self.param_arrays, self.opt_state, tuple(raw_in),
-                 raw_label, rng))
-            next_step = self.global_step + 1
+            span_args = {"step": self.global_step + 1}
+            if n > 1:
+                span_args["k"] = n
+            dsp = _tracing.begin("step:dispatch", args=span_args) \
+                if _tracing.enabled() else None
+            try:
+                new_params, new_state, loss_out, new_metrics = \
+                    _profiler.timed_call(
+                        label, fn,
+                        (self.param_arrays, self.opt_state) + call_args
+                        + (self._metrics_acc,))
+            finally:
+                if dsp is not None:
+                    dsp.end()
+            next_step = self.global_step + n
             # single-assignment snapshot: the preemption handler may fire
             # between any two bytecodes, and must never observe params
             # from step N next to optimizer state from step N-1.  The
             # PRNG stream state rides in the snapshot too — reading it
             # live at flush time would leak a key consumed by a step
-            # that never committed, breaking bit-for-bit resume.
+            # that never committed, breaking bit-for-bit resume.  Under
+            # async dispatch the arrays are device futures; a flush
+            # landing now simply blocks in the host gather until the
+            # step completes (the drain-before-snapshot contract).
             self._committed = (new_params, new_state, next_step,
                                _random.get_key_data())
             self.param_arrays = new_params
             self.opt_state = new_state
             self.global_step = next_step
+            self._metrics_acc = new_metrics
+            self._metrics_pending += n
         finally:
             if mask:
                 _signal.pthread_sigmask(
                     _signal.SIG_UNBLOCK,
                     {_signal.SIGTERM, _signal.SIGINT})
-        if self._on_nonfinite != "off":
+        self._flush_metrics(next_step)
+        self._account(t_step0, n, raw_in, raw_label)
+        self._maybe_periodic_checkpoint(next_step, n)
+        return loss_out
+
+    # -- metric flush / drain boundaries ---------------------------------
+    def _flush_metrics(self, step, force=False):
+        """Hand the device-resident accumulator off every
+        ``metrics_every`` steps: to the bounded fetch thread (async) or
+        to the synchronous consumer.  A fresh zeroed buffer replaces it
+        (the old one was donated away)."""
+        if self._metrics_acc is None or self._metrics_pending == 0:
+            return
+        if not force and self._metrics_pending < self._metrics_every:
+            return
+        acc, self._metrics_acc = self._metrics_acc, self._fresh_metrics()
+        n, self._metrics_pending = self._metrics_pending, 0
+        if self._async:
+            if self._fetcher is None:
+                self._fetcher = _MetricFetcher(self._apply_metrics_host,
+                                               depth=self._fetch_depth)
+            self._fetcher.submit(step, n, acc)
+        else:
+            self._consume_metrics_sync(step, n, acc)
+
+    def _consume_metrics_sync(self, step, n, acc):
+        """The synchronous (historical) metric path: block on the loss
+        accumulator right inside the step.  Lives OUTSIDE the hot-path
+        functions so the no-host-sync guard can assert the async path
+        never reaches a blocking read."""
+        sp = _tracing.begin("step:fetch",
+                            args={"step": step, "steps": n, "sync": True}) \
+            if _tracing.enabled() else None
+        try:
+            host = np.asarray(acc)
+        finally:
+            if sp is not None:
+                sp.end()
+        self._apply_metrics_host(step, n, host, async_mode=False)
+
+    def _apply_metrics_host(self, step, n, host, async_mode=True):
+        """Consume one flushed accumulator (host side): heartbeat loss
+        gauge, non-finite policy, skip counting.  Runs on the fetch
+        thread under async dispatch, inline otherwise."""
+        tel = _telemetry.enabled()
+        nonfinite = int(host[_M_NONFINITE])
+        if tel:
+            _telemetry.TRAIN_LOSS.set(float(host[_M_LAST]))
+        if self._on_nonfinite != "off" and nonfinite:
             from .. import checkpoint as _ckpt
 
-            # host check (syncs on the loss, which callers consume per
-            # step anyway); under "skip" the compiled select already
-            # discarded the update — this only reports and counts
-            loss_host = np.asarray(loss)
-            if not _ckpt.check_finite(
-                    loss_host, self._on_nonfinite,
-                    what="loss (step %d)" % next_step):
-                self.skipped_steps += 1
-                _telemetry.TRAIN_SKIPPED_STEPS.inc(loop="sharded")
-            if tel and loss_host.size == 1:
-                _telemetry.TRAIN_LOSS.set(float(loss_host.reshape(())))
+            what = "loss (%d of %d steps ending at step %d)" % (
+                nonfinite, n, step)
+            try:
+                applied = _ckpt.check_finite(
+                    np.float32(np.nan), self._on_nonfinite, what=what)
+            except Exception as e:  # NonfiniteError under "raise"
+                if not async_mode:
+                    raise
+                # deferred raise: surfaces at the next step()/drain()
+                self._pending_exc = e
+                return
+            if not applied:  # "skip": the compiled select already
+                # discarded the updates — this only counts them
+                self.skipped_steps += nonfinite
+                _telemetry.TRAIN_SKIPPED_STEPS.inc(nonfinite,
+                                                   loop="sharded")
+
+    def _raise_pending(self):
+        exc, self._pending_exc = self._pending_exc, None
+        if exc is not None:
+            raise exc
+
+    def drain(self):
+        """Hard sync boundary for async dispatch: flush the
+        device-resident metric accumulator, wait for every in-flight
+        background fetch to complete AND apply, then re-raise any
+        deferred non-finite error.  Call before reading
+        ``skipped_steps``/heartbeat gauges, at epoch ends, or before
+        tearing the trainer down.  A no-op in sync mode (metrics were
+        consumed inside each step)."""
+        self._flush_metrics(self.global_step, force=True)
+        if self._fetcher is not None:
+            self._fetcher.wait()
+            if self._fetcher.error is not None:
+                err, self._fetcher.error = self._fetcher.error, None
+                raise err
+        self._raise_pending()
+        return self
+
+    def close(self):
+        """Release background resources: drain pending metric fetches
+        and stop the fetch thread.  Safe to call repeatedly, and the
+        trainer keeps working afterwards (a fresh fetch thread starts
+        lazily on the next async flush)."""
+        self.drain()
+        if self._fetcher is not None:
+            fetcher, self._fetcher = self._fetcher, None
+            fetcher.close()
+        return self
+
+    def configure_overlap(self, async_metrics=None, steps_per_call=None,
+                          metrics_every=None):
+        """Re-knob the dispatch-overlap machinery after construction
+        (the bench A/B path).  Drains first so a toggle can neither
+        lose nor double-count in-flight metrics; changing
+        ``steps_per_call`` invalidates the fused executable (rebuilt
+        lazily on the next :meth:`step_many`)."""
+        self.drain()
+        if async_metrics is not None:
+            self._async = bool(async_metrics)
+            if not self._async and self._fetcher is not None:
+                # release the fetch thread (drained above, so the
+                # sentinel put cannot block); the A/B toggle path must
+                # not accumulate one idle thread per flip
+                fetcher, self._fetcher = self._fetcher, None
+                fetcher.close()
+        if steps_per_call is not None:
+            k = int(steps_per_call)
+            if k < 1:
+                raise MXNetError("steps_per_call must be >= 1; got %d" % k)
+            if k != self.steps_per_call:
+                self.steps_per_call = k
+                self._step_k_fn = None
+            if not self._metrics_every_explicit:
+                self._metrics_every = k
+        if metrics_every is not None:
+            self._metrics_every = max(1, int(metrics_every))
+            self._metrics_every_explicit = True
+        return self
+
+    def _account(self, t_step0, n, raw_in, raw_label):
+        """Post-dispatch telemetry for a call covering ``n`` steps.
+        Under async dispatch the window covers dispatch only; steady
+        state still converges to true step time via fetch-queue and
+        dispatch-queue backpressure.  Under the sync metric path the
+        flush already blocked on the device, so the window covers
+        execution (the historical semantics)."""
+        # t_step0 is None when telemetry was off at dispatch time — an
+        # enable() racing in mid-step must not crash the accounting
+        tel = _telemetry.enabled() and t_step0 is not None
         if tel:
-            # per-axis collective payload attribution (host-side plan
-            # built at placement; see _build_collective_plan)
             for ax, op, b in self._collective_plan:
-                _telemetry.COLLECTIVE_BYTES.inc(b, axis=ax, op=op)
-            # measured here so that under any loss-syncing policy (the
-            # default) the window covers device execution, not just the
-            # async dispatch; with policy "off" steady-state steps still
-            # converge to true step time via dispatch-queue backpressure
+                _telemetry.COLLECTIVE_BYTES.inc(b * n, axis=ax, op=op)
             dt = _time.perf_counter() - t_step0
-            _telemetry.TRAIN_STEP_SECONDS.observe(dt, loop="sharded")
-            _telemetry.TRAIN_STEPS.inc(loop="sharded")
+            _telemetry.TRAIN_STEP_SECONDS.observe(dt / n, loop="sharded")
+            _telemetry.TRAIN_STEPS.inc(n, loop="sharded")
             bs = 0
             for a in (raw_label,) + tuple(raw_in):
                 shp = getattr(a, "shape", None)
@@ -730,24 +1172,38 @@ class ShardedTrainer:
                     bs = int(shp[0])
                     break
             if bs and dt > 0:
-                _telemetry.TRAIN_SAMPLES_PER_SEC.set(bs / dt)
-            self._record_step_cost(raw_in, raw_label, rng)
+                _telemetry.TRAIN_SAMPLES_PER_SEC.set(bs * n / dt)
+            self._record_step_cost(raw_in, raw_label)
             if self._step_flops:
                 _telemetry.TRAIN_STEP_FLOPS.set(self._step_flops)
                 peak = _telemetry.peak_flops()
                 if peak and dt > 0:
-                    _telemetry.TRAIN_MFU.set(self._step_flops / dt / peak)
+                    _telemetry.TRAIN_MFU.set(self._step_flops * n / dt
+                                             / peak)
+            self._last_dispatch_end = _time.perf_counter()
         if tel or _tracing.enabled():
             # per-step HBM watermark sample: live/peak gauges per device
             # plus a counter track in the exported chrome trace
             _tracing.sample_device_memory()
+
+    def _maybe_periodic_checkpoint(self, next_step, n):
+        """Periodic save, fused-loop aware: fires when the call crossed
+        a period boundary (a K-step call saves once, at its end)."""
         m = self._ckpt_manager
         if m is not None and self._ckpt_period and not m.preempted and \
-                next_step % self._ckpt_period == 0:
+                (next_step // self._ckpt_period) > \
+                ((next_step - n) // self._ckpt_period):
+            if self._async and self._on_nonfinite == "raise":
+                # a parked NonfiniteError must abort BEFORE the save:
+                # under "raise" the poisoned update was applied, and
+                # persisting it as the newest checkpoint would hand
+                # auto-resume NaN state.  The checkpoint boundary is a
+                # documented hard-sync point, so the drain is free to
+                # block here.
+                self.drain()
             self.save_checkpoint(m, step=next_step)
-        return loss
 
-    def _record_step_cost(self, raw_in, raw_label, rng):
+    def _record_step_cost(self, raw_in, raw_label):
         """One-time XLA cost attribution for the compiled step.
 
         ``Lowered.cost_analysis`` reads the HLO without a second backend
@@ -755,7 +1211,8 @@ class ShardedTrainer:
         telemetry MFU gauge and ``profiler._xla_costs`` so ``dumps()``
         shows the train step next to the compiled-program cost table.
         Costs one extra host-side trace, paid once per process and only
-        when telemetry is on.
+        when telemetry is on.  Always lowers the SINGLE-step program
+        (per-step flops), also when training runs the fused loop.
         """
         if self._step_flops is not None:
             return
@@ -763,7 +1220,7 @@ class ShardedTrainer:
         try:
             lowered = self._step_fn.lower(
                 self.param_arrays, self.opt_state, tuple(raw_in),
-                raw_label, rng)
+                raw_label, self._last_rng, self._metrics_acc)
             cost = lowered.cost_analysis()
             if isinstance(cost, (list, tuple)):
                 cost = cost[0] if cost else {}

@@ -483,7 +483,32 @@ TRAIN_SAMPLES_PER_SEC = gauge(
     "mxnet_tpu_train_samples_per_second",
     "Throughput of the most recent train step.")
 TRAIN_LOSS = gauge(
-    "mxnet_tpu_train_loss", "Most recent train-step loss.")
+    "mxnet_tpu_train_loss",
+    "Most recent train-step loss (under MXNET_ASYNC_METRICS this is "
+    "the last COMPLETED background fetch, typically a few steps behind "
+    "the dispatch frontier — never a forced device sync).")
+HOST_GAP_SECONDS = histogram(
+    "mxnet_tpu_host_gap_seconds",
+    "Dispatch-to-dispatch host idle: wall time between one train "
+    "step's dispatch returning and the next step's dispatch starting "
+    "(data wait + host-side metric/bookkeeping cost).  The chip is "
+    "only guaranteed busy across the gap when dispatch runs ahead "
+    "(async metrics / fused K-step loop); large values bound the "
+    "utilization lost to the host.", ("loop",))
+ASYNC_FETCH_INFLIGHT = gauge(
+    "mxnet_tpu_async_fetch_inflight",
+    "Device->host metric fetches currently in flight (bounded queue "
+    "depth of the background metric fetcher; submits past the bound "
+    "backpressure the dispatch loop).")
+ASYNC_METRIC_FETCHES = counter(
+    "mxnet_tpu_async_metric_fetches_total",
+    "Completed background metric fetches (each transfers one "
+    "device-resident accumulator covering metrics_every steps).")
+PREFETCH_STALLS = counter(
+    "mxnet_tpu_device_prefetch_stalls_total",
+    "Times the training loop reached io.DevicePrefetcher before a "
+    "staged batch was ready (the input pipeline, not the chip, was "
+    "the bottleneck for that step).")
 TRAIN_STEP_FLOPS = gauge(
     "mxnet_tpu_train_step_flops",
     "XLA cost-analysis FLOPs of the compiled train step.")

@@ -228,6 +228,29 @@ def begin(name, args=None, activate=True):
     return Span(name, args=args, activate=activate)
 
 
+def instant(name, args=None):
+    """Record a zero-duration marker into the trace ring buffer
+    (chrome-trace ``ph:"i"``): completion ticks and stall markers from
+    background threads (the async metric fetcher, the device
+    prefetcher) that have no natural begin/end scope.  No-op when
+    tracing is off."""
+    global _dropped
+    if not _enabled:
+        return
+    tid = threading.get_ident()
+    rec = {"name": name, "span_id": "%016x" % next(_ids),
+           "parent_id": None, "tid": tid, "t0": time.perf_counter(),
+           "dur": 0.0, "status": "instant",
+           "args": dict(args) if args else None}
+    with _lock:
+        if tid not in _thread_names:
+            _thread_names[tid] = threading.current_thread().name
+        if _buffer.maxlen is not None and len(_buffer) == _buffer.maxlen:
+            _dropped += 1
+            _telemetry.TRACE_SPANS_DROPPED.inc()
+        _buffer.append(rec)
+
+
 def unwind_to(outer, error=True):
     """End every context-chain span opened below ``outer`` (innermost
     first) and restore ``outer`` as the current span — exception-path
@@ -376,6 +399,10 @@ def _span_event(rec):
     if rec["args"]:
         for k, v in rec["args"].items():
             args.setdefault(str(k), _jsonable(v))
+    if rec["status"] == "instant":
+        return {"name": rec["name"], "ph": "i", "s": "t", "cat": "span",
+                "ts": rec["t0"] * 1e6, "pid": _PID, "tid": rec["tid"],
+                "args": args}
     return {"name": rec["name"], "ph": "X", "cat": "span",
             "ts": rec["t0"] * 1e6, "dur": max(0.0, rec["dur"]) * 1e6,
             "pid": _PID, "tid": rec["tid"], "args": args}

@@ -469,6 +469,36 @@ def test_sharded_nonfinite_raise():
         tr.step([nd.array(xb)], nd.array(_Y))
 
 
+def test_preemption_flush_drains_fused_steps(tmp_path):
+    """SIGTERM lands right after an async fused K-step dispatch: the
+    flushed checkpoint must drain the in-flight ``lax.scan`` call
+    (device futures gather at snapshot) and record the complete fused
+    boundary — params bit-for-bit equal to a synchronous per-step run
+    to the same step, never a torn mid-call state."""
+    _, ref = _make_trainer(7)
+    for i in range(4):
+        x, y = _batch(i)
+        ref.step(x, y)
+    ref_params = [np.asarray(a).copy() for a in ref.param_arrays]
+
+    _, tr = _make_trainer(7, async_metrics=True, steps_per_call=4)
+    m = ck.CheckpointManager(tmp_path, keep_last=2, async_save=True)
+    assert tr.attach_checkpoint_manager(m) == 0
+    try:
+        batches = [_batch(i) for i in range(4)]
+        tr.step_many(batches)     # returns with device work in flight
+        faults.send_preemption()  # SIGTERM -> handler flushes snapshot
+    finally:
+        m.wait()
+        m.uninstall_preemption_handler()
+    assert m.preempted
+    ckpt = m.load()
+    assert ckpt.meta["step"] == 4  # the fused boundary, not a tear
+    for i, want in enumerate(ref_params):
+        np.testing.assert_array_equal(ckpt.arrays["param:%04d" % i], want)
+    tr.drain()  # in-flight metric fetches settle before teardown
+
+
 # ---------------------------------------------------------------------------
 # Module front-end: resume + guard
 # ---------------------------------------------------------------------------

@@ -9,7 +9,13 @@ atomically (``checkpoint.atomic_write``)::
 
     python tools/autotune.py --out docs/fusion_cost_cpu.json \
         [--trace trace.json] [--patterns add_act,layer_norm_fast] \
-        [--shapes 64x1024 256x4096] [--iters 20]
+        [--shapes 64x1024 256x4096] [--iters 20] [--lm]
+
+``--lm`` additionally profiles the transformer-LM bench model
+(tools/bench_lm.py) live: its hot-op timeline ranking lands in the
+table meta and its attention/matmul operand shapes join every
+pattern's microbench — the second hot-path profile next to the
+ResNet-50 trace (ROADMAP sharding follow-on).
 
 ``--trace`` takes a ``tracing.export_trace`` / ``profiler.dump()`` /
 flight-recorder artifact; its op-timeline ranking (total time + est.
@@ -80,6 +86,53 @@ def run_check(path, max_age_days):
     return 1 if problems else 0
 
 
+def profile_lm(args):
+    """Run the transformer-LM bench model (tools/bench_lm.py) for a few
+    steps under the unified trace and return its hot-op ranking plus
+    the LM's matmul/attention operand shapes — the second hot-path
+    profile the cost-table machinery has been waiting for (ROADMAP).
+    The shapes feed every pattern's microbench next to its canonical
+    ``bench_shapes``, so the table carries measured fused-vs-unfused
+    numbers at the sizes the LM actually runs."""
+    import tempfile
+
+    import jax
+
+    import bench_lm
+    from mxnet_tpu import profiler, telemetry, tracing
+
+    tracing.enable()
+    profiler.set_config(aggregate_stats=True)
+    telemetry.enable()
+    log("profiling transformer-LM bench model (%d steps, mesh=%s)"
+        % (args.lm_steps, args.lm_mesh or "single-device"))
+    trainer, tokens, labels, cfg = bench_lm.build_lm_trainer(
+        mesh=args.lm_mesh)
+    xs, ys = trainer.shard_batch(tokens, labels)
+    loss = None
+    for _ in range(max(1, args.lm_steps)):
+        loss = trainer.step([xs], ys)
+    jax.block_until_ready(loss)
+    path = os.path.join(tempfile.mkdtemp(prefix="mxnet_tpu_lm_"),
+                        "lm_trace.json")
+    tracing.export_trace(path)
+    hot = rank_trace_ops(path)
+    B, S, D = cfg["batch"], cfg["seq"], cfg["d_model"]
+    # the LM's three dominant GEMM operand shapes: attention/residual
+    # projections (B*S x D), the 4x MLP hidden (B*S x 4D), and the
+    # vocab head (B*S x V)
+    shapes = [(B * S, D), (B * S, 4 * D), (B * S, cfg["vocab"])]
+    meta = {"model": {k: cfg[k] for k in ("vocab", "d_model", "n_heads",
+                                          "n_layers", "seq", "batch")},
+            "mesh": args.lm_mesh, "steps": args.lm_steps,
+            "shapes": [list(s) for s in shapes],
+            "trace": path,
+            "hot_ops": [{"name": n, "total_ms": round(ms, 3), "calls": c,
+                         "est_hbm_bytes": est}
+                        for n, ms, c, est in hot]}
+    return meta, hot, shapes
+
+
 def run_tune(args):
     import mxnet_tpu  # noqa: F401  (backend init)
     import jax
@@ -93,6 +146,15 @@ def run_tune(args):
         log("timeline ranking from %s (total ms | calls | est HBM bytes):"
             % args.trace)
         for name, ms, n, est in hot:
+            log("  %-40s %10.3f %6d %s"
+                % (name, ms, n, "%12.0f" % est if est else "           -"))
+
+    lm_shapes = []
+    lm_meta = None
+    if args.lm:
+        lm_meta, lm_hot, lm_shapes = profile_lm(args)
+        log("LM timeline ranking (total ms | calls | est HBM bytes):")
+        for name, ms, n, est in lm_hot:
             log("  %-40s %10.3f %6d %s"
                 % (name, ms, n, "%12.0f" % est if est else "           -"))
 
@@ -117,13 +179,23 @@ def run_tune(args):
         table.meta["trace_hot_ops"] = [
             {"name": n, "total_ms": round(ms, 3), "calls": c,
              "est_hbm_bytes": est} for n, ms, c, est in hot]
+    if lm_meta is not None:
+        table.meta["lm_profile"] = lm_meta
 
     for name in names:
         pattern = F.get_pattern(name)
         if pattern.bench_builder is None:
             log("skip %s: no bench_builder" % name)
             continue
-        for shape in (shapes or pattern.bench_shapes):
+        pattern_shapes = list(shapes or pattern.bench_shapes)
+        # the LM's rank-2 GEMM shapes ride along only where the
+        # pattern's own bench chain is rank-2 (matmul/elementwise);
+        # conv patterns expect NCHW and would just trace-and-skip
+        if all(len(s) == 2 for s in pattern.bench_shapes):
+            for s in lm_shapes:
+                if s not in pattern_shapes:
+                    pattern_shapes.append(s)
+        for shape in pattern_shapes:
             if len(shape) < 2:
                 log("skip %s @ %s: chain needs >=2 dims" % (name, shape))
                 continue
@@ -169,6 +241,16 @@ def main(argv=None):
                    help="validate a cost-table JSON instead of tuning")
     p.add_argument("--trace", help="chrome-trace export to rank hot ops "
                                    "from (tracing.export_trace output)")
+    p.add_argument("--lm", action="store_true",
+                   help="profile the transformer-LM bench model "
+                        "(tools/bench_lm.py) live and fold its hot-op "
+                        "ranking + matmul/attention operand shapes into "
+                        "the tuning run")
+    p.add_argument("--lm-steps", type=int, default=2,
+                   help="--lm: traced LM steps (default 2)")
+    p.add_argument("--lm-mesh", default=None,
+                   help="--lm: mesh spec for the profiled LM trainer "
+                        "(default: MXNET_MESH, else single device)")
     p.add_argument("--patterns", help="comma list (default: all "
                                       "registered)")
     p.add_argument("--shapes", nargs="*",

@@ -6,7 +6,13 @@ ShardedTrainer over a named dp x fsdp x tp mesh with a spec-rule layout
 (docs/sharding.md).  Emits ONE BENCH JSON line on stdout carrying
 ``tokens_per_sec``, ``mfu`` (model-FLOPs accounting over the PR 4 peak
 gauge), and the ``mesh_shape``/``layout`` the number was measured under
-— so the perf trajectory is attributable to topology.
+— so the perf trajectory is attributable to topology.  Since ISSUE 10
+the run measures BOTH dispatch modes — synchronous per-step and async
++ K-step fused loop — and reports ``tokens_per_sec_sync``/``_async``,
+``async_speedup``, ``steps_per_call`` and the per-phase
+``host_gap_seconds`` p50; ``--trace-out`` writes the unified chrome
+trace that ``tools/autotune.py --lm`` folds into the fusion cost
+table.
 
     # 8-virtual-device CPU harness, canonical LLM layout:
     XLA_FLAGS=--xla_force_host_platform_device_count=8 \
@@ -81,21 +87,35 @@ def build_lm_trainer(mesh=None, layout=None, vocab=None, d_model=None,
     return trainer, tokens, labels, cfg
 
 
-def run(mesh=None, layout=None, steps=20, warmup=2, **model_kw):
+def run(mesh=None, layout=None, steps=20, warmup=2, steps_per_call=None,
+        trace_out=None, **model_kw):
     import jax
 
-    from mxnet_tpu import telemetry
+    from mxnet_tpu import telemetry, tracing
 
     telemetry.enable()  # MFU gauge + collective/state-bytes accounting
+    if trace_out:
+        # unified chrome trace of the measured run: the attention/
+        # matmul profile tools/autotune.py --lm folds into the fusion
+        # cost table (same artifact as tracing.export_trace)
+        tracing.enable()
+        from mxnet_tpu import profiler
+
+        profiler.set_config(aggregate_stats=True)
     trainer, tokens, labels, cfg = build_lm_trainer(
         mesh=mesh, layout=layout, **model_kw)
+    k = int(steps_per_call) if steps_per_call else \
+        (4 if cfg["on_tpu"] else 2)
     if not cfg["on_tpu"]:
-        steps = min(steps, 3)
+        # the LM smoke model is ms-scale per step: 12 steps keep the
+        # sync-vs-async A/B above the noise floor without moving the
+        # suite budget (bench.py's ResNet stays at 4)
+        steps = min(steps, 12)
         warmup = min(warmup, 1)
     log("devices=%d mesh=%s layout=%s model=%s"
         % (len(jax.devices()), trainer.mesh_shape, trainer.layout_name,
-           {k: cfg[k] for k in ("vocab", "d_model", "n_heads", "n_layers",
-                                "seq", "batch")}))
+           {k_: cfg[k_] for k_ in ("vocab", "d_model", "n_heads",
+                                   "n_layers", "seq", "batch")}))
     xs, ys = trainer.shard_batch(tokens, labels)
 
     warmup_step_secs = []
@@ -107,19 +127,41 @@ def run(mesh=None, layout=None, steps=20, warmup=2, **model_kw):
         log("warmup step %d done (loss=%.4f, %.1fs)"
             % (i, float(loss), warmup_step_secs[-1]))
 
+    # phase 1 — synchronous per-step dispatch (historical semantics)
+    telemetry.reset()
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = trainer.step([xs], ys)
     jax.block_until_ready(loss)
     dt = time.perf_counter() - t0
-    log("%d steps in %.3fs (loss=%.4f)" % (steps, dt, float(loss)))
+    gap_sync = telemetry.HOST_GAP_SECONDS.quantile(0.5, loop="sharded")
+    log("[sync] %d steps in %.3fs (loss=%.4f)" % (steps, dt, float(loss)))
+
+    # phase 2 — async dispatch + K-step fused loop (ISSUE 10)
+    trainer.configure_overlap(async_metrics=True, steps_per_call=k)
+    fused = [([xs], ys)] * k
+    losses = trainer.step_many(fused)
+    jax.block_until_ready(losses)
+    trainer.drain()
+    telemetry.reset()
+    calls = max(1, steps // k)
+    t0 = time.perf_counter()
+    for _ in range(calls):
+        losses = trainer.step_many(fused)
+    jax.block_until_ready(losses)
+    trainer.drain()
+    dt_async = time.perf_counter() - t0
+    gap_async = telemetry.HOST_GAP_SECONDS.quantile(0.5, loop="sharded")
+    log("[async] %d steps (%d fused calls of %d) in %.3fs"
+        % (calls * k, calls, k, dt_async))
 
     tokens_per_step = cfg["batch"] * cfg["seq"]
-    tps = tokens_per_step * steps / dt
+    tps_sync = tokens_per_step * steps / dt
+    tps = tokens_per_step * calls * k / dt_async
     # MFU two ways: the XLA cost-analysis gauge (exact program FLOPs)
     # when a peak is known, else the 6N analytic accounting only
     peak = telemetry.peak_flops()
-    step_secs = dt / steps
+    step_secs = dt_async / (calls * k)
     model_flops = cfg["flops_per_token"] * tokens_per_step
     mfu = None
     # on the CPU harness the docs/mfu_probe.json peak describes the
@@ -132,6 +174,15 @@ def run(mesh=None, layout=None, steps=20, warmup=2, **model_kw):
         "value": round(tps, 2),
         "unit": "tokens/sec",
         "tokens_per_sec": round(tps, 2),
+        "tokens_per_sec_sync": round(tps_sync, 2),
+        "tokens_per_sec_async": round(tps, 2),
+        "async_speedup": round(tps / tps_sync, 3) if tps_sync else None,
+        "steps_per_call": k,
+        "async_metrics": True,
+        "host_gap_seconds": {
+            "sync": round(gap_sync, 6) if gap_sync is not None else None,
+            "async": round(gap_async, 6) if gap_async is not None
+            else None},
         "mfu": mfu,
         "model_flops_per_step": model_flops,
         "mesh_shape": trainer.mesh_shape,
@@ -140,6 +191,9 @@ def run(mesh=None, layout=None, steps=20, warmup=2, **model_kw):
         "seq_len": cfg["seq"],
         "warmup_step_seconds": warmup_step_secs,
     }
+    if trace_out:
+        tracing.export_trace(trace_out)
+        log("unified trace written to %s" % trace_out)
     return result
 
 
@@ -153,6 +207,12 @@ def main(argv=None):
                         "canonical layout for the mesh axes)")
     p.add_argument("--steps", type=int, default=20)
     p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--steps-per-call", type=int, default=None,
+                   help="K for the fused-loop phase (default: 4 on "
+                        "TPU, 2 on the CPU harness)")
+    p.add_argument("--trace-out", default=None,
+                   help="write the measured run's unified chrome trace "
+                        "here (tools/autotune.py --lm consumes it)")
     p.add_argument("--vocab", type=int, default=None)
     p.add_argument("--d-model", type=int, default=None)
     p.add_argument("--n-heads", type=int, default=None)
@@ -161,7 +221,8 @@ def main(argv=None):
     p.add_argument("--batch", type=int, default=None)
     a = p.parse_args(argv)
     result = run(mesh=a.mesh, layout=a.layout, steps=a.steps,
-                 warmup=a.warmup, vocab=a.vocab, d_model=a.d_model,
+                 warmup=a.warmup, steps_per_call=a.steps_per_call,
+                 trace_out=a.trace_out, vocab=a.vocab, d_model=a.d_model,
                  n_heads=a.n_heads, n_layers=a.n_layers, seq=a.seq,
                  batch=a.batch)
     print(json.dumps(result))
