@@ -35,7 +35,8 @@ def log(msg):
 
 
 def build_trainer(batch=None, remat_policy=None, aot=None,
-                  aot_spec="bench_resnet50", mesh=None, layout=None):
+                  aot_spec="bench_resnet50", mesh=None, layout=None,
+                  dtype_policy=None):
     """The benchmark-of-record configuration: ResNet-50 v1, bf16
     compute + fp32 master (on accelerator), momentum SGD, one fused XLA
     program per step, synthetic bs-`batch` data.  Shared by bench.py,
@@ -69,6 +70,14 @@ def build_trainer(batch=None, remat_policy=None, aot=None,
     if not on_tpu:
         batch = min(batch, 16)  # keep CPU smoke runs fast
 
+    # precision: an explicit dtype_policy= (or BENCH_DTYPE_POLICY) wins;
+    # default is the mixed-precision recipe on the chip (bf16 compute,
+    # f32 master + loss scaling — supersedes the old blanket bf16 cast)
+    # and f32 on the CPU smoke harness
+    if dtype_policy is None:
+        dtype_policy = os.environ.get("BENCH_DTYPE_POLICY") or \
+            ("bf16_mixed" if on_tpu else None)
+
     net = vision.resnet50_v1(classes=1000)
     net.initialize(mx.init.Xavier())
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
@@ -76,7 +85,7 @@ def build_trainer(batch=None, remat_policy=None, aot=None,
         net, lambda o, l: loss_fn(o, l), mesh=mesh, layout=layout,
         optimizer="sgd",
         optimizer_params={"learning_rate": 0.1, "momentum": 0.9},
-        dtype=jax.numpy.bfloat16 if on_tpu else None,
+        dtype_policy=dtype_policy,
         remat_policy=remat_policy, aot=aot, aot_spec=aot_spec)
 
     rng = np.random.RandomState(0)
@@ -125,6 +134,33 @@ def _host_gap_p50():
     from mxnet_tpu import telemetry
 
     return telemetry.HOST_GAP_SECONDS.quantile(0.5, loop="sharded")
+
+
+def run_dtype_compare(policies, steps):
+    """BENCH_DTYPE_COMPARE=1: one short synchronous phase per dtype
+    policy on a FRESH trainer each, so the headline number's precision
+    choice is an A/B measured in the same run (the payoff sweep flips
+    the default from this field when bf16 wins on-chip)."""
+    import jax
+
+    out = {}
+    for pol in policies:
+        trainer, x, y, batch, _on_tpu = build_trainer(dtype_policy=pol)
+        loss = trainer.step([x], y)  # compile + warm
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = trainer.step([x], y)
+        jax.block_until_ready(loss)
+        dt = time.perf_counter() - t0
+        trainer.drain()
+        out[trainer.dtype_policy_tag] = {
+            "images_per_sec": round(batch * steps / dt, 2),
+            "loss_scale": trainer.loss_scale(),
+        }
+        log("[dtype %s] %d steps in %.3fs (%.1f img/s)"
+            % (trainer.dtype_policy_tag, steps, dt, batch * steps / dt))
+    return out
 
 
 def main():
@@ -230,7 +266,18 @@ def main():
             "sync": round(gap_sync, 6) if gap_sync is not None else None,
             "async": round(gap_async, 6) if gap_async is not None
             else None},
+        # precision attribution (docs/mixed_precision.md): the policy
+        # the headline number was measured under, plus the loss-scale
+        # endpoint state when the policy scales
+        "dtype_policy": trainer.dtype_policy_tag,
+        "loss_scale": trainer.loss_scale(),
+        "loss_scale_backoffs": trainer.skipped_steps
+        if trainer.dtype_policy is not None
+        and trainer.dtype_policy.loss_scaling else None,
     }
+    if os.environ.get("BENCH_DTYPE_COMPARE", "0") not in ("", "0"):
+        result["dtype_compare"] = run_dtype_compare(
+            ("f32", "bf16_mixed"), steps)
     if prewarm_info is not None:
         # cold = trace+compile paid by the prewarm subprocess (or
         # recorded in the store meta when it was already warm);

@@ -578,13 +578,18 @@ class AOTFunction:
     """
 
     def __init__(self, jit_fn, label, store, fingerprint_extra="",
-                 manifest_kind=None, manifest_spec=None):
+                 manifest_kind=None, manifest_spec=None,
+                 manifest_extra=None):
         self.jit = jit_fn
         self.label = label
         self.store = store
         self._extra = fingerprint_extra
         self._manifest_kind = manifest_kind
         self._manifest_spec = manifest_spec
+        # extra manifest fields (e.g. the dtype-policy tag every
+        # construction site records so tools/prewarm.py --check can
+        # validate the precision recipe of each signature)
+        self._manifest_extra = dict(manifest_extra or {})
         self._compiled = {}   # signature -> compiled executable
         self._lock = threading.Lock()
 
@@ -785,7 +790,7 @@ class AOTFunction:
                 not _config.get("MXNET_AOT_MANIFEST"):
             return
         try:
-            self.store.manifest_append({
+            entry = {
                 "kind": self._manifest_kind,
                 "spec": self._manifest_spec,
                 "label": self.label,
@@ -794,7 +799,10 @@ class AOTFunction:
                               for s, d, w, dev in sig[0]],
                 "backend": fp.get("backend"),
                 "created": _utcnow(),
-            })
+            }
+            entry.update(self._manifest_extra)
+            entry.setdefault("dtype_policy", "f32")
+            self.store.manifest_append(entry)
         except Exception as e:
             _warn_once("manifest:" + self.label,
                        "AOT %s: could not append signature manifest "

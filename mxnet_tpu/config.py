@@ -92,6 +92,42 @@ FLAGS = {
         "parallel.layout.register_layout additions); '' = pick the "
         "canonical layout for the mesh's axes (fsdp_tp when tp is "
         "present, fsdp for an fsdp axis, else data_parallel)"),
+    "MXNET_DTYPE_POLICY": (
+        "", str, "honored",
+        "default mixed-precision dtype policy for every compile "
+        "front-end (Executor/CachedOp/Module/ShardedTrainer/Predictor): "
+        "'' or 'f32' = historical f32, 'bf16_mixed' = bf16 compute / "
+        "f32 master params + loss scaling + per-layer f32 overrides, "
+        "'bf16_pure', or a dtype_policy.register_policy addition.  "
+        "Per-site override via dtype_policy="),
+    "MXNET_LOSS_SCALE": (
+        "65536", _pfloat, "honored",
+        "initial dynamic loss scale for loss-scaling dtype policies "
+        "(bf16_mixed): the loss is multiplied by the scale before the "
+        "backward pass and gradients unscaled after, keeping small "
+        "gradients out of the bf16 flush-to-zero band"),
+    "MXNET_LOSS_SCALE_GROWTH_INTERVAL": (
+        "2000", _pint, "honored",
+        "consecutive finite steps before the dynamic loss scale doubles "
+        "(capped at MXNET_LOSS_SCALE_MAX)"),
+    "MXNET_LOSS_SCALE_BACKOFF": (
+        "0.5", _pfloat, "honored",
+        "multiplier applied to the loss scale when a scaled step "
+        "overflows (the overflowed update is skipped in-graph and "
+        "counted, never applied)"),
+    "MXNET_LOSS_SCALE_MAX": (
+        "16777216", _pfloat, "honored",
+        "upper bound for dynamic loss-scale ramp-up (2^24 default)"),
+    "MXNET_QUANTIZE_TOPK": (
+        "5", _pint, "honored",
+        "k for the int8 accuracy gate: tools/quantize_model.py compares "
+        "fp32-of-record vs int8 top-k agreement on the recorded "
+        "calibration batch before emitting an artifact"),
+    "MXNET_QUANTIZE_MAX_DELTA": (
+        "0.02", _pfloat, "honored",
+        "maximum tolerated top-k accuracy delta (1 - agreement) for the "
+        "int8 quantization gate; a larger measured delta refuses the "
+        "artifact (tools/quantize_model.py exit code 3)"),
     "MXNET_REMAT_POLICY": (
         "", str, "honored",
         "default activation-remat policy for Executor/CachedOp/"

@@ -12,6 +12,10 @@ algorithms.
 """
 from __future__ import annotations
 
+import hashlib
+import json
+import os
+
 import numpy as np
 
 from ..base import MXNetError
@@ -21,7 +25,9 @@ from ..ops.utils import pfloat
 
 __all__ = ["quantize", "dequantize", "quantize_v2", "requantize",
            "calib_thresholds_kl", "quantize_model", "LayerOutputCollector",
-           "quantize_net"]
+           "quantize_net", "QuantizationGateError", "topk_agreement",
+           "quantize_serving_artifact", "save_artifact", "load_artifact",
+           "check_artifact", "ARTIFACT_META", "ARTIFACT_PREFIX"]
 
 import jax.numpy as jnp
 
@@ -86,13 +92,35 @@ def requantize(data, min_range, max_range, **kwargs):
                       kwargs)
 
 
-def calib_thresholds_kl(hist_data, num_bins=8001, num_quantized_bins=255):
+def calib_thresholds_kl(hist_data, num_bins=8001, num_quantized_bins=255,
+                        layer=None):
     """KL-divergence-optimal threshold (reference: quantization.py:346
-    _get_optimal_threshold)."""
-    data = np.abs(np.asarray(hist_data).ravel())
-    max_val = data.max() if data.size else 1.0
+    _get_optimal_threshold).
+
+    Empty, constant-zero, or non-finite calibration data has no defined
+    KL threshold; instead of a div-by-zero/NaN threshold silently
+    poisoning the quantized graph, a typed :class:`MXNetError` naming
+    the offending ``layer`` is raised — the calibration run (not the
+    serving rollout) is where this must surface."""
+    who = " for layer %r" % layer if layer else ""
+    data = np.abs(np.asarray(hist_data, dtype=np.float64).ravel())
+    if data.size == 0:
+        raise MXNetError(
+            "calib_thresholds_kl: empty calibration data%s — the "
+            "collector recorded no forward-pass outputs (did the "
+            "calibration batches run, and does include_layer match?)"
+            % who)
+    max_val = data.max()
+    if not np.isfinite(max_val):
+        raise MXNetError(
+            "calib_thresholds_kl: non-finite calibration data%s — the "
+            "calibration batch is poisoned (NaN/Inf activations); "
+            "refusing to derive int8 thresholds from it" % who)
     if max_val == 0:
-        return 1e-8
+        raise MXNetError(
+            "calib_thresholds_kl: constant-zero calibration data%s — "
+            "the KL threshold is undefined (all-zero histogram); check "
+            "the calibration batch actually excites this layer" % who)
     hist, edges = np.histogram(data, bins=num_bins, range=(0, max_val))
     thresholds = np.zeros(num_bins // 2)
     divergences = np.full(num_bins // 2, np.inf)
@@ -141,6 +169,20 @@ class LayerOutputCollector:
         else:
             self.min_max[name] = (mn, mx)
         self.samples.setdefault(name, []).append(np.abs(npv).ravel()[:4096])
+
+    def thresholds_kl(self, num_bins=8001, num_quantized_bins=255):
+        """Per-layer KL thresholds over everything collected.  Raises a
+        typed :class:`MXNetError` NAMING THE LAYER on empty/constant/
+        non-finite samples (see :func:`calib_thresholds_kl`) — and on a
+        collector that saw no layers at all."""
+        if not self.samples:
+            raise MXNetError(
+                "LayerOutputCollector.thresholds_kl: no layer outputs "
+                "collected — run the calibration forward passes first")
+        return {name: calib_thresholds_kl(
+                    np.concatenate(chunks), num_bins=num_bins,
+                    num_quantized_bins=num_quantized_bins, layer=name)
+                for name, chunks in self.samples.items()}
 
 
 _QUANTIZABLE = ("FullyConnected", "Convolution")
@@ -341,3 +383,214 @@ def _dequantize_i32(data, min_range, max_range, **kw):
     """int32 accumulator -> fp32 using the propagated product range."""
     r = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range))
     return data.astype(jnp.float32) * (r / (127.0 * 127.0))
+
+
+# ---------------------------------------------------------------------------
+# production int8 serving artifacts: accuracy-gated quantize -> atomic
+# artifact -> Predictor.from_symbol (driven by tools/quantize_model.py)
+# ---------------------------------------------------------------------------
+
+ARTIFACT_META = "meta.json"
+ARTIFACT_PREFIX = "model"
+
+
+class QuantizationGateError(MXNetError):
+    """The measured int8 accuracy delta (or a poisoned calibration
+    batch) failed the gate — no artifact may be emitted."""
+
+
+def _forward_symbol(sym, arg_params, aux_params, batch, data_name="data"):
+    """One inference forward of ``sym`` over ``batch`` with explicit
+    args (quantized graphs carry int8 weights + range scalars whose
+    shapes data-only inference cannot derive)."""
+    args = {n: (a if isinstance(a, NDArray) else array(np.asarray(a)))
+            for n, a in arg_params.items()}
+    args[data_name] = array(np.asarray(batch))
+    # dtype_policy pinned OFF: the gate must measure the fp32 model of
+    # record and the int8 graph EXACTLY as stored — an ambient
+    # MXNET_DTYPE_POLICY would re-cast the baseline weights (and the
+    # int8 range scalars) and validate numerics nobody will serve
+    ex = sym.bind(args=args, aux_states=dict(aux_params) or None,
+                  grad_req="null", dtype_policy="f32")
+    return ex.forward(is_train=False)[0].asnumpy()
+
+
+def topk_agreement(ref_logits, test_logits, k):
+    """Mean per-row overlap fraction of the top-``k`` index sets — the
+    gate's accuracy-of-record proxy: how much of the fp32 top-k does
+    the int8 graph preserve."""
+    ref = np.asarray(ref_logits)
+    test = np.asarray(test_logits)
+    k = min(int(k), ref.shape[-1])
+    ref_top = np.argsort(-ref, axis=-1)[..., :k]
+    test_top = np.argsort(-test, axis=-1)[..., :k]
+    hits = [len(set(r.tolist()) & set(t.tolist())) / float(k)
+            for r, t in zip(ref_top.reshape(-1, k),
+                            test_top.reshape(-1, k))]
+    return float(np.mean(hits))
+
+
+def quantize_serving_artifact(sym, arg_params, aux_params, calib_batch,
+                              data_name="data", excluded_sym_names=None,
+                              topk=None, max_delta=None, fold_bn=True,
+                              logger=None):
+    """The production int8 pipeline: fp32 symbol -> (BN fold) -> int8
+    graph rewrite -> measured accuracy gate.
+
+    The ``calib_batch`` is the recorded batch of record: the fp32
+    model's top-k on it is the accuracy baseline, and the int8 graph's
+    top-k agreement against it is the measured delta.  Raises
+    :class:`QuantizationGateError` — and returns nothing — when the
+    calibration batch is poisoned (non-finite), the int8 outputs are
+    non-finite, or the measured delta exceeds ``max_delta``
+    (``MXNET_QUANTIZE_MAX_DELTA`` default): a degraded artifact must
+    never be emitted.
+
+    Returns ``(qsym, qarg_params, qaux_params, report)``.
+    """
+    from .. import config as _config
+
+    log = logger or (lambda *a: None)
+    topk = int(topk if topk is not None
+               else _config.get("MXNET_QUANTIZE_TOPK"))
+    max_delta = float(max_delta if max_delta is not None
+                      else _config.get("MXNET_QUANTIZE_MAX_DELTA"))
+    calib = np.asarray(calib_batch)
+    if calib.size == 0:
+        raise QuantizationGateError(
+            "quantization gate: empty calibration batch — record a "
+            "real serving batch first")
+    if np.issubdtype(calib.dtype, np.floating) and \
+            not np.all(np.isfinite(calib)):
+        raise QuantizationGateError(
+            "quantization gate: poisoned calibration batch (NaN/Inf "
+            "values) — refusing to calibrate or emit an artifact")
+    fp32_out = _forward_symbol(sym, arg_params, aux_params, calib,
+                               data_name)
+    if not np.all(np.isfinite(fp32_out)):
+        raise QuantizationGateError(
+            "quantization gate: fp32 model of record produces "
+            "non-finite outputs on the calibration batch — fix the "
+            "model/batch before quantizing")
+    qsrc_sym, qsrc_args, qsrc_aux = sym, dict(arg_params), \
+        dict(aux_params or {})
+    if fold_bn and qsrc_aux:
+        from ..symbol.fusion import fold_batchnorm
+
+        qsrc_sym, qsrc_args, qsrc_aux = fold_batchnorm(
+            qsrc_sym, qsrc_args, qsrc_aux)
+        log("folded BatchNorm into producer weights "
+            "(%d aux entries remain)" % len(qsrc_aux))
+    qsym, qargs, qaux = quantize_model(
+        qsrc_sym, qsrc_args, qsrc_aux,
+        excluded_sym_names=excluded_sym_names, calib_mode="none")
+    n_q = sum(1 for n in qargs if n.endswith("_weight_quantized"))
+    if n_q == 0:
+        raise QuantizationGateError(
+            "quantization gate: no eligible layer was quantized "
+            "(every FullyConnected/Convolution excluded or shared) — "
+            "an 'int8 artifact' that is all-fp32 would be a lie")
+    int8_out = _forward_symbol(qsym, qargs, qaux, calib, data_name)
+    if not np.all(np.isfinite(int8_out)):
+        raise QuantizationGateError(
+            "quantization gate: int8 graph produces non-finite outputs "
+            "on the calibration batch — calibration is unusable")
+    agreement = topk_agreement(fp32_out, int8_out, topk)
+    delta = 1.0 - agreement
+    report = {
+        "dtype_policy": "int8",
+        "topk": topk,
+        "max_delta": max_delta,
+        "agreement": round(agreement, 6),
+        "delta": round(delta, 6),
+        "calib_rows": int(calib.shape[0]),
+        "calib_sha256": hashlib.sha256(
+            np.ascontiguousarray(calib).tobytes()).hexdigest(),
+        "quantized_layers": n_q,
+        "data_name": data_name,
+        "data_shape": [int(d) for d in calib.shape],
+        "data_dtype": str(calib.dtype),
+        "bn_folded": bool(fold_bn and aux_params),
+    }
+    if delta > max_delta:
+        raise QuantizationGateError(
+            "quantization gate REFUSED: measured top-%d accuracy delta "
+            "%.4f exceeds the %.4f threshold (agreement %.4f on %d "
+            "calibration rows) — the int8 artifact would degrade "
+            "accuracy of record" % (topk, delta, max_delta, agreement,
+                                    calib.shape[0]))
+    log("gate passed: top-%d agreement %.4f (delta %.4f <= %.4f), "
+        "%d int8 layers" % (topk, agreement, delta, max_delta, n_q))
+    return qsym, qargs, qaux, report
+
+
+def save_artifact(out_dir, qsym, qarg_params, qaux_params, report):
+    """Persist one gated int8 serving artifact: symbol json + params
+    blob (``model.save_checkpoint``, atomic) and — LAST, as the commit
+    point — ``meta.json`` carrying the gate report and the
+    ``dtype_policy: int8`` tag the serving/prewarm layers key on."""
+    import datetime
+
+    from .. import model as _model
+    from ..checkpoint import atomic_write
+
+    os.makedirs(out_dir, exist_ok=True)
+    prefix = os.path.join(out_dir, ARTIFACT_PREFIX)
+    _model.save_checkpoint(prefix, 0, qsym, qarg_params,
+                           qaux_params or {})
+    meta = dict(report)
+    meta.setdefault("dtype_policy", "int8")
+    meta["created"] = datetime.datetime.now(
+        datetime.timezone.utc).isoformat(timespec="seconds")
+    atomic_write(os.path.join(out_dir, ARTIFACT_META),
+                 json.dumps(meta, indent=2, sort_keys=True))
+    return out_dir
+
+
+def load_artifact(art_dir):
+    """Load a quantized serving artifact -> ``(qsym, qarg_params,
+    qaux_params, meta)``; raises MXNetError on a missing/torn artifact
+    (meta.json is the commit point — no meta, no artifact)."""
+    from .. import model as _model
+
+    meta_path = os.path.join(art_dir, ARTIFACT_META)
+    try:
+        with open(meta_path) as f:
+            meta = json.load(f)
+    except OSError as e:
+        raise MXNetError("quantized artifact %s: no %s (%s) — the "
+                         "artifact was never committed" %
+                         (art_dir, ARTIFACT_META, e))
+    except ValueError as e:
+        raise MXNetError("quantized artifact %s: malformed %s (%s)"
+                         % (art_dir, ARTIFACT_META, e))
+    qsym, qargs, qaux = _model.load_checkpoint(
+        os.path.join(art_dir, ARTIFACT_PREFIX), 0)
+    return qsym, qargs, qaux, meta
+
+
+def check_artifact(art_dir):
+    """Validation problems for an artifact dir (empty list = OK):
+    meta present + int8-tagged, gate report complete and within its
+    own threshold, model files loadable."""
+    problems = []
+    try:
+        _qsym, qargs, _qaux, meta = load_artifact(art_dir)
+    except MXNetError as e:
+        return [str(e)]
+    if meta.get("dtype_policy") != "int8":
+        problems.append("meta dtype_policy %r != 'int8'"
+                        % meta.get("dtype_policy"))
+    for field in ("topk", "max_delta", "delta", "agreement",
+                  "calib_sha256", "quantized_layers"):
+        if field not in meta:
+            problems.append("meta missing gate field %r" % field)
+    if isinstance(meta.get("delta"), (int, float)) and \
+            isinstance(meta.get("max_delta"), (int, float)) and \
+            meta["delta"] > meta["max_delta"]:
+        problems.append("recorded delta %.4f exceeds its own threshold "
+                        "%.4f — artifact should never have been emitted"
+                        % (meta["delta"], meta["max_delta"]))
+    if not any(n.endswith("_weight_quantized") for n in qargs):
+        problems.append("params contain no *_weight_quantized entries")
+    return problems

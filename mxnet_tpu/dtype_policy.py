@@ -1,0 +1,387 @@
+"""Named mixed-precision dtype policies: bf16 compute as a first-class
+speed lever.
+
+Everything before this module computed in f32; on TPU the MXU's bf16
+path alone is ~2x matmul throughput ("A Learned Performance Model for
+TPUs", PAPERS.md, makes dtype a first-order feature of op cost — our
+fusion cost table keys already carry it).  A :class:`DtypePolicy` makes
+the precision recipe a *declared, inspectable* artifact, threaded
+through every compile front-end exactly like ``fusion=``/``aot=``:
+
+* ``f32``        — the historical default: no casts, no loss scaling.
+* ``bf16_mixed`` — bf16 compute / f32 master params + optimizer state,
+  with per-layer override rules keeping normalization parameters and
+  the loss head (softmax logits) in f32, and dynamic loss scaling
+  (ramp-up/backoff on overflow) fused into the train step.
+* ``bf16_pure``  — everything bf16 in compute, no f32 islands, no loss
+  scaling (bf16 carries the f32 exponent range; use when the extra
+  stability of ``bf16_mixed`` is measured unnecessary).
+
+Per-layer overrides are ordered :class:`CastRule` lists — regex over
+the gluon parameter name plus an optional rank filter — the exact shape
+of ``parallel/layout.py`` SpecRules, so the same name conventions drive
+both sharding and precision.  First match wins; no match means the
+policy's compute dtype.
+
+Compute follows the *weight*: the trainer/executor/CachedOp/Predictor
+trace paths cast each parameter per the rules, and the parameterized
+ops (FullyConnected / Convolution) harmonize their activation input to
+the weight's dtype under an installed policy :func:`scope` — so a
+kept-f32 LayerNorm cannot silently promote the rest of the network
+back to f32 (bf16*f32 type promotion would), and a kept-f32 head
+really computes its logits in f32.
+
+Loss scaling rides the existing non-finite policy machinery: the step
+multiplies the loss by the current scale, unscales the gradients, and
+a non-finite (overflowed) scaled step selects the PREVIOUS params/
+optimizer state in-graph — skipped-and-counted on the device-resident
+metric accumulator, never host-synced, composing with the PR 10 async
+dispatch.  The scale state rides the optimizer-state pytree, so
+checkpoints, resharding, and donation handle it for free.
+"""
+from __future__ import annotations
+
+import contextlib
+import contextvars
+import re
+import threading
+
+import numpy as np
+
+from .base import MXNetError
+from . import config as _config
+
+__all__ = ["CastRule", "DtypePolicy", "LossScaleConfig",
+           "register_policy", "get_policy", "list_policies",
+           "resolve_policy", "policy_tag", "scope", "current_policy",
+           "harmonize", "loss_scale_update", "init_loss_scale"]
+
+
+def _is_float(dtype):
+    """Floating-point check that recognizes the ml_dtypes extension
+    types (bfloat16/float8 report numpy kind 'V', not 'f')."""
+    dt = np.dtype(dtype)
+    return dt.kind == "f" or dt.name.startswith("bfloat") or \
+        dt.name.startswith("float8")
+
+
+class CastRule:
+    """One ordered per-layer override: ``pattern`` (regex,
+    ``re.search`` over the full parameter name) + optional rank filter
+    -> compute dtype for that parameter.  Same matching semantics as
+    ``parallel.layout.SpecRule`` so one naming convention drives both
+    sharding and precision."""
+
+    def __init__(self, name, pattern, dtype, rank=None, min_rank=None):
+        self.name = name
+        self.pattern = pattern
+        self._re = re.compile(pattern)
+        self.dtype = np.dtype(dtype)
+        self.rank = rank
+        self.min_rank = min_rank
+
+    def matches(self, param_name, shape=None):
+        if shape is not None:
+            if self.rank is not None and len(shape) != self.rank:
+                return False
+            if self.min_rank is not None and len(shape) < self.min_rank:
+                return False
+        return self._re.search(param_name) is not None
+
+    def __repr__(self):
+        return "CastRule(%r, %r -> %s)" % (self.name, self.pattern,
+                                           self.dtype)
+
+
+class LossScaleConfig:
+    """Dynamic loss-scale schedule: start at ``init``, multiply by
+    ``growth`` after ``growth_interval`` consecutive finite steps
+    (capped at ``max_scale``), multiply by ``backoff`` on an overflowed
+    step (floored at 1.0).  Defaults come from the ``MXNET_LOSS_SCALE*``
+    env knobs at trainer build time."""
+
+    def __init__(self, init=None, growth_interval=None, backoff=None,
+                 growth=2.0, max_scale=None):
+        self.init = float(init if init is not None
+                          else _config.get("MXNET_LOSS_SCALE"))
+        self.growth_interval = int(
+            growth_interval if growth_interval is not None
+            else _config.get("MXNET_LOSS_SCALE_GROWTH_INTERVAL"))
+        self.backoff = float(backoff if backoff is not None
+                             else _config.get("MXNET_LOSS_SCALE_BACKOFF"))
+        self.growth = float(growth)
+        self.max_scale = float(max_scale if max_scale is not None
+                               else _config.get("MXNET_LOSS_SCALE_MAX"))
+        if self.init <= 0 or self.backoff <= 0 or self.backoff >= 1 or \
+                self.growth_interval < 1:
+            raise MXNetError(
+                "invalid loss-scale config: init=%r growth_interval=%r "
+                "backoff=%r (want init>0, interval>=1, 0<backoff<1)"
+                % (self.init, self.growth_interval, self.backoff))
+
+    def __repr__(self):
+        return ("LossScaleConfig(init=%g, growth_interval=%d, "
+                "backoff=%g, max=%g)" % (self.init, self.growth_interval,
+                                         self.backoff, self.max_scale))
+
+
+def init_loss_scale(cfg):
+    """Fresh host-side loss-scale state vector ``[scale, good_steps]``
+    (f32; rides the optimizer-state pytree)."""
+    return np.array([cfg.init, 0.0], np.float32)
+
+
+def loss_scale_update(state, keep, cfg):
+    """In-graph dynamic loss-scale transition (pure, jit-traceable).
+
+    ``state`` is the ``[scale, good_steps]`` vector, ``keep`` the
+    step's all-finite predicate.  Overflow: scale *= backoff (floor
+    1.0), streak resets.  ``growth_interval`` consecutive finite steps:
+    scale *= growth (cap ``max_scale``)."""
+    import jax.numpy as jnp
+
+    scale, good = state[0], state[1]
+    good_next = jnp.where(keep, good + 1.0, 0.0)
+    grow = good_next >= cfg.growth_interval
+    scale_next = jnp.where(
+        keep,
+        jnp.where(grow, jnp.minimum(scale * cfg.growth, cfg.max_scale),
+                  scale),
+        jnp.maximum(scale * cfg.backoff, 1.0))
+    good_next = jnp.where(grow, jnp.zeros_like(good_next), good_next)
+    return jnp.stack([scale_next, good_next]).astype(jnp.float32)
+
+
+class DtypePolicy:
+    """A named precision recipe (see module doc).
+
+    Parameters
+    ----------
+    name : registry name; also the tag folded into AOT content hashes,
+        manifest rows, and BENCH JSON lines.
+    compute_dtype : dtype activations and (rule-permitting) parameters
+        are cast to inside the traced program.
+    param_dtype : the master/storage dtype — parameters and optimizer
+        state stay here; casts happen per step inside the jit (XLA
+        fuses them into the first consumer).
+    rules : ordered :class:`CastRule` list; first match wins, no match
+        means ``compute_dtype``.
+    loss_scaling : arm dynamic loss scaling in ShardedTrainer (bf16
+        under-/overflow protection for the scaled gradients).
+    cast_outputs : cast floating outputs back to this dtype at the
+        program boundary (None = leave them in compute dtype).  Keeps
+        downstream eager metric/loss code dtype-stable.
+    """
+
+    def __init__(self, name, compute_dtype, param_dtype="float32",
+                 rules=(), loss_scaling=False, cast_outputs="float32"):
+        self.name = name
+        self.compute_dtype = np.dtype(compute_dtype)
+        self.param_dtype = np.dtype(param_dtype)
+        self.rules = list(rules)
+        self.loss_scaling = bool(loss_scaling)
+        self.cast_outputs = (np.dtype(cast_outputs)
+                             if cast_outputs is not None else None)
+
+    @property
+    def tag(self):
+        return self.name
+
+    def param_cast_dtype(self, param_name, shape=None):
+        """Compute dtype for one named parameter: the first matching
+        override rule wins, else the policy compute dtype."""
+        for r in self.rules:
+            if r.matches(param_name, shape):
+                return r.dtype
+        return self.compute_dtype
+
+    def rule_name(self, param_name, shape=None):
+        """Name of the override rule that fires for ``param_name``
+        (None = no override, compute dtype applies) — the audit hook
+        the tests assert rules fire by name through."""
+        for r in self.rules:
+            if r.matches(param_name, shape):
+                return r.name
+        return None
+
+    def cast_compute(self, name, arr):
+        """Trace-time cast of one named array toward this policy (jit
+        only — no-op for non-floating arrays or already-right dtypes)."""
+        dt = np.dtype(arr.dtype)
+        if not _is_float(dt):
+            return arr
+        tgt = self.param_cast_dtype(name, tuple(arr.shape))
+        return arr if dt == tgt else arr.astype(tgt)
+
+    def cast_output(self, arr):
+        if self.cast_outputs is None:
+            return arr
+        dt = np.dtype(arr.dtype)
+        if not _is_float(dt) or dt == self.cast_outputs:
+            return arr
+        return arr.astype(self.cast_outputs)
+
+    def describe(self, params=None):
+        """Human-readable recipe; with ``params`` (name, shape pairs)
+        also the per-parameter resolution — the precision analogue of
+        ``LayoutResolution.describe``."""
+        lines = ["policy=%s compute=%s params=%s loss_scaling=%s"
+                 % (self.name, self.compute_dtype, self.param_dtype,
+                    self.loss_scaling)]
+        for r in self.rules:
+            lines.append("  rule %-16s %-40s -> %s"
+                         % (r.name, r.pattern, r.dtype))
+        for n, s in (params or ()):
+            lines.append("  %-48s %-10s rule=%s"
+                         % (n, self.param_cast_dtype(n, s),
+                            self.rule_name(n, s) or "<compute>"))
+        return "\n".join(lines)
+
+    def __repr__(self):
+        return "DtypePolicy(%r, compute=%s, %d rules)" % (
+            self.name, self.compute_dtype, len(self.rules))
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+_REGISTRY = {}
+_REGISTRY_LOCK = threading.Lock()
+
+
+def register_policy(policy, overwrite=False):
+    if not isinstance(policy, DtypePolicy):
+        raise MXNetError("register_policy takes a DtypePolicy, got %s"
+                         % type(policy).__name__)
+    with _REGISTRY_LOCK:
+        if policy.name in _REGISTRY and not overwrite:
+            raise MXNetError("dtype policy %r is already registered "
+                             "(pass overwrite=True)" % policy.name)
+        _REGISTRY[policy.name] = policy
+    return policy
+
+
+def get_policy(name):
+    with _REGISTRY_LOCK:
+        p = _REGISTRY.get(name)
+    if p is None:
+        raise MXNetError("unknown dtype policy %r (registered: %s)"
+                         % (name, sorted(_REGISTRY)))
+    return p
+
+
+def list_policies():
+    with _REGISTRY_LOCK:
+        return sorted(_REGISTRY)
+
+
+def resolve_policy(spec=None):
+    """``dtype_policy=`` argument -> DtypePolicy or None (f32, no-op).
+
+    Accepted: None (defer to ``MXNET_DTYPE_POLICY``; '' = f32), a
+    registered name, or a DtypePolicy object.  ``"f32"``/''/False
+    resolve to None — the zero-cost path every pre-policy call site
+    stays on.  Unknown names raise at bind (the ``remat_policy``
+    fail-fast contract)."""
+    if isinstance(spec, DtypePolicy):
+        return None if spec.name == "f32" else spec
+    if spec is None:
+        spec = _config.get("MXNET_DTYPE_POLICY")
+    if spec in (False, "", "f32", "off", "none", None):
+        return None
+    if not isinstance(spec, str):
+        raise MXNetError("dtype_policy must be a DtypePolicy or a "
+                         "registered name, got %s" % type(spec).__name__)
+    return get_policy(spec)
+
+
+def policy_tag(policy):
+    """Canonical string tag for AOT fingerprints / manifests / BENCH
+    JSON: the policy name, ``"f32"`` for the no-policy path."""
+    if policy is None:
+        return "f32"
+    return policy.tag if isinstance(policy, DtypePolicy) else str(policy)
+
+
+# ---------------------------------------------------------------------------
+# trace-time scope: parameterized ops harmonize compute to the weight
+# ---------------------------------------------------------------------------
+
+_ctx = contextvars.ContextVar("mxnet_tpu_dtype_policy", default=None)
+
+
+@contextlib.contextmanager
+def scope(policy):
+    """Install ``policy`` for the duration of a trace (no-op for
+    None).  FullyConnected/Convolution fast paths consult it via
+    :func:`harmonize`."""
+    if policy is None:
+        yield None
+        return
+    token = _ctx.set(policy)
+    try:
+        yield policy
+    finally:
+        _ctx.reset(token)
+
+
+def current_policy():
+    return _ctx.get()
+
+
+def harmonize(data, weight):
+    """Cast ``data`` to ``weight``'s floating dtype under an active
+    policy scope — compute follows the weight, so a kept-f32 island
+    (norm gamma, loss head) computes in f32 and the next bf16-cast
+    weight pulls activations back down to bf16 instead of f32 type
+    promotion silently un-mixing the network.  Identity when no policy
+    scope is installed (every pre-policy call site)."""
+    if _ctx.get() is None:
+        return data
+    wdt = np.dtype(weight.dtype)
+    ddt = np.dtype(data.dtype)
+    if not _is_float(wdt) or not _is_float(ddt) or wdt == ddt:
+        return data
+    return data.astype(wdt)
+
+
+def note_policy(policy, where):
+    """Telemetry info gauge for the active policy at a build site."""
+    from . import telemetry as _telemetry
+
+    if _telemetry.enabled():
+        _telemetry.DTYPE_POLICY_INFO.set(1, policy=policy_tag(policy),
+                                         where=where)
+
+
+# ---------------------------------------------------------------------------
+# canonical built-ins
+# ---------------------------------------------------------------------------
+
+register_policy(DtypePolicy("f32", "float32", rules=(),
+                            loss_scaling=False, cast_outputs=None))
+
+# normalization statistics/affine params and the loss head stay f32:
+# norm reductions are where bf16 rounding visibly bends trajectories,
+# and f32 softmax logits are the standard mixed-precision recipe.
+# gamma/beta/moving/running suffixes ARE norm params by mxnet
+# convention whatever the prefix (batchnorm0_gamma, stage0_unit0_bn1_
+# gamma, bn0_moving_mean); weight/bias only count as norm params under
+# a norm/ln/bn-ish prefix.  The head rule matches the transformer-LM
+# naming the fsdp_tp layout rules already key on.
+_NORM_F32 = CastRule(
+    "norm_f32",
+    r"(^|_)(gamma|beta|moving_mean|moving_var|running_mean|"
+    r"running_var)$|(norm|ln|bn)[a-z0-9_]*_(weight|bias)$", "float32")
+_HEAD_F32 = CastRule("head_f32", r"(head|logits|lm_head)\d*_(weight|bias)$",
+                     "float32")
+
+register_policy(DtypePolicy(
+    "bf16_mixed", "bfloat16", param_dtype="float32",
+    rules=(_NORM_F32, _HEAD_F32), loss_scaling=True,
+    cast_outputs="float32"))
+
+register_policy(DtypePolicy(
+    "bf16_pure", "bfloat16", param_dtype="float32", rules=(),
+    loss_scaling=False, cast_outputs=None))

@@ -31,16 +31,25 @@ from .ndarray.ndarray import NDArray
 
 __all__ = ["Executor"]
 
+# symbolic args that carry per-batch DATA, not parameters: the mxnet
+# naming convention ("data", "data0", "softmax_label", "label", ...).
+# A dtype policy must not cast these — labels/token ids ride f32
+# carriers whose integer values bf16 cannot represent above 256.
+import re as _re
+
+_DATA_INPUT_RE = _re.compile(r"(^|_)(data|label)s?\d*$")
+
 
 class Executor:
     def __init__(self, symbol, ctx, args, args_grad, grad_req, aux_states,
                  shared_exec=None, remat_policy=None, fusion=None,
-                 aot=None):
+                 aot=None, dtype_policy=None):
         import jax
 
         from .remat import resolve_policy
         from . import fusion_cost as _fc
         from . import aot as _aot
+        from . import dtype_policy as _dtp
 
         # validate eagerly so a typo'd policy fails at bind, not at the
         # first backward; None defers to MXNET_REMAT_POLICY
@@ -53,6 +62,14 @@ class Executor:
         # bind like the fusion plan, threaded below onto the jits
         aot_store = _aot.resolve_aot(aot)
         self._aot = aot
+        # mixed-precision dtype policy (None defers to
+        # MXNET_DTYPE_POLICY): per-name compute casts inside the jitted
+        # graph fn, compute-follows-the-weight op harmonization, and
+        # floating outputs cast back to the policy boundary dtype so
+        # eager consumers stay dtype-stable
+        dt_policy = _dtp.resolve_policy(dtype_policy)
+        self._dtype_policy = dtype_policy
+        _dtp.note_policy(dt_policy, "executor")
 
         self._symbol = symbol
         self._ctx = ctx or current_context()
@@ -98,13 +115,38 @@ class Executor:
         fn = self._sym_fn
 
         def fwd(values, rng, is_train):
+            from . import dtype_policy as _dtp_mod
+
+            orig = values
+            if dt_policy is not None:
+                # per-name compute casts (the override rules fire on
+                # arg/aux names — norm gammas and moving stats stay
+                # f32); integer/int8 arrays pass through untouched.
+                # Data/label inputs are NEVER cast: class ids and token
+                # ids ride f32 carriers that bf16 would corrupt above
+                # 256 — same contract as the trainer, which casts only
+                # parameters; the op-level harmonize pulls real
+                # activations to the weight dtype at the first
+                # parameterized op.
+                values = {n: v if _DATA_INPUT_RE.search(n)
+                          else dt_policy.cast_compute(n, v)
+                          for n, v in values.items()}
             _random.push_trace_key(rng)
             prev = _ag.set_training(is_train)
             try:
-                outs, aux = fn(values, is_train=is_train)
+                with _dtp_mod.scope(dt_policy):
+                    outs, aux = fn(values, is_train=is_train)
             finally:
                 _ag.set_training(prev)
                 _random.pop_trace_key()
+            if dt_policy is not None:
+                # outputs back to the boundary dtype; aux (moving-stat)
+                # updates back to their STORAGE dtype inside the jit —
+                # a bf16 aux rebind would flip the bound signature and
+                # recompile every later step
+                outs = [dt_policy.cast_output(o) for o in outs]
+                aux = {k: v.astype(orig[k].dtype) if k in orig else v
+                       for k, v in aux.items()}
             return tuple(outs), aux
 
         self._jit_fwd_infer = jax.jit(functools.partial(fwd, is_train=False))
@@ -137,19 +179,24 @@ class Executor:
             # already reshape the lowered HLO, so they're in the key;
             # the explicit tag is belt-and-braces for policy aliases
             # that lower identically today but may not tomorrow
-            fp = "remat=%s|fusion=%s|fired=%s" % (
+            mext = {"dtype_policy": _dtp.policy_tag(dt_policy)}
+            fp = "remat=%s|fusion=%s|fired=%s|dtype=%s" % (
                 self._remat_policy or "", fusion if fusion is not None
-                else "", ",".join(map(str, self.fusion_fired)))
+                else "", ",".join(map(str, self.fusion_fired)),
+                mext["dtype_policy"])
             name = getattr(symbol, "name", "sym")
             self._jit_fwd_infer = _aot.AOTFunction(
                 self._jit_fwd_infer, "executor:%s:fwd_infer" % name,
-                aot_store, fingerprint_extra=fp, manifest_kind="executor")
+                aot_store, fingerprint_extra=fp, manifest_kind="executor",
+                manifest_extra=mext)
             self._jit_fwd_train = _aot.AOTFunction(
                 self._jit_fwd_train, "executor:%s:fwd_train" % name,
-                aot_store, fingerprint_extra=fp, manifest_kind="executor")
+                aot_store, fingerprint_extra=fp, manifest_kind="executor",
+                manifest_extra=mext)
             self._jit_fwd_bwd = _aot.AOTFunction(
                 self._jit_fwd_bwd, "executor:%s:fwd_bwd" % name,
-                aot_store, fingerprint_extra=fp, manifest_kind="executor")
+                aot_store, fingerprint_extra=fp, manifest_kind="executor",
+                manifest_extra=mext)
         self._cot_struct_cache = {}  # bound-shape key -> output structs
 
     # ------------------------------------------------------------------
@@ -304,7 +351,8 @@ class Executor:
         return Executor(self._symbol, self._ctx, new_args, new_grads,
                         self._grad_req, new_aux,
                         remat_policy=self._remat_policy,
-                        fusion=self._fusion, aot=self._aot)
+                        fusion=self._fusion, aot=self._aot,
+                        dtype_policy=self._dtype_policy)
 
     def set_monitor_callback(self, callback, monitor_all=False):
         self.monitor_callback = callback

@@ -49,8 +49,8 @@ from . import config as _config
 __all__ = ["shape_key", "CostTable", "validate_table", "load_table",
            "save_table", "set_cost_table", "current_table",
            "FusionPlan", "resolve_fusion", "scope", "current_plan",
-           "runtime_decision", "SPEEDUP_FIRE", "SPEEDUP_KEEP",
-           "TABLE_VERSION"]
+           "runtime_decision", "migrate_legacy_table", "SPEEDUP_FIRE",
+           "SPEEDUP_KEEP", "TABLE_VERSION"]
 
 # a default-OFF pattern fires when measured at least this much faster;
 # a default-ON pattern is suppressed when measured slower than parity.
@@ -60,12 +60,20 @@ SPEEDUP_FIRE = 1.05
 SPEEDUP_KEEP = 1.0
 TABLE_VERSION = 1
 
-_DTYPE_TAGS = {"float32": "f32", "float64": "f64", "float16": "f16",
-               "bfloat16": "bf16", "int32": "i32", "int64": "i64"}
+# ordered: "bfloat16" MUST precede "float16" — the tag match is a
+# substring scan and "float16" is a substring of "bfloat16"
+_DTYPE_TAGS = {"bfloat16": "bf16", "float32": "f32", "float64": "f64",
+               "float16": "f16", "int32": "i32", "int64": "i64"}
 
 # pattern|dtype|DxDx...[|ax<k>]
 _KEY_RE = re.compile(
     r"^[A-Za-z0-9_]+\|[a-z0-9]+\|\d+(x\d+)*(\|ax-?\d+)?(\|[a-z0-9.]+)?$")
+# the pre-dtype key form (pattern|DxD...): recognized only to emit a
+# targeted migration message and to drive migrate_legacy_table — a
+# bf16 site must never silently reuse an f32 measurement, so these
+# keys are invalid until migrated
+_LEGACY_KEY_RE = re.compile(
+    r"^[A-Za-z0-9_]+\|\d+(x\d+)*(\|ax-?\d+)?(\|[a-z0-9.]+)?$")
 
 _ENTRY_REQUIRED = ("pattern", "fused_ms", "unfused_ms", "speedup")
 
@@ -121,8 +129,15 @@ def validate_table(data, max_age_days=None, now=None):
         datetime.timezone.utc)
     for key, e in entries.items():
         if not _KEY_RE.match(key):
-            problems.append("bad shape key %r (want pattern|dtype|DxD"
-                            "[|axK])" % key)
+            if _LEGACY_KEY_RE.match(key):
+                problems.append(
+                    "legacy shape key %r is missing its dtype "
+                    "component — a bf16 site would reuse this f32 "
+                    "measurement; migrate with tools/autotune.py "
+                    "--migrate TABLE" % key)
+            else:
+                problems.append("bad shape key %r (want pattern|dtype|"
+                                "DxD[|axK])" % key)
             continue
         if not isinstance(e, dict):
             problems.append("entry %r is not an object" % key)
@@ -154,6 +169,33 @@ def validate_table(data, max_age_days=None, now=None):
                 problems.append("entry %r measured_at %r is not ISO-8601"
                                 % (key, e["measured_at"]))
     return problems, stale
+
+
+def migrate_legacy_table(data):
+    """Rewrite pre-dtype keys (``pattern|DxD...``) to the current form
+    by inserting the ``f32`` tag those measurements were taken under.
+
+    Returns ``(migrated_data, n_migrated)``; the input is not mutated.
+    Collisions (a legacy key whose migrated form already exists) keep
+    the EXPLICIT entry — a measured-with-dtype entry always outranks an
+    assumed-f32 legacy one."""
+    if not isinstance(data, dict) or not isinstance(data.get("entries"),
+                                                    dict):
+        return data, 0
+    out = {k: v for k, v in data.items() if k != "entries"}
+    entries = {}
+    n = 0
+    for key, e in data["entries"].items():
+        if not _KEY_RE.match(key) and _LEGACY_KEY_RE.match(key):
+            pattern, rest = key.split("|", 1)
+            new_key = "%s|f32|%s" % (pattern, rest)
+            if new_key not in data["entries"]:
+                entries[new_key] = e
+                n += 1
+            continue
+        entries[key] = e
+    out["entries"] = entries
+    return out, n
 
 
 class CostTable:

@@ -374,12 +374,14 @@ class CachedOp:
     cached_op.cc via MXCreateCachedOpEx)."""
 
     def __init__(self, block, static_alloc=False, static_shape=False,
-                 remat_policy=None, fusion=None, aot=None):
+                 remat_policy=None, fusion=None, aot=None,
+                 dtype_policy=None):
         import jax
 
         from ..remat import resolve_policy
         from .. import fusion_cost as _fc
         from .. import aot as _aot
+        from .. import dtype_policy as _dtp
 
         self._block = block
         self._jits = {}  # is_train -> jitted fn
@@ -405,26 +407,37 @@ class CachedOp:
         # config.enable_aot after construction still applies
         _aot.resolve_aot(aot)
         self._aot = aot
+        # mixed-precision dtype policy (hybridize(dtype_policy=...) or
+        # the MXNET_DTYPE_POLICY default): per-parameter compute casts
+        # by rule name inside the traced fn, op-level harmonization via
+        # the policy scope, outputs/moving stats cast back at the
+        # program boundary.  Validated now, re-resolved per trace.
+        _dtp.resolve_policy(dtype_policy)
+        self._dtype_policy = dtype_policy
 
     def _wrap_aot(self, jit_fn, tag):
         """AOT-wrap one freshly created jit (no-op when AOT is off)."""
         from .. import aot as _aot
+        from .. import dtype_policy as _dtp
 
         store = _aot.resolve_aot(self._aot)
         if store is None:
             return jit_fn
-        fp = "remat=%s|fusion=%s" % (self._remat_policy or "",
-                                     self._fusion if self._fusion
-                                     is not None else "")
+        dtag = _dtp.policy_tag(_dtp.resolve_policy(self._dtype_policy))
+        fp = "remat=%s|fusion=%s|dtype=%s" % (
+            self._remat_policy or "",
+            self._fusion if self._fusion is not None else "", dtag)
         return _aot.AOTFunction(
             jit_fn, "cachedop:%s:%s" % (self._block.name, tag), store,
-            fingerprint_extra=fp, manifest_kind="cachedop")
+            fingerprint_extra=fp, manifest_kind="cachedop",
+            manifest_extra={"dtype_policy": dtag})
 
     def _make_fn(self, is_train, n_inputs, n_params):
         block = self._block
 
         def raw_fn(rng, inputs, params):
             from .. import fusion_cost as _fc
+            from .. import dtype_policy as _dtp
             from contextlib import ExitStack
 
             # resolved per trace (not at construction) so a cost table
@@ -432,6 +445,7 @@ class CachedOp:
             # BEFORE mutating the global trace state so a bad
             # MXNET_FUSION set after construction cannot leak it
             fusion_plan = _fc.resolve_fusion(self._fusion)
+            dt_policy = _dtp.resolve_policy(self._dtype_policy)
             _random.push_trace_key(rng)
             prev_t = autograd.set_training(is_train)
             prev_r = autograd.set_recording(False)
@@ -440,14 +454,18 @@ class CachedOp:
             _trace_state.active = True
             stack = ExitStack()
             stack.enter_context(_fc.scope(fusion_plan))
+            stack.enter_context(_dtp.scope(dt_policy))
             try:
                 nd_inputs = [NDArray(x) for x in inputs]
                 # rebind live param NDArrays to tracers for the trace
+                # (cast to the policy compute dtype per override rule —
+                # norm params stay f32 under bf16_mixed)
                 saved = []
                 for p, arr in zip(self._param_list, params):
                     d = p.data()
                     saved.append((d, d._data))
-                    d._data = arr
+                    d._data = arr if dt_policy is None else \
+                        dt_policy.cast_compute(p.name, arr)
                 try:
                     out = block.hybrid_forward_dispatch(*nd_inputs)
                 finally:
@@ -458,6 +476,16 @@ class CachedOp:
                 aux_params = [p for (p, _v) in sink]
                 aux_vals = [v._data if isinstance(v, NDArray) else v
                             for (_p, v) in sink]
+                if dt_policy is not None:
+                    # boundary casts inside the jit: outputs to the
+                    # policy's output dtype, moving-stat updates back
+                    # to their STORAGE dtype (a bf16 aux rebind would
+                    # flip the traced signature and recompile)
+                    outs = [dt_policy.cast_output(o) for o in outs]
+                    aux_vals = [
+                        v.astype(p.data()._data.dtype)
+                        if hasattr(v, "astype") else v
+                        for p, v in zip(aux_params, aux_vals)]
                 return tuple(outs), tuple(aux_vals), tmpl, aux_params
             finally:
                 stack.close()

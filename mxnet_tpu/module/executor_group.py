@@ -37,11 +37,13 @@ class DataParallelExecutorGroup:
                  param_names, for_training, inputs_need_grad,
                  shared_group=None, logger=None, fixed_param_names=None,
                  grad_req="write", state_names=None, group2ctxs=None,
-                 remat_policy=None, fusion=None, aot=None):
+                 remat_policy=None, fusion=None, aot=None,
+                 dtype_policy=None):
         self.symbol = symbol
         self.remat_policy = remat_policy
         self.fusion = fusion
         self.aot = aot
+        self.dtype_policy = dtype_policy
         self.contexts = contexts
         self.workload = workload or [1] * len(contexts)
         self.for_training = for_training
@@ -98,6 +100,7 @@ class DataParallelExecutorGroup:
                                           remat_policy=self.remat_policy,
                                           fusion=self.fusion,
                                           aot=self.aot,
+                                          dtype_policy=self.dtype_policy,
                                           **shapes)
             self.execs.append(exe)
 

@@ -39,11 +39,13 @@ class Module(BaseModule):
                  label_names=("softmax_label",), logger=logging, context=None,
                  work_load_list=None, fixed_param_names=None,
                  state_names=None, group2ctxs=None, compression_params=None,
-                 remat_policy=None, fusion=None, aot=None):
+                 remat_policy=None, fusion=None, aot=None,
+                 dtype_policy=None):
         super().__init__(logger=logger)
         self._remat_policy = remat_policy
         self._fusion = fusion
         self._aot = aot
+        self._dtype_policy = dtype_policy
         ctxs = context if context is not None else cpu()
         if isinstance(ctxs, Context):
             ctxs = [ctxs]
@@ -214,7 +216,8 @@ class Module(BaseModule):
             for_training, inputs_need_grad, shared_group, self.logger,
             self._fixed_param_names, grad_req, self._state_names,
             self._group2ctxs, remat_policy=self._remat_policy,
-            fusion=self._fusion, aot=self._aot)
+            fusion=self._fusion, aot=self._aot,
+            dtype_policy=self._dtype_policy)
         self.binded = True
 
         if shared_module is not None and shared_module.params_initialized:

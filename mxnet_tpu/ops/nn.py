@@ -20,6 +20,7 @@ from jax import lax
 from .registry import register
 from .utils import pbool, pint, pfloat, ptuple, pdtype, paxis, normalize_axis
 from .. import random as _random
+from ..dtype_policy import harmonize as _dtype_harmonize
 
 # ---------------------------------------------------------------------------
 # FullyConnected (reference: src/operator/nn/fully_connected.cc)
@@ -29,6 +30,10 @@ from .. import random as _random
 @register("FullyConnected", num_inputs=-1)
 def fully_connected(data, weight, bias=None, num_hidden=None, no_bias=False,
                     flatten=True, **kw):
+    # mixed precision: compute follows the WEIGHT's dtype under an
+    # active dtype-policy scope (a kept-f32 head computes f32 logits;
+    # a bf16-cast weight pulls f32-promoted activations back to bf16)
+    data = _dtype_harmonize(data, weight)
     if pbool(flatten, True) and data.ndim > 2:
         data = data.reshape(data.shape[0], -1)
     out = jnp.matmul(data, weight.T)
@@ -58,6 +63,7 @@ def _dim_numbers(nd):
 def convolution(data, weight, bias=None, kernel=None, stride=None, dilate=None,
                 pad=None, num_filter=None, num_group=1, no_bias=False,
                 layout=None, workspace=None, cudnn_tune=None, cudnn_off=None, **kw):
+    data = _dtype_harmonize(data, weight)  # see fully_connected
     kernel = ptuple(kernel)
     nd = _conv_dims(kernel)
     stride = ptuple(stride, ndim=nd, default=(1,) * nd)

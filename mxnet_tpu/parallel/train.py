@@ -38,8 +38,10 @@ __all__ = ["ShardedTrainer", "sgd_init", "adam_init"]
 # (every ``metrics_every`` steps) instead of per step.  Layout:
 #   [0] sum of FINITE losses   [1] steps accumulated
 #   [2] non-finite loss count  [3] loss of the newest step (raw)
-_M_LOSS_SUM, _M_STEPS, _M_NONFINITE, _M_LAST = range(4)
-_METRICS_WIDTH = 4
+#   [4] current loss scale     [5] loss-scale backoffs (overflow skips)
+_M_LOSS_SUM, _M_STEPS, _M_NONFINITE, _M_LAST, _M_LS_SCALE, \
+    _M_LS_BACKOFF = range(6)
+_METRICS_WIDTH = 6
 
 
 class _MetricFetcher:
@@ -211,7 +213,7 @@ class ShardedTrainer:
                  remat_policy=None, fusion=None, on_nonfinite=None,
                  aot=None, aot_spec=None, layout=None,
                  async_metrics=None, steps_per_call=None,
-                 metrics_every=None, fetch_depth=2):
+                 metrics_every=None, fetch_depth=2, dtype_policy=None):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -220,6 +222,7 @@ class ShardedTrainer:
         from .. import config as _config
         from .. import fusion_cost as _fc
         from .. import aot as _aot
+        from .. import dtype_policy as _dtp
         from .mesh import resolve_mesh
         from . import layout as _layout
 
@@ -250,6 +253,25 @@ class ShardedTrainer:
         # discards the whole update (params, optimizer state, moving
         # stats) and keeps the previous state
         self._on_nonfinite = nonfinite_policy(on_nonfinite)
+        # mixed-precision dtype policy (None defers to
+        # MXNET_DTYPE_POLICY; '' / 'f32' = the historical f32 path):
+        # per-parameter compute casts by the policy's override rules,
+        # compute-follows-the-weight harmonization inside the traced
+        # ops, and — for loss-scaling policies — dynamic loss scaling
+        # whose overflow skip reuses the non-finite select above.  The
+        # legacy ``dtype=`` blanket cast survives as the escape hatch
+        # but cannot be combined with a policy.
+        self._dtype_policy = _dtp.resolve_policy(dtype_policy)
+        if self._dtype_policy is not None and dtype is not None:
+            raise MXNetError(
+                "pass dtype= (legacy blanket compute cast) or "
+                "dtype_policy=, not both")
+        self._ls_cfg = _dtp.LossScaleConfig() \
+            if (self._dtype_policy is not None
+                and self._dtype_policy.loss_scaling) else None
+        self._ls_active = self._ls_cfg is not None
+        self._cast_bytes = 0
+        _dtp.note_policy(self._dtype_policy, "trainer")
         # host-overlap knobs (ISSUE 10 — the dependency-engine overlap):
         # async_metrics moves every loss/metric host read off the
         # dispatch path onto a bounded fetch thread; steps_per_call=K
@@ -364,6 +386,16 @@ class ShardedTrainer:
             self.opt_state = sgd_init(train_arrays, momentum=self._momentum)
         else:
             self.opt_state = adam_init(train_arrays)
+        if self._ls_active:
+            # the dynamic loss-scale state rides the optimizer-state
+            # pytree: donation, out-sharding pinning, checkpointing and
+            # reshard-on-load all handle it with zero extra plumbing —
+            # a save/resume round-trip preserves the scale exactly
+            from .. import dtype_policy as _dtp
+
+            self.opt_state = {"base": self.opt_state,
+                              "loss_scale": _dtp.init_loss_scale(
+                                  self._ls_cfg)}
         if self.mesh is not None:
             self._shard_params(jax, NamedSharding, P)
         else:
@@ -417,6 +449,30 @@ class ShardedTrainer:
         ``.describe()``."""
         return self._layout_res
 
+    @property
+    def dtype_policy(self):
+        """The resolved :class:`~mxnet_tpu.dtype_policy.DtypePolicy`
+        (None = the historical f32 path)."""
+        return self._dtype_policy
+
+    @property
+    def dtype_policy_tag(self):
+        """Policy tag for BENCH JSON / manifests (``"f32"`` when no
+        policy is active)."""
+        from .. import dtype_policy as _dtp
+
+        return _dtp.policy_tag(self._dtype_policy)
+
+    def loss_scale(self):
+        """Current dynamic loss scale (host read — a device sync; call
+        at drain/checkpoint boundaries, not per step).  None when the
+        active policy does not loss-scale."""
+        if not self._ls_active:
+            return None
+        if self.opt_state is None:  # deferred shapes: not yet stepped
+            return float(self._ls_cfg.init)
+        return float(np.asarray(self.opt_state["loss_scale"])[0])
+
     def _resolve_layout_specs(self):
         """Resolve the layout against the materialized param shapes —
         once; the Layout caches by (params, mesh) so trainer No. 2 on
@@ -466,11 +522,15 @@ class ShardedTrainer:
         train_sh = [sh for sh, t in zip(self._param_shardings,
                                         self._trainable) if t]
         repl = NamedSharding(self.mesh, P())
+        base_state = self.opt_state["base"] if self._ls_active \
+            else self.opt_state
         if self._opt_name == "sgd":
-            opt_sh = {"mom": None if self.opt_state["mom"] is None
+            opt_sh = {"mom": None if base_state["mom"] is None
                       else list(train_sh)}
         else:
             opt_sh = {"m": list(train_sh), "v": list(train_sh), "t": repl}
+        if self._ls_active:
+            opt_sh = {"base": opt_sh, "loss_scale": repl}
         self._opt_shardings = opt_sh
         self.opt_state = jax.tree_util.tree_map(
             lambda a, sh: self._global_put(jax, a, sh),
@@ -585,12 +645,33 @@ class ShardedTrainer:
         loss_fn = self.loss_fn
         trainable = self._trainable
         cdtype = self._dtype
+        policy = self._dtype_policy
+
+        # per-parameter compute-cast plan, resolved ONCE at build: the
+        # policy's ordered override rules fire by name (norm params and
+        # the loss head stay f32 under bf16_mixed), everything else
+        # casts to the compute dtype.  None = no cast.  The legacy
+        # ``dtype=`` arg keeps its blanket-cast semantics.
+        cast_dtypes = [None] * len(params_objs)
+        self._cast_bytes = 0
+        for i, (p, arr) in enumerate(zip(params_objs, self.param_arrays)):
+            if not np.issubdtype(np.dtype(arr.dtype), np.floating):
+                continue
+            if policy is not None:
+                tgt = policy.param_cast_dtype(p.name, tuple(arr.shape))
+                if np.dtype(arr.dtype) != tgt:
+                    cast_dtypes[i] = tgt
+                    self._cast_bytes += int(arr.nbytes)
+            elif cdtype is not None:
+                cast_dtypes[i] = np.dtype(cdtype)
+                self._cast_bytes += int(arr.nbytes)
 
         fusion_spec = self._fusion
 
         def forward_loss(param_arrays, inputs, label, rng):
             from contextlib import ExitStack
 
+            from .. import dtype_policy as _dtp
             from .. import fusion_cost as _fc
 
             # resolved per trace, not at build: a cost table installed
@@ -606,20 +687,41 @@ class ShardedTrainer:
             _block_mod._trace_state.active = True
             stack = ExitStack()
             stack.enter_context(_fc.scope(fusion_plan))
+            # the policy scope makes FullyConnected/Convolution
+            # harmonize activations to their weight's dtype (compute
+            # follows the weight — see dtype_policy module doc)
+            stack.enter_context(_dtp.scope(policy))
             try:
                 saved = []
-                for p, arr in zip(params_objs, param_arrays):
+                for i, (p, arr) in enumerate(zip(params_objs,
+                                                 param_arrays)):
                     d = p.data()
                     saved.append((d, d._data))
-                    d._data = arr.astype(cdtype) if (
-                        cdtype is not None
-                        and np.issubdtype(np.dtype(arr.dtype), np.floating)) \
-                        else arr
+                    ct = cast_dtypes[i]
+                    d._data = arr.astype(ct) if ct is not None else arr
                 try:
+                    # inputs are NOT blanket-cast under a policy: token
+                    # ids ride f32 carriers that bf16 would corrupt;
+                    # the op-level harmonize casts real activations at
+                    # each parameterized op instead.  The legacy
+                    # ``dtype=`` path keeps its historical input cast.
                     nd_inputs = [NDArray(x.astype(cdtype)
                                          if cdtype is not None else x)
                                  for x in inputs]
                     out = net.hybrid_forward_dispatch(*nd_inputs)
+                    if policy is not None and \
+                            policy.cast_outputs is not None:
+                        # the loss head boundary: logits in f32 before
+                        # the softmax/CE (the bf16_mixed recipe), so
+                        # the loss reduction never quantizes to bf16
+                        def _co(o):
+                            if isinstance(o, NDArray):
+                                return NDArray(policy.cast_output(o._data))
+                            if isinstance(o, (list, tuple)):
+                                return type(o)(_co(v) for v in o)
+                            return o
+
+                        out = _co(out)
                     loss = loss_fn(out, NDArray(label))
                 finally:
                     for d, old in saved:
@@ -633,7 +735,9 @@ class ShardedTrainer:
                                  for (_p, v) in sink)
                 import jax.numpy as jnp
 
-                return jnp.mean(loss._data).astype(jnp.float32), aux_vals
+                # reduce in f32: a bf16 mean quantizes the reported
+                # loss to ~3 decimal digits
+                return jnp.mean(loss._data.astype(jnp.float32)), aux_vals
             finally:
                 stack.close()
                 _block_mod._trace_state.active = False
@@ -654,9 +758,19 @@ class ShardedTrainer:
         lr, wd, momentum = self._lr, self._wd, self._momentum
         beta1, beta2, eps = self._beta1, self._beta2, self._eps
         pidx = self._param_index
-        guard_skip = self._on_nonfinite == "skip"
+        ls_active = self._ls_active
+        ls_cfg = self._ls_cfg
+        # loss scaling reuses the non-finite select: an overflowed
+        # scaled step must always be discarded in-graph, whatever the
+        # host-side non-finite policy says
+        guard_skip = self._on_nonfinite == "skip" or ls_active
 
         def step(param_arrays, opt_state, inputs, label, rng, metrics):
+            import jax.numpy as jnp
+
+            base_state = opt_state["base"] if ls_active else opt_state
+            scale = opt_state["loss_scale"][0] if ls_active else None
+
             def lf(train_params):
                 full = []
                 ti = 0
@@ -667,19 +781,36 @@ class ShardedTrainer:
                     else:
                         full.append(p)
                 loss, aux = forward_loss(full, inputs, label, rng)
-                return loss, aux
+                # the SCALED loss drives the backward pass: gradients
+                # too small for bf16 ride up out of the flush-to-zero
+                # band, and are unscaled below in f32
+                scaled = loss * scale if ls_active else loss
+                return scaled, (loss, aux)
 
             train_params = [p for i, p in enumerate(param_arrays)
                             if trainable[i]]
-            (loss, aux), grads = jax.value_and_grad(lf, has_aux=True)(
-                train_params)
-            if opt_name == "sgd":
-                new_train, new_state = _sgd_update(train_params, grads,
-                                                   opt_state, lr, momentum, wd)
+            (_scaled, (loss, aux)), grads = jax.value_and_grad(
+                lf, has_aux=True)(train_params)
+            if ls_active:
+                inv = 1.0 / scale
+                grads = [g * inv for g in grads]
+                # overflow check on the unscaled master grads: inf/nan
+                # survives the unscale, so this catches both a scaled
+                # overflow and a genuinely poisoned batch
+                grads_finite = jnp.all(jnp.stack(
+                    [jnp.all(jnp.isfinite(g)) for g in grads])) \
+                    if grads else jnp.bool_(True)
+                keep = jnp.logical_and(jnp.isfinite(loss), grads_finite)
             else:
-                new_train, new_state = _adam_update(train_params, grads,
-                                                    opt_state, lr, beta1,
-                                                    beta2, eps, wd)
+                keep = jnp.isfinite(loss)
+            if opt_name == "sgd":
+                new_train, new_base = _sgd_update(train_params, grads,
+                                                  base_state, lr, momentum,
+                                                  wd)
+            else:
+                new_train, new_base = _adam_update(train_params, grads,
+                                                   base_state, lr, beta1,
+                                                   beta2, eps, wd)
             new_params = []
             ti = 0
             for i, p in enumerate(param_arrays):
@@ -694,29 +825,41 @@ class ShardedTrainer:
             for p, v in zip(aux_meta["params"], aux):
                 i = pidx[id(p)]
                 new_params[i] = v.astype(new_params[i].dtype)
-            import jax.numpy as jnp
-
             if guard_skip:
                 # non-finite guard fused into the step: a NaN/Inf loss
+                # (or, under loss scaling, an overflowed gradient)
                 # selects the PREVIOUS params/opt-state/moving-stats, so
-                # one poisoned batch cannot corrupt training state (the
-                # building block for loss-scale backoff) — no extra host
-                # sync, just a per-buffer select XLA folds into the
-                # update
-                keep = jnp.isfinite(loss)
+                # one poisoned batch or scaled overflow cannot corrupt
+                # training state — no extra host sync, just a
+                # per-buffer select XLA folds into the update
                 new_params = [jnp.where(keep, n, o)
                               for n, o in zip(new_params, param_arrays)]
-                new_state = jax.tree_util.tree_map(
-                    lambda n, o: jnp.where(keep, n, o), new_state,
-                    opt_state)
+                new_base = jax.tree_util.tree_map(
+                    lambda n, o: jnp.where(keep, n, o), new_base,
+                    base_state)
+            if ls_active:
+                from .. import dtype_policy as _dtp
+
+                new_ls = _dtp.loss_scale_update(
+                    opt_state["loss_scale"], keep, ls_cfg)
+                new_state = {"base": new_base, "loss_scale": new_ls}
+            else:
+                new_state = new_base
             # device-resident metric accumulation (no host sync): the
             # vector is donated in/out, so across steps the running
-            # sums never leave HBM until a flush boundary
-            finite = jnp.isfinite(loss)
+            # sums never leave HBM until a flush boundary.  Under loss
+            # scaling "finite" means the whole step (loss AND unscaled
+            # grads) was finite, and the backoff slot counts skips.
+            finite = keep if ls_active else jnp.isfinite(loss)
+            one = jnp.ones((), jnp.float32)
+            zero = jnp.zeros((), jnp.float32)
             new_metrics = metrics + jnp.stack(
-                [jnp.where(finite, loss, 0.0), jnp.ones((), jnp.float32),
-                 jnp.where(finite, 0.0, 1.0), jnp.zeros((), jnp.float32)])
+                [jnp.where(finite, loss, 0.0), one,
+                 jnp.where(finite, 0.0, 1.0), zero, zero,
+                 jnp.where(finite, 0.0, 1.0) if ls_active else zero])
             new_metrics = new_metrics.at[_M_LAST].set(loss)
+            if ls_active:
+                new_metrics = new_metrics.at[_M_LS_SCALE].set(new_ls[0])
             return new_params, new_state, loss, new_metrics
 
         self._step_core = step
@@ -725,10 +868,17 @@ class ShardedTrainer:
             self._aot_fingerprint(guard_skip))
 
     def _aot_fingerprint(self, guard_skip):
-        return "remat=%s|fusion=%s|opt=%s|donate=%s|guard=%s" % (
+        from .. import dtype_policy as _dtp
+
+        # the dtype policy rides the AOT content hash: an f32-compiled
+        # executable can never be loaded under a bf16 policy (the cast
+        # plan already reshapes the HLO, but the explicit tag holds
+        # even for policies that happen to lower identically)
+        return "remat=%s|fusion=%s|opt=%s|donate=%s|guard=%s|dtype=%s" % (
             self._remat_policy or "",
             self._fusion if self._fusion is not None else "",
-            self._opt_name, self._donate, guard_skip)
+            self._opt_name, self._donate, guard_skip,
+            _dtp.policy_tag(self._dtype_policy))
 
     def _jit_and_wrap(self, fn, label, fp_extra):
         """jit (donated params/opt/metrics, outputs pinned to the input
@@ -752,12 +902,15 @@ class ShardedTrainer:
                 repl, repl)
         jitted = jax.jit(fn, donate_argnums=donate, **jit_kw)
         from .. import aot as _aot
+        from .. import dtype_policy as _dtp
 
         store = _aot.resolve_aot(self._aot)
         if store is not None:
             jitted = _aot.AOTFunction(
                 jitted, label, store, fingerprint_extra=fp_extra,
-                manifest_kind="trainer", manifest_spec=self._aot_spec)
+                manifest_kind="trainer", manifest_spec=self._aot_spec,
+                manifest_extra={
+                    "dtype_policy": _dtp.policy_tag(self._dtype_policy)})
         return jitted
 
     def _build_k(self, n_inputs):
@@ -796,7 +949,8 @@ class ShardedTrainer:
 
         self._step_k_fn = self._jit_and_wrap(
             step_k, "sharded_step_k:%s" % self.net.name,
-            self._aot_fingerprint(self._on_nonfinite == "skip")
+            self._aot_fingerprint(self._on_nonfinite == "skip"
+                                  or self._ls_active)
             + "|k=%d" % K)
 
     def step(self, inputs, label):
@@ -1066,6 +1220,41 @@ class ShardedTrainer:
         nonfinite = int(host[_M_NONFINITE])
         if tel:
             _telemetry.TRAIN_LOSS.set(float(host[_M_LAST]))
+        if self._ls_active:
+            # loss-scaling mode: a scaled overflow is ROUTINE — the
+            # update was already discarded in-graph and the scale
+            # backed off, so it is counted (skip semantics), not
+            # warned or raised through the non-finite policy.
+            backoffs = int(host[_M_LS_BACKOFF])
+            scale_now = float(host[_M_LS_SCALE])
+            if tel:
+                _telemetry.LOSS_SCALE.set(scale_now)
+            if backoffs:
+                self.skipped_steps += backoffs
+                if tel:
+                    _telemetry.LOSS_SCALE_BACKOFFS.inc(backoffs)
+                    _telemetry.TRAIN_SKIPPED_STEPS.inc(backoffs,
+                                                       loop="sharded")
+                if scale_now <= 1.0 and \
+                        self._on_nonfinite in ("warn", "raise"):
+                    # the scale has bottomed out at its floor and steps
+                    # STILL overflow: this is a genuinely poisoned run
+                    # (NaN data / diverged model), not a routine scaled
+                    # overflow — honor the caller's non-finite policy
+                    # instead of silently skipping forever
+                    from .. import checkpoint as _ckpt
+
+                    what = ("loss/gradients (%d of %d steps ending at "
+                            "step %d; loss scale at floor %.1f)"
+                            % (backoffs, n, step, scale_now))
+                    try:
+                        _ckpt.check_finite(np.float32(np.nan),
+                                           self._on_nonfinite, what=what)
+                    except Exception as e:  # NonfiniteError ("raise")
+                        if not async_mode:
+                            raise
+                        self._pending_exc = e
+            return
         if self._on_nonfinite != "off" and nonfinite:
             from .. import checkpoint as _ckpt
 
@@ -1162,6 +1351,9 @@ class ShardedTrainer:
         if tel:
             for ax, op, b in self._collective_plan:
                 _telemetry.COLLECTIVE_BYTES.inc(b * n, axis=ax, op=op)
+            if self._cast_bytes:
+                _telemetry.DTYPE_CAST_BYTES.inc(
+                    self._cast_bytes * n, policy=self.dtype_policy_tag)
             dt = _time.perf_counter() - t_step0
             _telemetry.TRAIN_STEP_SECONDS.observe(dt / n, loop="sharded")
             _telemetry.TRAIN_STEPS.inc(n, loop="sharded")
@@ -1294,7 +1486,10 @@ class ShardedTrainer:
                 # shape resplits them (reshard-on-load; _apply_restore
                 # detects and counts the topology change)
                 "mesh_axes": self.mesh_shape,
-                "layout": self.layout_name}
+                "layout": self.layout_name,
+                # the precision recipe the state was trained under (the
+                # loss-scale leaf rides the opt:* arrays when active)
+                "dtype_policy": self.dtype_policy_tag}
         if self._layout_res is not None:
             meta["param_specs"] = self._layout_res.spec_strings()
         return (int(gstep) if step is None else int(step)), arrays, {}, meta

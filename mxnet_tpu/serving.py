@@ -69,16 +69,45 @@ class Predictor:
 
     def __init__(self, forward, params, chain=8, preprocess=None,
                  postprocess=None, batch_shape=None, batch_dtype=None,
-                 device=None, aot=None, aot_spec=None):
+                 device=None, aot=None, aot_spec=None, dtype_policy=None,
+                 param_names=None, aot_policy_tag=None):
         import jax
         from jax import lax
 
         from . import aot as _aot
+        from . import dtype_policy as _dtp
 
         assert chain >= 1
         self._chain = int(chain)
         self._preprocess = preprocess
         self._postprocess = postprocess
+        # mixed-precision dtype policy (None defers to
+        # MXNET_DTYPE_POLICY): params cast to the compute dtype inside
+        # the compiled program (per override rule when ``param_names``
+        # names the leaves — from_block/from_symbol wire them), ops
+        # harmonize to the weight dtype, floating outputs cast back at
+        # the boundary.  Params stay committed in storage dtype — the
+        # cast fuses into the first consumer on device.
+        dt_policy = _dtp.resolve_policy(dtype_policy)
+        self._dtype_policy = dt_policy
+        self._param_names = list(param_names) if param_names else None
+        _dtp.note_policy(dt_policy, "predictor")
+
+        def _cast_param_tree(tree):
+            if dt_policy is None:
+                return tree
+            if isinstance(tree, dict):
+                return {n: dt_policy.cast_compute(n, a)
+                        for n, a in tree.items()}
+            if self._param_names is not None and \
+                    isinstance(tree, (list, tuple)) and \
+                    len(tree) == len(self._param_names):
+                return tuple(dt_policy.cast_compute(n, a)
+                             for n, a in zip(self._param_names, tree))
+            # anonymous pytree: blanket compute cast on floating leaves
+            return jax.tree_util.tree_map(
+                lambda a: a.astype(dt_policy.compute_dtype)
+                if _dtp._is_float(a.dtype) else a, tree)
         # commit every param to the device ONCE: host-resident params
         # would re-upload per call, paying the tunnel's per-transfer
         # latency for each tensor on every dispatch.  ``device`` pins
@@ -90,9 +119,14 @@ class Predictor:
         jax.block_until_ready(self._params)
 
         def one(x, params_):
+            from . import dtype_policy as _dtp_mod
+
             if preprocess is not None:
                 x = preprocess(x)
-            out = forward(x, params_)
+            with _dtp_mod.scope(dt_policy):
+                out = forward(x, _cast_param_tree(params_))
+            if dt_policy is not None:
+                out = dt_policy.cast_output(out)
             if postprocess is not None:
                 # device-side output reduction (e.g. top-k for a
                 # classify API): shrinks the device->host fetch from
@@ -126,12 +160,23 @@ class Predictor:
         self._aot_spec = aot_spec
         store = _aot.resolve_aot(aot)
         if store is not None:
+            # the dtype-policy tag rides the content hash AND the
+            # manifest: an f32-compiled executable can never be served
+            # under a bf16 (or int8) policy — key separation by
+            # construction
+            # aot_policy_tag overrides for graph-level precision the
+            # cast policy cannot express (the int8 quantize rewrite)
+            dtag = aot_policy_tag or _dtp.policy_tag(dt_policy)
+            fp = "dtype=%s" % dtag
+            mext = {"dtype_policy": dtag}
             self._jit_one = _aot.AOTFunction(
                 self._jit_one, "predictor:one", store,
-                manifest_kind="predictor", manifest_spec=aot_spec)
+                fingerprint_extra=fp, manifest_kind="predictor",
+                manifest_spec=aot_spec, manifest_extra=mext)
             self._jit_chain = _aot.AOTFunction(
                 self._jit_chain, "predictor:chain", store,
-                manifest_kind="predictor", manifest_spec=aot_spec)
+                fingerprint_extra=fp, manifest_kind="predictor",
+                manifest_spec=aot_spec, manifest_extra=mext)
         # serving batch contract.  Pass batch_shape (or build via
         # from_block, which seeds it from the example input) so a
         # ragged FIRST request pads up to the intended size; with
@@ -196,7 +241,7 @@ class Predictor:
     @classmethod
     def from_block(cls, net, example_input, chain=8, preprocess=None,
                    postprocess=None, device=None, aot=None,
-                   aot_spec=None):
+                   aot_spec=None, dtype_policy=None):
         """Build from a gluon HybridBlock: traces the block's forward the
         same way CachedOp does (moving stats frozen — inference).
 
@@ -240,8 +285,55 @@ class Predictor:
                    preprocess=preprocess, postprocess=postprocess,
                    batch_shape=tuple(x_nd.shape),
                    batch_dtype=np.dtype(x_nd.dtype), device=device,
-                   aot=aot, aot_spec=aot_spec)
+                   aot=aot, aot_spec=aot_spec, dtype_policy=dtype_policy,
+                   param_names=[p.name for p in params])
         return pred, jnp.asarray(x_nd._data)
+
+    @classmethod
+    def from_symbol(cls, sym, arg_params, aux_params=None,
+                    data_name="data", chain=8, preprocess=None,
+                    postprocess=None, batch_shape=None, batch_dtype=None,
+                    device=None, aot=None, aot_spec=None,
+                    dtype_policy=None, aot_policy_tag=None):
+        """Build from a symbolic model: the whole graph evaluates as one
+        pure fn over named arrays, params committed to the device once.
+
+        This is the serving entry point for graph-rewritten models that
+        have no gluon block — most importantly the int8 artifacts
+        ``tools/quantize_model.py`` emits (quantized symbol + int8
+        weight params + range scalars; see
+        ``contrib.quantization.load_artifact``).  ``arg_params`` /
+        ``aux_params`` take NDArray or raw arrays; ``data_name`` is the
+        one free data variable fed per batch.
+        """
+        from .ndarray.ndarray import NDArray
+
+        if aot_policy_tag is not None and dtype_policy is None:
+            # graph-level precision (the int8 quantize rewrite): the
+            # artifact's numerics were validated by the accuracy gate
+            # EXACTLY as stored — pin the cast policy OFF so an
+            # ambient MXNET_DTYPE_POLICY cannot re-cast range scalars
+            # or the excluded-fp32 layers of a gated artifact
+            dtype_policy = "f32"
+        fn, _, _ = sym._build_fn()
+        params = {}
+        for src in (arg_params or {}), (aux_params or {}):
+            for n, a in src.items():
+                if n == data_name:
+                    continue
+                params[n] = a._data if isinstance(a, NDArray) else a
+
+        def forward(x, params_):
+            values = dict(params_)
+            values[data_name] = x
+            outs, _aux = fn(values, is_train=False)
+            return outs[0]
+
+        return cls(forward, params, chain=chain, preprocess=preprocess,
+                   postprocess=postprocess, batch_shape=batch_shape,
+                   batch_dtype=batch_dtype, device=device, aot=aot,
+                   aot_spec=aot_spec, dtype_policy=dtype_policy,
+                   aot_policy_tag=aot_policy_tag)
 
     def _upload(self, b, request_id=None):
         """Async host->device transfer of one raw batch.

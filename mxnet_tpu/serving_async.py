@@ -423,7 +423,7 @@ class AsyncPredictor:
     @classmethod
     def from_block(cls, net, example_input, replicas=1, chain=8,
                    preprocess=None, postprocess=None, aot=None,
-                   aot_spec=None, **kwargs):
+                   aot_spec=None, dtype_policy=None, **kwargs):
         """Build ``replicas`` Predictor replicas from a gluon block,
         placed round-robin over the mesh devices (one per device when
         ``replicas`` <= device count), and wrap them.  The same builder
@@ -443,7 +443,7 @@ class AsyncPredictor:
             pred, _ = Predictor.from_block(
                 net, example_input, chain=chain, preprocess=preprocess,
                 postprocess=postprocess, device=devs[i % len(devs)],
-                aot=aot, aot_spec=aot_spec)
+                aot=aot, aot_spec=aot_spec, dtype_policy=dtype_policy)
             return pred
 
         preds = [build() for _ in range(int(replicas))]

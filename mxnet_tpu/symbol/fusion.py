@@ -777,7 +777,7 @@ register_pattern(FusionPattern(
 
 
 def microbench(pattern_name, shape, iters=20, warmup=3, grad=True,
-               rng=None, repeats=5):
+               rng=None, repeats=5, dtype="float32"):
     """Measure one pattern's canonical chain fused vs unfused at
     ``shape`` on the current backend.
 
@@ -803,6 +803,10 @@ def microbench(pattern_name, shape, iters=20, warmup=3, grad=True,
     if pattern.bench_builder is None:
         raise MXNetError("pattern %r has no bench_builder" % pattern_name)
     rng = rng or np.random.RandomState(0)
+    # measurement dtype (tools/autotune.py --dtype-policy): operands are
+    # bound in this dtype, and the emitted table key carries its tag —
+    # bf16 measurements can never be reused for f32 sites or vice versa
+    dt = np.dtype(dtype)
     chain, feeds = pattern.bench_builder(tuple(shape))
     loss = S._invoke_sym("sum", [chain], {}, name="loss")
     fused_sym, fired = apply_fusion(loss, pattern_name)
@@ -817,15 +821,13 @@ def microbench(pattern_name, shape, iters=20, warmup=3, grad=True,
         import jax.numpy as jnp
 
         for n, a in exe.arg_dict.items():
-            if n in vals:
-                a._rebind(jnp.asarray(vals[n]))
-            else:
+            if n not in vals:
                 vals[n] = rng.rand(*a.shape).astype(np.float32) + 0.5
-                a._rebind(jnp.asarray(vals[n]))
+            a._rebind(jnp.asarray(vals[n]).astype(dt))
         for n, a in exe.aux_dict.items():
             v = vals.setdefault(
                 n, rng.rand(*a.shape).astype(np.float32) + 0.5)
-            a._rebind(jnp.asarray(v))
+            a._rebind(jnp.asarray(v).astype(dt))
         return exe
 
     def fwd_block(exe, n):
@@ -876,7 +878,7 @@ def microbench(pattern_name, shape, iters=20, warmup=3, grad=True,
     # the table key MUST be derived through the same site_key path the
     # bind-time gate uses (axis suffix and all), so tuned entries hit
     sites = pattern.plan(loss)
-    known = {n: (s, np.float32) for n, s in feeds.items()}
+    known = {n: (s, dt) for n, s in feeds.items()}
     structs = _node_structs(loss, known)
     keys = {pattern.site_key(s, structs) for s in sites.values()}
     keys.discard(None)

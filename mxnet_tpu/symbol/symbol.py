@@ -470,7 +470,7 @@ class Symbol:
     def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
                     stype_dict=None, group2ctx=None, shared_arg_names=None,
                     shared_exec=None, shared_buffer=None, remat_policy=None,
-                    fusion=None, aot=None, **kwargs):
+                    fusion=None, aot=None, dtype_policy=None, **kwargs):
         from ..executor import Executor
         from ..ndarray.ndarray import zeros as nd_zeros
         from ..context import current_context
@@ -492,11 +492,11 @@ class Symbol:
                for n, s in zip(self.list_auxiliary_states(), aux_shapes)}
         return Executor(self, ctx, args, args_grad, grad_req, aux,
                         shared_exec=shared_exec, remat_policy=remat_policy,
-                        fusion=fusion, aot=aot)
+                        fusion=fusion, aot=aot, dtype_policy=dtype_policy)
 
     def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
              aux_states=None, group2ctx=None, shared_exec=None,
-             remat_policy=None, fusion=None, aot=None):
+             remat_policy=None, fusion=None, aot=None, dtype_policy=None):
         from ..executor import Executor
 
         arg_names = self.list_arguments()
@@ -509,7 +509,8 @@ class Symbol:
             aux_states = dict(zip(aux_names, aux_states))
         return Executor(self, ctx, args or {}, args_grad or {}, grad_req,
                         aux_states or {}, shared_exec=shared_exec,
-                        remat_policy=remat_policy, fusion=fusion, aot=aot)
+                        remat_policy=remat_policy, fusion=fusion, aot=aot,
+                        dtype_policy=dtype_policy)
 
     # gradient: returns symbolic grad graph — TPU-native answer is vjp at
     # executor level; provided for API parity on simple cases.

@@ -542,6 +542,29 @@ CHECKPOINT_RESHARDS = counter(
     "Checkpoint restores whose saved mesh topology/layout differed "
     "from the restoring trainer's (arrays were resplit onto the new "
     "topology on load — elastic resume).")
+# mixed precision (dtype_policy.py; see docs/mixed_precision.md)
+DTYPE_POLICY_INFO = gauge(
+    "mxnet_tpu_dtype_policy_info",
+    "Constant-1 info gauge for the dtype policy active at each build "
+    "site (trainer/executor/cachedop/predictor): the label carries the "
+    "policy tag, so a scrape shows which precision recipe every "
+    "compiled program was built under.", ("policy", "where"))
+LOSS_SCALE = gauge(
+    "mxnet_tpu_loss_scale",
+    "Current dynamic loss scale of the training run (device-resident; "
+    "under MXNET_ASYNC_METRICS the value is from the last completed "
+    "background fetch).")
+LOSS_SCALE_BACKOFFS = counter(
+    "mxnet_tpu_loss_scale_backoffs_total",
+    "Scaled-overflow steps: the update was discarded in-graph (the "
+    "non-finite select), the loss scale multiplied by "
+    "MXNET_LOSS_SCALE_BACKOFF, and the finite-step streak reset.")
+DTYPE_CAST_BYTES = counter(
+    "mxnet_tpu_dtype_cast_bytes_total",
+    "Parameter bytes cast to the policy compute dtype per train step "
+    "(host-side accounting from array sizes: the per-step cast traffic "
+    "a dtype policy adds, fused by XLA into the first consumer).",
+    ("policy",))
 FUSION_REWRITES = counter(
     "mxnet_tpu_fusion_rewrites_total",
     "Graph-fusion rewrites fired at bind/hybridize/trace time, by "

@@ -1,0 +1,443 @@
+"""Mixed precision as a first-class speed lever (ISSUE 11).
+
+Tier-1 guards for the dtype-policy tentpole:
+* bf16_mixed trains tiny_mlp AND the transformer-LM (fsdp_tp mesh) to
+  a loss trajectory within documented tolerance of f32, with master
+  params + optimizer state verifiably f32;
+* dynamic loss scaling ramps up on finite streaks and backs off on an
+  injected overflow, with the overflowed update discarded in-graph;
+* a checkpoint save/resume round-trip preserves the loss-scale state;
+* per-layer override rules fire by parameter name;
+* the AOT store holds DISTINCT entries per policy (cross-policy load
+  impossible by key construction) and every manifest row carries a
+  validated dtype_policy tag;
+* the int8 gate refuses a poisoned calibration batch and a gated
+  artifact serves end-to-end through Predictor.from_symbol;
+* calib_thresholds_kl raises a typed error naming the layer.
+
+Kept lean for the tier-1 budget: only tiny nets compile, policy/rule/
+key logic is tested without any compile.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, gluon, parallel
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu import dtype_policy as dtp
+from mxnet_tpu.testing import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+for p in (os.path.join(REPO, "tools"), os.path.join(REPO, "examples")):
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+LOSS_TOL = 0.02  # documented bf16-vs-f32 per-step tolerance (tiny nets)
+
+
+def _mlp_trainer(policy=None, optimizer="sgd", aot=None,
+                 aot_spec=None, on_nonfinite=None):
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(16, activation="relu"))
+        net.add(gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    return parallel.ShardedTrainer(
+        net, lambda o, l: loss_fn(o, l), mesh=None, optimizer=optimizer,
+        dtype_policy=policy, aot=aot, aot_spec=aot_spec,
+        on_nonfinite=on_nonfinite)
+
+
+def _batch(seed=0, n=8, dim=10, classes=4):
+    rng = np.random.RandomState(seed)
+    return (nd.array(rng.rand(n, dim).astype(np.float32)),
+            nd.array(rng.randint(0, classes, n).astype(np.float32)))
+
+
+# ---------------------------------------------------------------------------
+# registry / rules (no compiles)
+# ---------------------------------------------------------------------------
+
+def test_registry_resolution_and_env_default(monkeypatch):
+    assert {"f32", "bf16_mixed", "bf16_pure"} <= set(dtp.list_policies())
+    assert dtp.resolve_policy(None) is None  # '' env default = f32
+    assert dtp.resolve_policy("f32") is None
+    assert dtp.resolve_policy("bf16_mixed").name == "bf16_mixed"
+    monkeypatch.setenv("MXNET_DTYPE_POLICY", "bf16_mixed")
+    assert dtp.resolve_policy(None).name == "bf16_mixed"
+    monkeypatch.setenv("MXNET_DTYPE_POLICY", "bogus")
+    with pytest.raises(MXNetError, match="unknown dtype policy"):
+        dtp.resolve_policy(None)
+    assert dtp.policy_tag(None) == "f32"
+    assert dtp.policy_tag(dtp.get_policy("bf16_pure")) == "bf16_pure"
+
+
+def test_per_layer_override_rules_fire_by_name():
+    pol = dtp.get_policy("bf16_mixed")
+    bf16 = np.dtype("bfloat16")
+    f32 = np.dtype(np.float32)
+    # norm affine params + moving stats stay f32, BY RULE NAME
+    for name in ("batchnorm0_gamma", "layernorm3_beta",
+                 "batchnorm2_moving_mean", "batchnorm2_moving_var"):
+        assert pol.param_cast_dtype(name, (8,)) == f32, name
+        assert pol.rule_name(name, (8,)) == "norm_f32", name
+    # the loss head stays f32
+    assert pol.param_cast_dtype("head0_weight", (16, 8)) == f32
+    assert pol.rule_name("head0_weight", (16, 8)) == "head_f32"
+    # everything else computes bf16 (no rule fires)
+    assert pol.param_cast_dtype("dense0_weight", (16, 8)) == bf16
+    assert pol.rule_name("dense0_weight", (16, 8)) is None
+    # bf16_pure has no f32 islands
+    pure = dtp.get_policy("bf16_pure")
+    assert pure.param_cast_dtype("batchnorm0_gamma", (8,)) == bf16
+    # the audit description names the firing rule
+    desc = pol.describe([("batchnorm0_gamma", (8,)),
+                         ("dense0_weight", (16, 8))])
+    assert "norm_f32" in desc and "bfloat16" in desc
+
+
+def test_loss_scale_state_machine():
+    import jax.numpy as jnp
+
+    cfg = dtp.LossScaleConfig(init=1024.0, growth_interval=2,
+                              backoff=0.5, max_scale=4096.0)
+    s = jnp.asarray(dtp.init_loss_scale(cfg))
+    # two finite steps -> growth; streak resets
+    s = dtp.loss_scale_update(s, jnp.bool_(True), cfg)
+    s = dtp.loss_scale_update(s, jnp.bool_(True), cfg)
+    assert float(s[0]) == 2048.0 and float(s[1]) == 0.0
+    # overflow -> backoff, streak reset
+    s = dtp.loss_scale_update(s, jnp.bool_(False), cfg)
+    assert float(s[0]) == 1024.0 and float(s[1]) == 0.0
+    # growth saturates at max_scale
+    for _ in range(8):
+        s = dtp.loss_scale_update(s, jnp.bool_(True), cfg)
+    assert float(s[0]) == 4096.0
+    # backoff floors at 1.0
+    tiny = dtp.loss_scale_update(jnp.asarray([1.0, 0.0], jnp.float32),
+                                 jnp.bool_(False), cfg)
+    assert float(tiny[0]) == 1.0
+
+
+def test_harmonize_follows_weight_only_in_scope():
+    import jax.numpy as jnp
+
+    x = jnp.ones((2, 2), jnp.float32)
+    w = jnp.ones((2, 2), jnp.bfloat16)
+    assert dtp.harmonize(x, w).dtype == jnp.float32  # no scope: no-op
+    with dtp.scope(dtp.get_policy("bf16_mixed")):
+        assert dtp.harmonize(x, w).dtype == jnp.bfloat16
+        # non-float weights (int8 kernels) never harmonize
+        assert dtp.harmonize(x, jnp.ones((2, 2), jnp.int8)).dtype == \
+            jnp.float32
+
+
+# ---------------------------------------------------------------------------
+# training trajectories + loss scaling
+# ---------------------------------------------------------------------------
+
+def test_bf16_mixed_trajectory_tiny_mlp_and_master_f32():
+    import jax
+
+    x, y = _batch()
+    mx.random.seed(7)
+    t32 = _mlp_trainer(None)
+    l32 = [float(t32.step([x], y)) for _ in range(6)]
+    mx.random.seed(7)
+    tbf = _mlp_trainer("bf16_mixed")
+    lbf = [float(tbf.step([x], y)) for _ in range(6)]
+    for a, b in zip(l32, lbf):
+        assert abs(a - b) < LOSS_TOL, (l32, lbf)
+    # loss must still DECREASE under bf16 (not just track)
+    assert lbf[-1] < lbf[0]
+    # master params and optimizer state are verifiably f32
+    assert all(np.dtype(a.dtype) == np.float32 for a in tbf.param_arrays)
+    for leaf in jax.tree_util.tree_leaves(tbf.opt_state):
+        assert np.dtype(leaf.dtype) == np.float32
+    assert tbf.dtype_policy_tag == "bf16_mixed"
+    assert t32.dtype_policy_tag == "f32"
+
+
+def test_loss_scale_backoff_skips_in_graph():
+    x, y = _batch()
+    tr = _mlp_trainer("bf16_mixed")
+    tr.step([x], y)
+    before = [np.asarray(a).copy() for a in tr.param_arrays]
+    s0 = tr.loss_scale()
+    xp = nd.array(faults.poison_batch(x.asnumpy()))
+    loss = tr.step([xp], y)
+    tr.drain()
+    # the poisoned update was discarded by the in-graph select ...
+    assert not np.isfinite(float(loss))
+    for b, a in zip(before, tr.param_arrays):
+        np.testing.assert_array_equal(b, np.asarray(a))
+    # ... counted as a skip, and the scale backed off
+    assert tr.skipped_steps == 1
+    assert tr.loss_scale() == s0 * 0.5
+    # training continues (scale state is healthy)
+    out = float(tr.step([x], y))
+    assert np.isfinite(out)
+
+
+def test_loss_scale_rampup(monkeypatch):
+    monkeypatch.setenv("MXNET_LOSS_SCALE", "1024")
+    monkeypatch.setenv("MXNET_LOSS_SCALE_GROWTH_INTERVAL", "2")
+    x, y = _batch()
+    tr = _mlp_trainer("bf16_mixed")
+    assert tr.loss_scale() == 1024.0
+    for _ in range(4):
+        tr.step([x], y)
+    tr.drain()
+    assert tr.loss_scale() == 4096.0  # two growth events of 2 steps
+
+
+def test_checkpoint_roundtrip_preserves_loss_scale(tmp_path):
+    from mxnet_tpu.checkpoint import CheckpointManager
+
+    x, y = _batch()
+    mx.random.seed(3)
+    tr = _mlp_trainer("bf16_mixed", optimizer="adam")
+    tr.step([x], y)
+    xp = nd.array(faults.poison_batch(x.asnumpy()))
+    tr.step([xp], y)  # force a backoff so the scale is non-default
+    tr.drain()
+    s0 = tr.loss_scale()
+    assert s0 != dtp.LossScaleConfig().init
+    m = CheckpointManager(str(tmp_path), async_save=False)
+    step0 = tr.save_checkpoint(m)
+    m.wait()
+    mx.random.seed(3)
+    tr2 = _mlp_trainer("bf16_mixed", optimizer="adam")
+    tr2._lazy_init(example_inputs=[x._data])
+    tr2.restore_checkpoint(m.load())
+    assert tr2.loss_scale() == s0
+    assert tr2.global_step == step0
+    # restored trainer keeps training with the restored scale
+    tr2.step([x], y)
+    tr2.drain()
+
+
+def test_transformer_lm_fsdp_tp_bf16_trajectory():
+    import bench_lm
+
+    kw = dict(mesh="fsdp=2,tp=2", layout="fsdp_tp", vocab=64,
+              d_model=16, n_heads=2, n_layers=1, seq=8, batch=4)
+    mx.random.seed(11)
+    t32, tok, lab, _ = bench_lm.build_lm_trainer(dtype_policy=None, **kw)
+    xs, ys = t32.shard_batch(tok, lab)
+    l32 = [float(t32.step([xs], ys)) for _ in range(3)]
+    mx.random.seed(11)
+    tbf, tok, lab, _ = bench_lm.build_lm_trainer(
+        dtype_policy="bf16_mixed", **kw)
+    xs, ys = tbf.shard_batch(tok, lab)
+    lbf = [float(tbf.step([xs], ys)) for _ in range(3)]
+    for a, b in zip(l32, lbf):
+        assert abs(a - b) < 0.05, (l32, lbf)
+    assert all(np.dtype(a.dtype) == np.float32 for a in tbf.param_arrays)
+    assert tbf.layout_name == "fsdp_tp"
+    assert tbf.dtype_policy_tag == "bf16_mixed"
+
+
+def test_dtype_and_legacy_dtype_arg_conflict():
+    import jax.numpy as jnp
+
+    net = gluon.nn.Dense(2)
+    net.initialize(mx.init.Xavier())
+    with pytest.raises(MXNetError, match="not both"):
+        parallel.ShardedTrainer(net, lambda o, l: o.sum(),
+                                dtype=jnp.bfloat16,
+                                dtype_policy="bf16_mixed")
+
+
+# ---------------------------------------------------------------------------
+# AOT key separation + manifest policy tags
+# ---------------------------------------------------------------------------
+
+def test_aot_entries_distinct_per_policy(tmp_path):
+    from mxnet_tpu import aot as aot_mod
+    import prewarm as prewarm_cli
+
+    store = aot_mod.AOTStore(str(tmp_path))
+    x, y = _batch()
+    mx.random.seed(5)
+    t32 = _mlp_trainer(None, aot=store, aot_spec="tiny_mlp")
+    t32.step([x], y)
+    keys_f32 = {k for k, _m in store.entries()}
+    assert keys_f32
+    mx.random.seed(5)
+    tbf = _mlp_trainer("bf16_mixed", aot=store, aot_spec="tiny_mlp")
+    tbf.step([x], y)
+    keys_all = {k for k, _m in store.entries()}
+    # the bf16 policy landed NEW keys: cross-policy load is impossible
+    # by key construction
+    assert keys_all > keys_f32
+    entries, problems = store.manifest_entries()
+    assert not problems
+    tags = {e.get("dtype_policy") for e in entries}
+    assert tags == {"f32", "bf16_mixed"}
+    # prewarm --check validates the tags (rc 0 on this store) ...
+    ns = type("NS", (), {"store": str(tmp_path), "max_age_days": None})
+    assert prewarm_cli.run_check(ns) == 0
+    # ... a pre-policy row with NO tag is LEGACY (implied f32, rc 0) ...
+    with open(store.manifest_path(), "a") as f:
+        f.write(json.dumps({"kind": "trainer", "label": "legacy",
+                            "key": "0" * 64, "signature": []}) + "\n")
+    store._manifest_keys = None
+    assert prewarm_cli.run_check(ns) == 0
+    # ... and an UNKNOWN tag is rejected (wrong-precision prewarm)
+    with open(store.manifest_path(), "a") as f:
+        f.write(json.dumps({"kind": "trainer", "label": "rogue",
+                            "key": "1" * 64, "signature": [],
+                            "dtype_policy": "fp4_wishful"}) + "\n")
+    store._manifest_keys = None
+    assert prewarm_cli.run_check(ns) == 1
+
+
+# ---------------------------------------------------------------------------
+# inference front-ends
+# ---------------------------------------------------------------------------
+
+def test_executor_and_predictor_policy_boundaries():
+    from mxnet_tpu.serving import Predictor
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(4, 8).astype(np.float32)
+    data = mx.sym.var("data")
+    h = mx.sym.FullyConnected(data, num_hidden=16, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    out = mx.sym.FullyConnected(h, num_hidden=4, name="fc2")
+    args = {"data": nd.array(x),
+            "fc1_weight": nd.array(rng.randn(16, 8).astype(np.float32)),
+            "fc1_bias": nd.array(np.zeros(16, np.float32)),
+            "fc2_weight": nd.array(rng.randn(4, 16).astype(np.float32)),
+            "fc2_bias": nd.array(np.zeros(4, np.float32))}
+    r32 = out.bind(args=dict(args)).forward()[0].asnumpy()
+    rbf = out.bind(args=dict(args),
+                   dtype_policy="bf16_mixed").forward()[0].asnumpy()
+    # outputs cast back to f32 at the boundary, numerics bf16-close
+    assert rbf.dtype == np.float32
+    assert np.abs(rbf - r32).max() / np.abs(r32).max() < 0.03
+    # predictor: same contract through the serving tier
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(8, activation="relu"))
+        net.add(gluon.nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    p32, _ = Predictor.from_block(net, x, chain=1)
+    pbf, _ = Predictor.from_block(net, x, chain=1,
+                                  dtype_policy="bf16_mixed")
+    o32 = next(iter(p32.predict([x])))
+    obf = next(iter(pbf.predict([x])))
+    assert obf.dtype == np.float32
+    assert np.abs(o32 - obf).max() < 0.05
+
+
+# ---------------------------------------------------------------------------
+# int8: typed calib errors, gate refusal, end-to-end artifact serving
+# ---------------------------------------------------------------------------
+
+def test_calib_thresholds_kl_typed_errors():
+    from mxnet_tpu.contrib import quantization as q
+
+    with pytest.raises(MXNetError, match="empty calibration.*'fc3_out'"):
+        q.calib_thresholds_kl([], layer="fc3_out")
+    with pytest.raises(MXNetError, match="constant-zero.*'fc1_out'"):
+        q.calib_thresholds_kl(np.zeros(128, np.float32), layer="fc1_out")
+    with pytest.raises(MXNetError, match="non-finite.*'fc2_out'"):
+        q.calib_thresholds_kl(np.full(64, np.nan), layer="fc2_out")
+    # collector path names the layer too
+    c = q.LayerOutputCollector()
+    c.collect("lay0", nd.array(np.zeros((2, 4), np.float32)))
+    with pytest.raises(MXNetError, match="'lay0'"):
+        c.thresholds_kl()
+    # healthy data still yields a positive threshold (few bins: the
+    # full 8001-bin KL scan is a 15 s pure-python loop)
+    assert q.calib_thresholds_kl(
+        np.random.RandomState(0).rand(512), num_bins=401,
+        layer="ok") > 0
+
+
+def test_int8_gate_refuses_poisoned_calibration(tmp_path):
+    import quantize_model as qm
+
+    poison = tmp_path / "poison.npy"
+    np.save(str(poison), np.full((8, 16), np.nan, np.float32))
+    out = tmp_path / "art"
+    rc = qm.main(["--model", "mlp", "--out", str(out),
+                  "--calib", str(poison)])
+    assert rc == 3
+    assert not (out / "meta.json").exists()  # nothing was emitted
+
+
+def test_int8_artifact_end_to_end_serving(tmp_path):
+    import quantize_model as qm
+    from mxnet_tpu.contrib import quantization as q
+    from mxnet_tpu.serving import Predictor
+
+    out = str(tmp_path / "art")
+    assert qm.main(["--model", "mlp", "--out", out, "--seed", "1"]) == 0
+    assert q.check_artifact(out) == []
+    qsym, qargs, qaux, meta = q.load_artifact(out)
+    assert meta["dtype_policy"] == "int8"
+    assert meta["delta"] <= meta["max_delta"]
+    assert any(n.endswith("_weight_quantized") for n in qargs)
+    # serve end-to-end through the Predictor the async tier wraps
+    pred = Predictor.from_symbol(
+        qsym, qargs, qaux, chain=2,
+        batch_shape=tuple(meta["data_shape"]),
+        batch_dtype=meta["data_dtype"], aot_policy_tag="int8")
+    batch = np.random.RandomState(2).rand(
+        *meta["data_shape"]).astype(np.float32)
+    served = next(iter(pred.predict([batch])))
+    assert served.shape[0] == batch.shape[0]
+    assert np.all(np.isfinite(served))
+    # and it agrees with the fp32 graph within the gate's budget
+    sym, _shape = qm.build_mlp()
+    arg_p, aux_p = qm.init_params(sym, tuple(meta["data_shape"]), seed=1)
+    fp32_out = q._forward_symbol(sym, arg_p, aux_p, batch)
+    assert q.topk_agreement(fp32_out, served, meta["topk"]) >= \
+        1.0 - meta["max_delta"]
+    # --check on a damaged artifact is loud
+    (tmp_path / "art" / "meta.json").write_text("{not json")
+    assert qm.main(["--check", out]) == 1
+
+
+# ---------------------------------------------------------------------------
+# fusion cost table: dtype-tagged keys + legacy migration
+# ---------------------------------------------------------------------------
+
+def test_fusion_keys_carry_dtype_and_legacy_tables_migrate():
+    import jax.numpy as jnp
+
+    from mxnet_tpu import fusion_cost as fc
+
+    assert fc.shape_key("add_act", (32, 64), jnp.bfloat16) == \
+        "add_act|bf16|32x64"
+    assert fc.shape_key("add_act", (32, 64), np.float32) == \
+        "add_act|f32|32x64"
+    # bf16 and f32 sites NEVER share an entry
+    assert fc.shape_key("p", (8,), jnp.bfloat16) != \
+        fc.shape_key("p", (8,), np.float32)
+    entry = {"pattern": "add_act", "fused_ms": 1.0, "unfused_ms": 2.0,
+             "speedup": 2.0}
+    legacy = {"version": fc.TABLE_VERSION,
+              "entries": {"add_act|64x128": dict(entry)}}
+    problems, _stale = fc.validate_table(legacy)
+    assert any("missing its dtype component" in p for p in problems)
+    migrated, n = fc.migrate_legacy_table(legacy)
+    assert n == 1
+    assert "add_act|f32|64x128" in migrated["entries"]
+    problems, _stale = fc.validate_table(migrated)
+    assert not problems
+    # an explicit dtype-tagged entry outranks a colliding legacy one
+    both = {"version": fc.TABLE_VERSION,
+            "entries": {"add_act|64x128": dict(entry, speedup=9.0),
+                        "add_act|f32|64x128": dict(entry)}}
+    migrated, n = fc.migrate_legacy_table(both)
+    assert n == 0
+    assert migrated["entries"]["add_act|f32|64x128"]["speedup"] == 2.0

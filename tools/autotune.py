@@ -133,12 +133,39 @@ def profile_lm(args):
     return meta, hot, shapes
 
 
+def run_migrate(path, max_age_days):
+    """Rewrite a pre-dtype (legacy) table in place: every key gains the
+    f32 tag its measurements were taken under, then the migrated table
+    is re-validated."""
+    from mxnet_tpu import fusion_cost as fc
+
+    try:
+        with open(path) as f:
+            data = json.load(f)
+    except (OSError, ValueError) as e:
+        print("%s: cannot read (%s)" % (path, e), file=sys.stderr)
+        return 1
+    data, n = fc.migrate_legacy_table(data)
+    data.setdefault("dtype_policy", "f32")
+    fc.save_table(path, data)
+    log("migrated %d legacy key(s) in %s (assumed f32)" % (n, path))
+    return run_check(path, max_age_days)
+
+
 def run_tune(args):
     import mxnet_tpu  # noqa: F401  (backend init)
     import jax
 
+    from mxnet_tpu import dtype_policy as dtp
     from mxnet_tpu import fusion_cost as fc
     from mxnet_tpu.symbol import fusion as F
+
+    # measurement precision (--dtype-policy): operands bound in the
+    # policy's compute dtype, the policy tag stamped into the table
+    # meta, and every emitted key carrying the dtype tag — bf16
+    # measurements never reuse (or pollute) f32 entries
+    policy = dtp.resolve_policy(args.dtype_policy)
+    bench_dtype = str(policy.compute_dtype) if policy is not None         else "float32"
 
     hot = None
     if args.trace:
@@ -174,6 +201,8 @@ def run_tune(args):
             __import__("datetime").timezone.utc).isoformat(
                 timespec="seconds"),
         "iters": args.iters,
+        "dtype_policy": dtp.policy_tag(policy),
+        "bench_dtype": bench_dtype,
     })
     if hot:
         table.meta["trace_hot_ops"] = [
@@ -201,7 +230,8 @@ def run_tune(args):
                 continue
             try:
                 res = F.microbench(name, shape, iters=args.iters,
-                                   grad=not args.no_grad)
+                                   grad=not args.no_grad,
+                                   dtype=bench_dtype)
             except Exception as e:
                 log("skip %s @ %s: %s" % (name, shape, e))
                 continue
@@ -239,6 +269,15 @@ def main(argv=None):
     p.add_argument("--out", help="cost-table JSON to write (tuning mode)")
     p.add_argument("--check", metavar="TABLE",
                    help="validate a cost-table JSON instead of tuning")
+    p.add_argument("--migrate", metavar="TABLE",
+                   help="rewrite a pre-dtype (legacy) table in place: "
+                        "keys gain the f32 tag, then the table is "
+                        "re-validated")
+    p.add_argument("--dtype-policy", default=None,
+                   help="measure under this dtype policy's compute "
+                        "dtype (f32/bf16_mixed/bf16_pure; default: "
+                        "MXNET_DTYPE_POLICY) and stamp the tag into "
+                        "the table meta")
     p.add_argument("--trace", help="chrome-trace export to rank hot ops "
                                    "from (tracing.export_trace output)")
     p.add_argument("--lm", action="store_true",
@@ -264,6 +303,8 @@ def main(argv=None):
     args = p.parse_args(argv)
     if args.check:
         return run_check(args.check, args.max_age_days)
+    if args.migrate:
+        return run_migrate(args.migrate, args.max_age_days)
     if not args.out:
         p.error("--out is required in tuning mode (or use --check)")
     return run_tune(args)

@@ -47,7 +47,7 @@ def log(msg):
 
 def build_lm_trainer(mesh=None, layout=None, vocab=None, d_model=None,
                      n_heads=None, n_layers=None, seq=None, batch=None,
-                     optimizer="adam"):
+                     optimizer="adam", dtype_policy=None):
     """The LM benchmark-of-record configuration, shared with the tier-1
     smoke test (tests/test_sharding_layouts.py) so the committed BENCH
     numbers describe the exact program the suite guards.
@@ -69,13 +69,18 @@ def build_lm_trainer(mesh=None, layout=None, vocab=None, d_model=None,
     seq = seq or (512 if on_tpu else 32)
     batch = batch or (32 if on_tpu else 8)
 
+    # precision: explicit dtype_policy= wins; default is the mixed
+    # recipe on the chip (supersedes the old blanket bf16 cast, which
+    # also bf16-rounded the f32 token-id carriers) and f32 on CPU
+    if dtype_policy is None:
+        dtype_policy = os.environ.get("BENCH_DTYPE_POLICY") or             ("bf16_mixed" if on_tpu else None)
     lm = TransformerLM(vocab_size=vocab, d_model=d_model, n_heads=n_heads,
                        n_layers=n_layers, max_len=max(seq, 64))
     lm.initialize(mx.init.Xavier())
     trainer = parallel.ShardedTrainer(
         lm, lm_loss_fn(vocab), mesh=mesh, layout=layout,
         optimizer=optimizer, optimizer_params={"learning_rate": 1e-3},
-        dtype=jax.numpy.bfloat16 if on_tpu else None)
+        dtype_policy=dtype_policy)
     rng = np.random.RandomState(0)
     tokens = nd.array(rng.randint(0, vocab, (batch, seq))
                       .astype(np.float32))
@@ -88,7 +93,7 @@ def build_lm_trainer(mesh=None, layout=None, vocab=None, d_model=None,
 
 
 def run(mesh=None, layout=None, steps=20, warmup=2, steps_per_call=None,
-        trace_out=None, **model_kw):
+        trace_out=None, dtype_compare=False, **model_kw):
     import jax
 
     from mxnet_tpu import telemetry, tracing
@@ -190,7 +195,38 @@ def run(mesh=None, layout=None, steps=20, warmup=2, steps_per_call=None,
         "batch": cfg["batch"],
         "seq_len": cfg["seq"],
         "warmup_step_seconds": warmup_step_secs,
+        # precision attribution (docs/mixed_precision.md)
+        "dtype_policy": trainer.dtype_policy_tag,
+        "loss_scale": trainer.loss_scale(),
+        "loss_scale_backoffs": trainer.skipped_steps
+        if trainer.dtype_policy is not None
+        and trainer.dtype_policy.loss_scaling else None,
     }
+    if dtype_compare:
+        # one short synchronous phase per policy on a fresh trainer:
+        # the f32-vs-bf16 A/B the on-chip payoff sweep flips on
+        comp = {}
+        mk = {k: v for k, v in model_kw.items() if k != "dtype_policy"}
+        for pol in ("f32", "bf16_mixed"):
+            t2, tok2, lab2, c2 = build_lm_trainer(
+                mesh=mesh, layout=layout, dtype_policy=pol, **mk)
+            x2, y2 = t2.shard_batch(tok2, lab2)
+            loss2 = t2.step([x2], y2)
+            jax.block_until_ready(loss2)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                loss2 = t2.step([x2], y2)
+            jax.block_until_ready(loss2)
+            dt2 = time.perf_counter() - t0
+            t2.drain()
+            comp[t2.dtype_policy_tag] = {
+                "tokens_per_sec": round(
+                    c2["batch"] * c2["seq"] * steps / dt2, 2),
+                "loss_scale": t2.loss_scale(),
+            }
+            log("[dtype %s] %d steps in %.3fs"
+                % (t2.dtype_policy_tag, steps, dt2))
+        result["dtype_compare"] = comp
     if trace_out:
         tracing.export_trace(trace_out)
         log("unified trace written to %s" % trace_out)
@@ -210,6 +246,13 @@ def main(argv=None):
     p.add_argument("--steps-per-call", type=int, default=None,
                    help="K for the fused-loop phase (default: 4 on "
                         "TPU, 2 on the CPU harness)")
+    p.add_argument("--dtype-policy", default=None,
+                   help="mixed-precision dtype policy for the measured "
+                        "trainer (f32/bf16_mixed/bf16_pure; default: "
+                        "BENCH_DTYPE_POLICY, else bf16_mixed on TPU)")
+    p.add_argument("--dtype-compare", action="store_true",
+                   help="also measure one short f32 AND bf16_mixed "
+                        "phase (fresh trainers) and emit dtype_compare")
     p.add_argument("--trace-out", default=None,
                    help="write the measured run's unified chrome trace "
                         "here (tools/autotune.py --lm consumes it)")
@@ -222,9 +265,10 @@ def main(argv=None):
     a = p.parse_args(argv)
     result = run(mesh=a.mesh, layout=a.layout, steps=a.steps,
                  warmup=a.warmup, steps_per_call=a.steps_per_call,
-                 trace_out=a.trace_out, vocab=a.vocab, d_model=a.d_model,
+                 trace_out=a.trace_out, dtype_compare=a.dtype_compare,
+                 vocab=a.vocab, d_model=a.d_model,
                  n_heads=a.n_heads, n_layers=a.n_layers, seq=a.seq,
-                 batch=a.batch)
+                 batch=a.batch, dtype_policy=a.dtype_policy)
     print(json.dumps(result))
     return 0
 

@@ -29,6 +29,7 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))  # quantize_model (int8 spec)
 
 # jax 0.4.x XLA:CPU splits large modules across parallel-codegen object
 # files and executable serialization only captures the entry module — a
@@ -65,7 +66,7 @@ def model(name, doc):
 
 @model("tiny_mlp", "2-layer MLP trainer + predictor at toy shapes "
                    "(seconds; exercises every path — used by the tests)")
-def _tiny_mlp(store, batch=None):
+def _tiny_mlp(store, batch=None, dtype_policy=None):
     import numpy as np
 
     import mxnet_tpu as mx
@@ -81,32 +82,33 @@ def _tiny_mlp(store, batch=None):
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
     trainer = parallel.ShardedTrainer(
         net, lambda o, l: loss_fn(o, l), mesh=None, optimizer="sgd",
-        aot=store, aot_spec="tiny_mlp")
+        aot=store, aot_spec="tiny_mlp", dtype_policy=dtype_policy)
     x = nd.array(np.zeros((batch, 16), np.float32))
     y = nd.array(np.zeros((batch,), np.float32))
     yield trainer.prewarm([x], y)
     pred, _ = Predictor.from_block(net, np.zeros((batch, 16), np.float32),
                                    chain=2, aot=store,
-                                   aot_spec="tiny_mlp")
+                                   aot_spec="tiny_mlp",
+                                   dtype_policy=dtype_policy)
     for info in pred.prewarm():
         yield info
 
 
 @model("bench_resnet50", "the bench.py trainer-of-record (ResNet-50 "
                          "bf16/fp32 fused step; BENCH_BATCH honored)")
-def _bench_resnet50(store, batch=None):
+def _bench_resnet50(store, batch=None, dtype_policy=None):
     import bench
 
     trainer, x, y, _b, _on_tpu = bench.build_trainer(
         batch=int(batch) if batch else None, aot=store,
-        aot_spec="bench_resnet50")
+        aot_spec="bench_resnet50", dtype_policy=dtype_policy)
     yield trainer.prewarm([x], y)
 
 
 @model("resnet18_serving", "ResNet-18 serving replica (Predictor "
                            "chain=2) — the CPU-measurable cold-start "
                            "probe for the serving tier")
-def _resnet18_serving(store, batch=None):
+def _resnet18_serving(store, batch=None, dtype_policy=None):
     import numpy as np
 
     import mxnet_tpu as mx
@@ -118,7 +120,8 @@ def _resnet18_serving(store, batch=None):
     net.initialize(mx.init.Xavier())
     x = np.zeros((batch, 3, 224, 224), np.float32)
     pred, _ = Predictor.from_block(net, x, chain=2, aot=store,
-                                   aot_spec="resnet18_serving")
+                                   aot_spec="resnet18_serving",
+                                   dtype_policy=dtype_policy)
     for info in pred.prewarm():
         yield info
 
@@ -126,7 +129,7 @@ def _resnet18_serving(store, batch=None):
 @model("resnet50_serving", "the serving tier of record (perf_notes "
                            "'Small-batch serving'): ResNet-50 bs32 "
                            "uint8 input, chain=8, device-side top-5")
-def _resnet50_serving(store, batch=None):
+def _resnet50_serving(store, batch=None, dtype_policy=None):
     import numpy as np
 
     import mxnet_tpu as mx
@@ -148,7 +151,49 @@ def _resnet50_serving(store, batch=None):
         else uint8_normalizer(dtype="float32")
     pred, _ = Predictor.from_block(
         net, x, chain=8, preprocess=prep,
-        postprocess=top5, aot=store, aot_spec="resnet50_serving")
+        postprocess=top5, aot=store, aot_spec="resnet50_serving",
+        dtype_policy=dtype_policy)
+    for info in pred.prewarm():
+        yield info
+
+
+@model("resnet50_serving_int8", "int8 variant of resnet50_serving: "
+                                "accuracy-gated quantize (BN fold + "
+                                "int8 rewrite) then prewarm the "
+                                "quantized executables — warm-pool "
+                                "replicas come up already quantized")
+def _resnet50_serving_int8(store, batch=None, dtype_policy=None):
+    import numpy as np
+
+    import quantize_model as qm
+    from mxnet_tpu.contrib import quantization as q
+    from mxnet_tpu.serving import Predictor
+
+    art = os.path.join(store.path, "quantized", "resnet50_serving_int8")
+    try:
+        # one load serves both validation and serving (a ResNet-50
+        # params blob is too big to deserialize twice on the cold path)
+        qsym, qargs, qaux, meta = q.load_artifact(art)
+    except Exception:
+        # no committed artifact (or a damaged one): rebuild through the
+        # gate.  A refused gate aborts the spec — a degraded int8
+        # replica must never be prewarmed into the fleet.
+        log("building gated int8 artifact at %s" % art)
+        sym, data_shape = qm.build_resnet50()
+        if batch:
+            data_shape = (int(batch),) + tuple(data_shape[1:])
+        arg_p, aux_p = qm.init_params(sym, data_shape)
+        calib = np.random.RandomState(1).rand(*data_shape) \
+            .astype(np.float32)
+        qsym, qargs, qaux, report = q.quantize_serving_artifact(
+            sym, arg_p, aux_p, calib, logger=log)
+        q.save_artifact(art, qsym, qargs, qaux, report)
+        meta = dict(report)
+    pred = Predictor.from_symbol(
+        qsym, qargs, qaux, data_name=meta.get("data_name", "data"),
+        chain=8, batch_shape=tuple(meta["data_shape"]),
+        batch_dtype=meta.get("data_dtype", "float32"), aot=store,
+        aot_spec="resnet50_serving_int8", aot_policy_tag="int8")
     for info in pred.prewarm():
         yield info
 
@@ -166,16 +211,18 @@ def _resolve_store(path):
     return aot.default_store()
 
 
-def _run_specs(store, specs, batch):
+def _run_specs(store, specs, batch, dtype_policy=None):
     infos = []
     for name in specs:
         if name not in MODELS:
             raise SystemExit(
                 "unknown model spec %r; registered: %s"
                 % (name, ", ".join(sorted(MODELS))))
-        log("building %s ..." % name)
+        log("building %s%s ..." % (name, " [dtype_policy=%s]"
+                                   % dtype_policy if dtype_policy else ""))
         t0 = time.perf_counter()
-        for info in MODELS[name](store, batch=batch):
+        for info in MODELS[name](store, batch=batch,
+                                 dtype_policy=dtype_policy):
             info = dict(info or {})
             info["spec"] = name
             infos.append(info)
@@ -192,7 +239,8 @@ def run_prewarm(args):
     store = _resolve_store(args.store)
     log("store: %s" % store.path)
     t0 = time.perf_counter()
-    infos = _run_specs(store, args.model, args.batch)
+    infos = _run_specs(store, args.model, args.batch,
+                       args.dtype_policy)
     total = time.perf_counter() - t0
     compiled = [i for i in infos if i.get("status") == "compiled"]
     hits = [i for i in infos if i.get("status") == "hit"]
@@ -231,20 +279,35 @@ def run_manifest(args):
             "MXNET_AOT=1 (or prewarm --model) to record signatures"
             % store.manifest_path())
     specs, unknown = [], []
+    # rebuild each (spec, dtype_policy) pair the manifest recorded: the
+    # policy tag is part of the AOT key, so replaying a bf16_mixed row
+    # under f32 would compile the WRONG executable and leave the
+    # promised one cold.  An explicit --dtype-policy overrides all rows
+    # (operator intent); the int8 spec carries its policy in the graph.
+    groups = []
     for e in entries:
         spec = e.get("spec")
         if spec and spec in MODELS:
+            pol = args.dtype_policy or e.get("dtype_policy") or None
+            if pol in ("f32", "int8"):
+                pol = None
             if spec not in specs:
                 specs.append(spec)
+            if (spec, pol) not in groups:
+                groups.append((spec, pol))
         else:
             unknown.append(e)
     for e in unknown:
         log("skip manifest entry %s (%s): spec %r is not in this "
             "CLI's registry — prewarm it from its own entry point"
             % (e.get("key", "?")[:12], e.get("label"), e.get("spec")))
-    infos = _run_specs(store, specs, args.batch)
+    infos = []
+    for spec, pol in groups:
+        infos += _run_specs(store, [spec], args.batch, pol)
     if args.json:
         print(json.dumps({"store": store.path, "specs": specs,
+                          "spec_policies": [[s, p or "f32"]
+                                            for s, p in groups],
                           "skipped": len(unknown),
                           "entries": infos}))
     if problems:
@@ -254,10 +317,34 @@ def run_manifest(args):
 
 
 def run_check(args):
+    from mxnet_tpu import dtype_policy as _dtp
+
     store = _resolve_store(args.store)
     problems, stale = store.check(max_age_days=args.max_age_days)
     entries = store.entries()
     manifest, _ = store.manifest_entries()
+    # every manifest signature must carry a recognized dtype-policy tag
+    # (a registered policy name, or "int8" for quantized artifacts): a
+    # wrong tag would prewarm the wrong executable.  Rows recorded
+    # BEFORE the tag existed were f32 by construction (current builds
+    # always stamp one) — reported as LEGACY, not fatal, so a store
+    # that was green yesterday stays green.
+    known_tags = set(_dtp.list_policies()) | {"int8"}
+    legacy = []
+    for e in manifest:
+        tag = e.get("dtype_policy")
+        if tag is None:
+            legacy.append(
+                "manifest entry %s (%s): no dtype_policy tag "
+                "(pre-policy row, implied f32) — re-record with a "
+                "current build to tag it"
+                % (e.get("key", "?")[:12], e.get("label")))
+        elif tag not in known_tags:
+            problems.append(
+                "manifest entry %s (%s): unknown dtype_policy %r "
+                "(known: %s)" % (e.get("key", "?")[:12],
+                                 e.get("label"), tag,
+                                 sorted(known_tags)))
     print("%s: %d executables, %d manifest signatures"
           % (store.path, len(entries), len(manifest)))
     for key, meta in entries:
@@ -267,6 +354,8 @@ def run_check(args):
                  meta.get("compile_seconds") or 0.0))
     for msg in stale:
         print("STALE: %s" % msg)
+    for msg in legacy:
+        print("LEGACY: %s" % msg)
     for msg in problems:
         print("MALFORMED: %s" % msg, file=sys.stderr)
     return 1 if problems else 0
@@ -288,6 +377,12 @@ def main(argv=None):
     p.add_argument("--check", action="store_true",
                    help="validate the store instead of compiling; "
                         "nonzero exit on a malformed store")
+    p.add_argument("--dtype-policy", default=None,
+                   help="mixed-precision dtype policy for the built "
+                        "specs (f32/bf16_mixed/bf16_pure; default: the "
+                        "MXNET_DTYPE_POLICY env default) — each policy "
+                        "compiles its own AOT entries, keyed apart by "
+                        "the policy tag")
     p.add_argument("--batch", type=int,
                    help="override the spec's batch size")
     p.add_argument("--json", action="store_true",
