@@ -9,8 +9,11 @@ one XLA program per step (fwd+bwd+update, donated buffers), bf16 compute
 with fp32 params — the TPU-native equivalent of the reference's
 Module + kvstore('device') training loop.
 
-Prints ONE JSON line on stdout: {"metric", "value", "unit",
-"vs_baseline"}.  Progress goes to stderr.
+Prints ONE ``BENCH {json}`` marker line on stdout (the schema-versioned
+record of mxnet_tpu/perf_ledger.py, appended to the MXNET_PERF_LEDGER
+run ledger when set): {"metric", "value", "unit", "vs_baseline", ...}
+plus provenance and the step-time ``attribution`` breakdown.  Progress
+goes to stderr.
 """
 import json
 import os
@@ -136,6 +139,25 @@ def _host_gap_p50():
     return telemetry.HOST_GAP_SECONDS.quantile(0.5, loop="sharded")
 
 
+def ledger_records(result):
+    """The run's perf_ledger record(s): the classic bench fields stay
+    top-level (r02-r05 continuity), the topology/precision fields are
+    ALSO stamped into provenance so every ledger row is comparable
+    without knowing this emitter's layout.  The tier-1 schema guard
+    calls this with a canned result."""
+    from mxnet_tpu import perf_ledger
+
+    prov = {"mesh_shape": result.get("mesh_shape"),
+            "layout": result.get("layout"),
+            "dtype_policy": result.get("dtype_policy"),
+            "steps_per_call": result.get("steps_per_call", 1)}
+    fields = {k: v for k, v in result.items()
+              if k not in ("metric", "value", "unit", "attribution")}
+    return [perf_ledger.make_record(
+        result["metric"], result["value"], result["unit"], prov=prov,
+        attribution=result.get("attribution"), **fields)]
+
+
 def run_dtype_compare(policies, steps):
     """BENCH_DTYPE_COMPARE=1: one short synchronous phase per dtype
     policy on a FRESH trainer each, so the headline number's precision
@@ -238,6 +260,12 @@ def main():
     dt_async = time.perf_counter() - t0
     ips_async = batch * calls * k / dt_async
     gap_async = _host_gap_p50()
+    # where did the milliseconds go, over the async (headline) phase:
+    # the attribution every ledger row carries so perf_gate can name
+    # the bucket that moved when the img/s number does
+    breakdown = trainer.step_breakdown()
+    if breakdown is not None:
+        log("\n" + breakdown.describe())
     log("[async] %d steps (%d fused calls of %d) in %.3fs (%.1f img/s)"
         % (calls * k, calls, k, dt_async, ips_async))
 
@@ -286,7 +314,12 @@ def main():
         result["cold_start_seconds"] = prewarm_info.get("cold_seconds")
         if warmup_step_secs:
             result["warm_start_seconds"] = warmup_step_secs[0]
-    print(json.dumps(result))
+    if breakdown is not None:
+        result["attribution"] = breakdown.as_dict()
+    from mxnet_tpu import perf_ledger
+
+    for rec in ledger_records(result):
+        perf_ledger.emit(rec)
 
 
 if __name__ == "__main__":

@@ -199,6 +199,19 @@ FLAGS = {
     "MXNET_TELEMETRY_INTERVAL": (
         "30", _pfloat, "honored",
         "TelemetryReporter default snapshot interval in seconds"),
+    "MXNET_TELEMETRY_PORT": (
+        "0", _pint, "honored",
+        "Prometheus HTTP scrape endpoint: serve telemetry.scrape() at "
+        "http://0.0.0.0:PORT/metrics with a /healthz readiness probe "
+        "for the process lifetime (telemetry.serve_scrape; 0 = off).  "
+        "Pair with MXNET_TELEMETRY=1 for non-zero series"),
+    "MXNET_PERF_LEDGER": (
+        "", str, "honored",
+        "append-only JSONL run ledger every bench emitter "
+        "(bench.py, tools/bench_*.py) writes its schema-versioned "
+        "BENCH records into via perf_ledger.emit — the queryable perf "
+        "history tools/perf_report.py and tools/perf_gate.py consume "
+        "('' = records print but nothing persists)"),
     "MXNET_PEAK_TFLOPS": (
         "", str, "honored",
         "accelerator peak TFLOP/s for the MFU gauge (overrides the "

@@ -26,6 +26,7 @@ from __future__ import annotations
 
 import queue as _queue
 import threading as _threading
+import time as _time
 
 from .. import telemetry as _telemetry
 from .. import tracing as _tracing
@@ -120,11 +121,18 @@ class DevicePrefetcher:
             kind, item = self._q.get_nowait()
         except _queue.Empty:
             # the train loop beat the pipeline to the handoff: the
-            # input path, not the chip, bounds this step
-            if _telemetry.enabled():
+            # input path, not the chip, bounds this step.  The blocked
+            # wall time is the data_wait attribution bucket
+            # (perf_ledger.StepBreakdown / the heartbeat line).
+            tel = _telemetry.enabled()
+            if tel:
                 _telemetry.PREFETCH_STALLS.inc()
             _tracing.instant("prefetch:stall")
+            t0 = _time.perf_counter() if tel else None
             kind, item = self._q.get()
+            if tel:
+                _telemetry.PREFETCH_WAIT_SECONDS.observe(
+                    _time.perf_counter() - t0)
         if kind == "err":
             self._done = True
             raise item

@@ -1297,6 +1297,20 @@ class ShardedTrainer:
         self._raise_pending()
         return self
 
+    def step_breakdown(self):
+        """Where did this trainer's step milliseconds go: a
+        :class:`~mxnet_tpu.perf_ledger.StepBreakdown` over the
+        telemetry window (since the last ``telemetry.reset()``) —
+        device_compute / compile / aot_load / data_wait / host_other
+        buckets that sum to the measured wall per step, plus the
+        per-axis collective payload.  Drains first so async-mode
+        metrics are complete.  Returns None when telemetry recorded no
+        steps (collection off, or no step since the last reset)."""
+        from .. import perf_ledger as _pl
+
+        self.drain()
+        return _pl.StepBreakdown.from_telemetry(loop="sharded")
+
     def close(self):
         """Release background resources: drain pending metric fetches
         and stop the fetch thread.  Safe to call repeatedly, and the

@@ -38,6 +38,7 @@ __all__ = ["Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
            "enabled", "enable", "disable", "counter", "gauge", "histogram",
            "span", "scrape", "dump", "collect", "reset",
            "TelemetryReporter", "set_peak_flops", "peak_flops",
+           "serve_scrape", "stop_scrape", "scrape_server",
            "DEFAULT_TIME_BUCKETS", "BATCH_SIZE_BUCKETS"]
 
 _enabled = False
@@ -509,6 +510,12 @@ PREFETCH_STALLS = counter(
     "Times the training loop reached io.DevicePrefetcher before a "
     "staged batch was ready (the input pipeline, not the chip, was "
     "the bottleneck for that step).")
+PREFETCH_WAIT_SECONDS = histogram(
+    "mxnet_tpu_device_prefetch_wait_seconds",
+    "Wall time the training loop spent blocked at the "
+    "io.DevicePrefetcher handoff waiting for the input pipeline "
+    "(observed only on stalls; the data_wait bucket of "
+    "perf_ledger.StepBreakdown and the heartbeat line).")
 TRAIN_STEP_FLOPS = gauge(
     "mxnet_tpu_train_step_flops",
     "XLA cost-analysis FLOPs of the compiled train step.")
@@ -821,6 +828,96 @@ def peak_flops():
 
 
 # ---------------------------------------------------------------------------
+# Prometheus HTTP scrape endpoint
+# ---------------------------------------------------------------------------
+
+_scrape_server = None
+_scrape_lock = threading.Lock()
+
+
+class _ScrapeServer:
+    """Background HTTP server exposing the registry.
+
+    Routes: ``/metrics`` (Prometheus text exposition, the
+    :func:`scrape` body) and ``/healthz`` (readiness probe: 200 "ok"
+    once the server thread accepts connections — the contract fleet
+    schedulers and the future network front end gate rollout on).
+    Everything else is 404.  Daemon threads; :meth:`stop` is
+    synchronous.
+    """
+
+    def __init__(self, port, host="0.0.0.0"):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server API)
+                path = self.path.split("?", 1)[0]
+                if path == "/metrics":
+                    body = scrape().encode("utf-8")
+                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                elif path == "/healthz":
+                    body = b"ok\n"
+                    ctype = "text/plain; charset=utf-8"
+                else:
+                    self.send_error(404, "unknown path %r" % path)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):
+                pass  # scrapes are periodic; stay out of training logs
+
+        self._httpd = ThreadingHTTPServer((host, int(port)), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="telemetry-scrape",
+            daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._thread.join()
+        self._httpd.server_close()
+
+
+def serve_scrape(port=None, host="0.0.0.0"):
+    """Start (or return the already-running) scrape endpoint.
+
+    ``port`` defaults to ``MXNET_TELEMETRY_PORT`` (0 = pick an
+    ephemeral port — tests; the chosen port is on the returned
+    server's ``.port``).  One server per process: a second call
+    returns the live one.  Serving does not by itself enable
+    collection — pair with ``MXNET_TELEMETRY=1`` / :func:`enable` for
+    non-zero numbers (the exposition itself is always valid)."""
+    global _scrape_server
+    with _scrape_lock:
+        if _scrape_server is not None:
+            return _scrape_server
+        if port is None:
+            port = _config.get("MXNET_TELEMETRY_PORT")
+        _scrape_server = _ScrapeServer(port, host=host)
+        return _scrape_server
+
+
+def stop_scrape():
+    """Stop the scrape endpoint (no-op when none is running)."""
+    global _scrape_server
+    with _scrape_lock:
+        srv, _scrape_server = _scrape_server, None
+    if srv is not None:
+        srv.stop()
+
+
+def scrape_server():
+    """The live :class:`_ScrapeServer`, or None."""
+    return _scrape_server
+
+
+# ---------------------------------------------------------------------------
 # background reporter
 # ---------------------------------------------------------------------------
 
@@ -898,3 +995,16 @@ class TelemetryReporter:
 
 if _config.get("MXNET_TELEMETRY"):
     enable()
+
+if _config.get("MXNET_TELEMETRY_PORT") > 0:
+    # env-configured scrape endpoint: up for the process lifetime (the
+    # /healthz probe must outlive any one trainer/predictor object);
+    # a port conflict warns instead of killing the training process
+    try:
+        serve_scrape()
+    except OSError as e:
+        import warnings
+
+        warnings.warn("MXNET_TELEMETRY_PORT=%s: scrape endpoint not "
+                      "started (%s)"
+                      % (_config.get("MXNET_TELEMETRY_PORT"), e))

@@ -320,7 +320,12 @@ def test_bench_lm_emits_tokens_per_sec_json(capsys):
         telemetry.reset()
     assert rc == 0
     out = capsys.readouterr().out.strip().splitlines()[-1]
-    rec = json.loads(out)
+    # the unambiguous emission contract: one BENCH-marked record line
+    from mxnet_tpu import perf_ledger
+
+    assert out.startswith(perf_ledger.BENCH_MARKER), out[:80]
+    rec = json.loads(out[len(perf_ledger.BENCH_MARKER):])
+    assert not perf_ledger.validate_record(rec)
     assert rec["metric"] == "transformer_lm_train_tokens_per_sec"
     assert rec["tokens_per_sec"] > 0
     assert rec["mesh_shape"] == {"dp": 2, "fsdp": 2, "tp": 2}

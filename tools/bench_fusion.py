@@ -4,11 +4,12 @@ pattern (symbol/fusion.py registry), BENCH-comparable output.
 For each pattern x shape the canonical chain (the same
 ``FusionPattern.bench_builder`` the autotuner and the tier-1 parity
 guard use) is bound twice — stock graph vs force-fused — and timed for
-forward (inference) and forward+backward (training).  One JSON line per
-measurement goes to stdout::
+forward (inference) and forward+backward (training).  One BENCH-marked
+perf_ledger record per measurement goes to stdout (and to the
+MXNET_PERF_LEDGER run ledger when set)::
 
-    {"metric": "fusion_layer_norm_fast_256x4096_train_speedup",
-     "value": 1.72, "unit": "x", ...}
+    BENCH {"metric": "fusion_layer_norm_fast_256x4096_train_speedup",
+           "value": 1.72, "unit": "x", ...}
 
 plus a headline ``fusion_best_speedup`` line — train-mode only (the
 acceptance gate: >=1.10 fwd+bwd on at least one elementwise chain).
@@ -34,6 +35,21 @@ def log(msg):
           file=sys.stderr, flush=True)
 
 
+def ledger_records(rows):
+    """perf_ledger record(s) for measured rows (each already carries
+    metric/value/unit).  The tier-1 schema guard calls this with a
+    canned row list."""
+    from mxnet_tpu import perf_ledger
+
+    recs = []
+    for row in rows:
+        fields = {k: v for k, v in row.items()
+                  if k not in ("metric", "value", "unit")}
+        recs.append(perf_ledger.make_record(
+            row["metric"], row["value"], row["unit"], **fields))
+    return recs
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         description="Measure fused-vs-unfused speedups per pattern/shape")
@@ -49,6 +65,7 @@ def main(argv=None):
     import jax
 
     import mxnet_tpu  # noqa: F401
+    from mxnet_tpu import perf_ledger
     from mxnet_tpu.symbol import fusion as F
 
     log("devices=%s" % (jax.devices(),))
@@ -87,7 +104,9 @@ def main(argv=None):
                 "key": res["key"],
             }
             rows.append(row)
-            print(json.dumps(row), flush=True)
+            # emit AS MEASURED: a killed mid-sweep run keeps every
+            # completed row on stdout and in the ledger
+            perf_ledger.emit(ledger_records([row])[0])
             # headline is TRAIN-ONLY: the acceptance gate is a
             # training-path win, an inference-only win must not pass it
             if best is None or res["speedup"] > best["value"]:
@@ -96,8 +115,8 @@ def main(argv=None):
                         "pattern": name, "mode": "train",
                         "shape": "x".join(str(d) for d in shape)}
     if best is not None:
-        print(json.dumps(best), flush=True)
         rows.append(best)
+        perf_ledger.emit(ledger_records([best])[0])
     if args.json:
         from mxnet_tpu.checkpoint import atomic_write
 

@@ -31,6 +31,22 @@ import mxnet_tpu as mx  # noqa: E402
 from mxnet_tpu import recordio  # noqa: E402
 
 
+def ledger_records(host_rate, e2e_rate, n_images, threads):
+    """perf_ledger record(s) for one run: the host pipeline rate and
+    the end-to-end (incl. device upload) rate — both must clear the
+    training consumption rate or the chip starves.  The tier-1 schema
+    guard calls this with canned rates."""
+    from mxnet_tpu import perf_ledger
+
+    fields = {"n_images": n_images, "threads": threads}
+    return [
+        perf_ledger.make_record("io_pipeline_host_img_s", host_rate,
+                                "images/sec", **fields),
+        perf_ledger.make_record("io_pipeline_e2e_img_s", e2e_rate,
+                                "images/sec", **fields),
+    ]
+
+
 def make_rec(path, n, size=224):
     rng = np.random.RandomState(0)
     w = recordio.MXIndexedRecordIO(path + ".idx", path + ".rec", "w")
@@ -86,6 +102,12 @@ def main():
         last.asnumpy()  # drain the async queue
         e2e_rate = imgs / (time.time() - t0)
         print("end-to-end w/ device upload: %.0f img/s" % e2e_rate)
+
+        from mxnet_tpu import perf_ledger
+
+        for rec in ledger_records(round(host_rate, 1),
+                                  round(e2e_rate, 1), n, threads):
+            perf_ledger.emit(rec)
 
 
 if __name__ == "__main__":

@@ -3,7 +3,9 @@
 The second hot-path profile next to bench.py's ResNet-50 (ROADMAP "New
 workload"): a decoder-only LM (examples/transformer_lm.py) trained by
 ShardedTrainer over a named dp x fsdp x tp mesh with a spec-rule layout
-(docs/sharding.md).  Emits ONE BENCH JSON line on stdout carrying
+(docs/sharding.md).  Emits ONE ``BENCH {json}`` marker line on stdout
+(a schema-versioned perf_ledger record, appended to the
+MXNET_PERF_LEDGER run ledger when set) carrying
 ``tokens_per_sec``, ``mfu`` (model-FLOPs accounting over the PR 4 peak
 gauge), and the ``mesh_shape``/``layout`` the number was measured under
 — so the perf trajectory is attributable to topology.  Since ISSUE 10
@@ -21,10 +23,9 @@ table.
     # real chip (defaults scale up on accelerator backends):
     python tools/bench_lm.py --mesh fsdp=4,tp=2
 
-Progress goes to stderr; stdout is the parsed JSON line only.
+Progress goes to stderr; stdout is the marked record line only.
 """
 import argparse
-import json
 import os
 import sys
 import time
@@ -43,6 +44,23 @@ _T0 = time.time()
 def log(msg):
     print("[bench_lm %6.1fs] %s" % (time.time() - _T0, msg),
           file=sys.stderr, flush=True)
+
+
+def ledger_records(result):
+    """perf_ledger record(s) for one bench_lm run: classic fields stay
+    top-level, topology/precision ALSO stamp provenance (the schema
+    guard test calls this with a canned result)."""
+    from mxnet_tpu import perf_ledger
+
+    prov = {"mesh_shape": result.get("mesh_shape"),
+            "layout": result.get("layout"),
+            "dtype_policy": result.get("dtype_policy"),
+            "steps_per_call": result.get("steps_per_call", 1)}
+    fields = {k: v for k, v in result.items()
+              if k not in ("metric", "value", "unit", "attribution")}
+    return [perf_ledger.make_record(
+        result["metric"], result["value"], result["unit"], prov=prov,
+        attribution=result.get("attribution"), **fields)]
 
 
 def build_lm_trainer(mesh=None, layout=None, vocab=None, d_model=None,
@@ -157,6 +175,11 @@ def run(mesh=None, layout=None, steps=20, warmup=2, steps_per_call=None,
     trainer.drain()
     dt_async = time.perf_counter() - t0
     gap_async = telemetry.HOST_GAP_SECONDS.quantile(0.5, loop="sharded")
+    # step-time attribution over the async (headline) phase — rides
+    # the BENCH record so perf_gate can name the moving bucket
+    breakdown = trainer.step_breakdown()
+    if breakdown is not None:
+        log("\n" + breakdown.describe())
     log("[async] %d steps (%d fused calls of %d) in %.3fs"
         % (calls * k, calls, k, dt_async))
 
@@ -202,6 +225,8 @@ def run(mesh=None, layout=None, steps=20, warmup=2, steps_per_call=None,
         if trainer.dtype_policy is not None
         and trainer.dtype_policy.loss_scaling else None,
     }
+    if breakdown is not None:
+        result["attribution"] = breakdown.as_dict()
     if dtype_compare:
         # one short synchronous phase per policy on a fresh trainer:
         # the f32-vs-bf16 A/B the on-chip payoff sweep flips on
@@ -269,7 +294,10 @@ def main(argv=None):
                  vocab=a.vocab, d_model=a.d_model,
                  n_heads=a.n_heads, n_layers=a.n_layers, seq=a.seq,
                  batch=a.batch, dtype_policy=a.dtype_policy)
-    print(json.dumps(result))
+    from mxnet_tpu import perf_ledger
+
+    for rec in ledger_records(result):
+        perf_ledger.emit(rec)
     return 0
 
 

@@ -50,6 +50,33 @@ from mxnet_tpu.serving_async import (AsyncPredictor,  # noqa: E402
                                      DeadlineExceeded, ServingError)
 
 
+def ledger_records(results):
+    """perf_ledger record(s) for one bench_serving run — the three
+    throughput modes of the default run, or one goodput record per
+    swept rate for ``--load`` results (detected by the ``sweep`` key).
+    The tier-1 schema guard calls this with canned results."""
+    from mxnet_tpu import perf_ledger
+
+    recs = []
+    if "sweep" in results:
+        meta = {k: v for k, v in results.items() if k != "sweep"}
+        for row in results["sweep"]:
+            fields = dict(meta)
+            fields.update(row)
+            recs.append(perf_ledger.make_record(
+                "serving_load_goodput_qps@%g" % row["target_qps"],
+                row["goodput_qps"], "qps", **fields))
+        return recs
+    for metric, key in (
+            ("resnet50_serving_host_uint8_img_s", "host_uint8_img_s"),
+            ("resnet50_serving_device_img_s", "device_resident_img_s"),
+            ("resnet50_serving_device_top5_img_s", "device_top5_img_s")):
+        if results.get(key) is not None:
+            recs.append(perf_ledger.make_record(
+                metric, results[key], "images/sec", **results))
+    return recs
+
+
 def measure_link_bw(shape, chain=8, reps=2):
     """Upload bandwidth in serving's own regime: a stream of ``chain``
     per-batch async device_puts, forced together by one host fetch."""
@@ -145,6 +172,11 @@ def run(batch=32, n_batches=32, chain=8, dtype="bfloat16", json_path=None):
     print("vs V100 fp16 anchor (%.0f): device %.2fx, host-fed %.2fx "
           "(tunnel-capped)" % (anchor, ips_dev / anchor, ips / anchor),
           flush=True)
+
+    from mxnet_tpu import perf_ledger
+
+    for rec in ledger_records(results):
+        perf_ledger.emit(rec)
 
     if json_path:
         with open(json_path, "w") as f:
@@ -258,7 +290,11 @@ def run_load(qps_list, duration=5.0, batch_rows=8, feat=16, rows=1,
                 else None,
             }
             out["sweep"].append(row)
-            print("BENCH_SERVING_LOAD " + json.dumps(row), flush=True)
+            from mxnet_tpu import perf_ledger
+
+            perf_ledger.emit(ledger_records(
+                {**{k: v for k, v in out.items() if k != "sweep"},
+                 "sweep": [row]})[0])
     finally:
         ap.close(timeout=30)
     if json_path:
