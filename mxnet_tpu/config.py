@@ -289,6 +289,35 @@ FLAGS = {
         "seconds between auto-heal canary probes of ejected "
         "AsyncPredictor replicas (0 = no probing): a probe dispatches "
         "one known-good batch and re-admits the replica on success"),
+    "MXNET_DECODE_SLOTS": (
+        "8", _pint, "honored",
+        "generate.GenerationEngine default decode batch slots: the "
+        "fixed-shape continuous-batching width of the compiled decode "
+        "step (one KV-cache lane per slot)"),
+    "MXNET_DECODE_CACHE_LEN": (
+        "256", _pint, "honored",
+        "default KV-cache ring length per slot (positions kept per "
+        "sequence; capped at the model's max_len).  Generation past "
+        "the ring attends over a sliding window"),
+    "MXNET_DECODE_BUCKETS": (
+        "32,64,128,256", str, "honored",
+        "comma list of prefill length buckets: a prompt pads up to "
+        "the smallest bucket >= its length, so prefill compiles one "
+        "executable per bucket (each a distinct AOT manifest row "
+        "tools/prewarm.py can warm) instead of one per prompt length"),
+    "MXNET_DECODE_QUEUE": (
+        "64", _pint, "honored",
+        "generate.TokenServer admission-queue depth: a full queue "
+        "rejects with the typed Overloaded('queue') error"),
+    "MXNET_DECODE_DEADLINE_MS": (
+        "0", _pfloat, "honored",
+        "default per-request decode deadline (0 = none): an expired "
+        "request fails with DeadlineExceeded(stage='prefill'|'decode') "
+        "and its cache slot is evicted (reason='deadline')"),
+    "MXNET_DECODE_MAX_NEW": (
+        "128", _pint, "honored",
+        "default cap on generated tokens per request (finish_reason "
+        "'length'); per-submit max_new_tokens= overrides"),
     "DMLC_ROLE": ("worker", str, "honored", "dist kvstore role"),
     "DMLC_PS_ROOT_URI": ("", str, "honored", "dist kvstore server host"),
     "DMLC_PS_ROOT_PORT": ("9091", _pint, "honored",

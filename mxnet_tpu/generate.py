@@ -1,0 +1,1000 @@
+"""LM generation engine: KV-cache decode with a prefill/decode split
+and continuous-batching token serving.
+
+The training half of the LM stack (``examples/transformer_lm.py`` +
+``ShardedTrainer``) ships tokens *into* the model; production LM
+traffic is autoregressive decode *out* of it, and a naive decode
+re-runs the full context every token — O(T) work per token where a KV
+cache pays O(1).  This module is the inference half, built the way the
+TPU path rewards (fixed-shape compiled executables, PAPERS.md "full
+compilation" line):
+
+* **KV cache as donated device state** — one ring-buffer lane per
+  decode slot, ``(layers, slots, heads, ring, d_head)`` stacked arrays
+  donated into every prefill/decode dispatch so the cache updates in
+  place; cache dtype follows the ``dtype_policy=`` compute dtype
+  (bf16 under ``bf16_mixed``), and with a mesh the lanes shard by the
+  ``kv_cache`` spec rule of the PR 9 layouts (slots over dp/fsdp,
+  heads over tp — tp serving composes with the training mesh).
+* **Prefill/decode split** — prefill runs the model's full-sequence
+  forward at *bucketed* lengths (``MXNET_DECODE_BUCKETS``: one
+  compiled executable per bucket, each a distinct AOT manifest row
+  ``tools/prewarm.py`` can warm), seeding the admitted sequence's
+  cache lane and sampling its first token (the TTFT token).  Decode is
+  one fixed-shape token step over ALL slots — admission and eviction
+  change host-side masks, never the compiled program.
+* **Sampling under the PRNG discipline** — greedy / top-k / top-p
+  fused into the compiled step; sampling keys come from
+  ``mxnet_tpu.random.next_key()``, so ``mx.random.seed(n)`` makes a
+  generation stream reproducible end to end (greedy consumes no keys).
+* **Continuous-batching token serving** — :class:`TokenServer` drives
+  the engine from a bounded admission queue with the SAME typed error
+  taxonomy as ``serving_async`` (:class:`Overloaded` at admission,
+  :class:`DeadlineExceeded` tagged ``stage="prefill"`` vs
+  ``stage="decode"``, burn-rate shedding over the TTFT histogram,
+  drained ``close()``), so the future HTTP front end maps decode
+  failures to 429/504 exactly like predict failures.
+
+Model protocol: any net exposing ``prefill_forward(tokens)`` /
+``decode_forward(tokens, caches, pos)`` (see
+``examples/transformer_lm.py``) plus a ``config`` dict with
+``vocab_size`` / ``d_model`` / ``n_heads`` / ``n_layers`` / ``max_len``
+plugs in.  Benchmarks: ``tools/bench_decode.py`` (tokens/s/user, TTFT
+p50/p99, the >=3x KV-cache-vs-reforward acceptance number); docs:
+``docs/lm_serving.md``.
+"""
+from __future__ import annotations
+
+import collections
+import logging
+import threading
+import time
+
+import numpy as np
+
+from . import config as _config
+from . import telemetry as _telemetry
+from .base import MXNetError
+from .serving_async import (Cancelled, DeadlineExceeded, Overloaded,
+                            ReplicaFailed, ServingError, ServingFuture,
+                            BurnRateShedder)
+
+__all__ = ["SamplingConfig", "GenerationEngine", "TokenServer",
+           "GenerationResult", "sample_logits", "ServingError",
+           "Overloaded", "DeadlineExceeded", "Cancelled"]
+
+_logger = logging.getLogger("mxnet_tpu.generate")
+
+_UNSET = object()
+
+
+# ---------------------------------------------------------------------------
+# sampling
+# ---------------------------------------------------------------------------
+
+class SamplingConfig:
+    """Declared sampling recipe, fused into the compiled decode step.
+
+    ``greedy=True`` (default) takes the argmax and consumes no PRNG
+    keys.  Otherwise sampling is categorical over the
+    temperature-scaled logits, optionally restricted to the ``top_k``
+    highest logits and/or the smallest set of tokens whose cumulative
+    probability reaches ``top_p`` (nucleus).  ``eos_id`` is the token
+    that finishes a sequence (eviction reason ``eos``); None means
+    sequences only finish by length/deadline."""
+
+    def __init__(self, greedy=True, temperature=1.0, top_k=None,
+                 top_p=None, eos_id=None):
+        self.greedy = bool(greedy)
+        self.temperature = float(temperature)
+        if self.temperature <= 0:
+            raise MXNetError("temperature must be > 0, got %r"
+                             % (temperature,))
+        self.top_k = int(top_k) if top_k is not None else None
+        if self.top_k is not None and self.top_k < 1:
+            raise MXNetError("top_k must be >= 1, got %r" % (top_k,))
+        self.top_p = float(top_p) if top_p is not None else None
+        if self.top_p is not None and not 0 < self.top_p <= 1:
+            raise MXNetError("top_p must be in (0, 1], got %r" % (top_p,))
+        self.eos_id = int(eos_id) if eos_id is not None else None
+
+    @property
+    def tag(self):
+        """Compact recipe tag (AOT manifest rows, BENCH records)."""
+        if self.greedy:
+            return "greedy"
+        parts = ["sample"]
+        if self.temperature != 1.0:
+            parts.append("t%g" % self.temperature)
+        if self.top_k:
+            parts.append("k%d" % self.top_k)
+        if self.top_p:
+            parts.append("p%g" % self.top_p)
+        return "_".join(parts)
+
+    def __repr__(self):
+        return "SamplingConfig(%s, eos_id=%r)" % (self.tag, self.eos_id)
+
+
+def sample_logits(logits, key, cfg):
+    """In-graph token selection over (B, V) f32 logits -> (B,) int32.
+
+    Pure and jit-traceable; every slot samples independently from one
+    key (``jax.random.categorical`` splits per row)."""
+    import jax
+    import jax.numpy as jnp
+
+    if cfg.greedy:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    if cfg.temperature != 1.0:
+        logits = logits / cfg.temperature
+    neg = jnp.asarray(-jnp.inf, logits.dtype)
+    if cfg.top_k:
+        k = min(cfg.top_k, logits.shape[-1])
+        kth = jax.lax.top_k(logits, k)[0][..., -1:]
+        logits = jnp.where(logits < kth, neg, logits)
+    if cfg.top_p is not None and cfg.top_p < 1.0:
+        sorted_logits = jnp.flip(jnp.sort(logits, axis=-1), axis=-1)
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep a token while the mass BEFORE it is under top_p (the
+        # first token always survives)
+        kept = (cum - probs) < cfg.top_p
+        min_kept = jnp.min(
+            jnp.where(kept, sorted_logits, jnp.inf), axis=-1,
+            keepdims=True)
+        logits = jnp.where(logits < min_kept, neg, logits)
+    return jax.random.categorical(key, logits).astype(jnp.int32)
+
+
+def _parse_buckets(spec, cache_len):
+    """``MXNET_DECODE_BUCKETS``/buckets= -> sorted unique lengths
+    capped at ``cache_len`` (always containing cache_len so every
+    admissible prompt has a bucket)."""
+    if spec is None:
+        spec = _config.get("MXNET_DECODE_BUCKETS")
+    if isinstance(spec, str):
+        vals = [int(s) for s in spec.split(",") if s.strip()]
+    else:
+        vals = [int(v) for v in spec]
+    vals = sorted({v for v in vals if 0 < v <= cache_len} | {cache_len})
+    return vals
+
+
+# ---------------------------------------------------------------------------
+# the engine
+# ---------------------------------------------------------------------------
+
+class GenerationEngine:
+    """Fixed-shape KV-cache generation over a decode-protocol model.
+
+    ``slots`` decode lanes share one compiled token step; each lane
+    owns a ``cache_len``-position KV ring.  :meth:`admit` prefills a
+    prompt into a free lane (bucketed lengths) and returns its first
+    sampled token; :meth:`decode_step` advances every active lane one
+    token; :meth:`evict` frees a lane.  All device state (cache) is
+    donated through the jit sites, which thread ``aot=`` /
+    ``dtype_policy=`` like every other front end.
+
+    Single-consumer: one thread drives the engine (TokenServer's loop,
+    or a bench loop).  Admission control, deadlines, and futures live
+    in :class:`TokenServer`.
+    """
+
+    def __init__(self, net, slots=None, cache_len=None, buckets=None,
+                 mesh=None, layout=None, dtype_policy=None, aot=None,
+                 aot_spec=None, sampling=None, device=None):
+        import jax
+        import jax.numpy as jnp
+
+        from . import aot as _aot
+        from . import dtype_policy as _dtp
+        from . import autograd
+        from . import parallel
+        from .gluon import block as block_mod
+        from .ndarray.ndarray import NDArray
+
+        for attr in ("prefill_forward", "decode_forward", "config"):
+            if not hasattr(net, attr):
+                raise MXNetError(
+                    "GenerationEngine needs a model implementing the "
+                    "decode protocol (prefill_forward / decode_forward "
+                    "/ config — see examples/transformer_lm.py); %s "
+                    "lacks %r" % (type(net).__name__, attr))
+        cfg = dict(net.config)
+        for k in ("vocab_size", "d_model", "n_heads", "n_layers",
+                  "max_len"):
+            if k not in cfg:
+                raise MXNetError("model config lacks %r (decode "
+                                 "protocol)" % k)
+        self.model_config = cfg
+        if slots is None:
+            slots = _config.get("MXNET_DECODE_SLOTS")
+        self._slots = int(slots)
+        if self._slots < 1:
+            raise MXNetError("slots must be >= 1, got %r" % (slots,))
+        if cache_len is None:
+            cache_len = min(_config.get("MXNET_DECODE_CACHE_LEN"),
+                            cfg["max_len"])
+        self._cache_len = int(min(cache_len, cfg["max_len"]))
+        if self._cache_len < 1:
+            raise MXNetError("cache_len must be >= 1, got %r"
+                             % (cache_len,))
+        self._buckets = _parse_buckets(buckets, self._cache_len)
+        self.sampling = sampling if sampling is not None \
+            else SamplingConfig()
+
+        # finish deferred parameter init (abstract eval — no compile)
+        probe = NDArray(jnp.zeros(
+            (1, min(8, cfg["max_len"])), jnp.float32))
+        with autograd.pause():
+            block_mod._abstract_eval_forward(net, [probe])
+        self._net = net
+        params = list(net.collect_params().values())
+        self._param_names = [p.name for p in params]
+        dt_policy = _dtp.resolve_policy(dtype_policy)
+        self._dtype_policy = dt_policy
+        _dtp.note_policy(dt_policy, "generate")
+        self._cache_dtype = np.dtype(dt_policy.compute_dtype) \
+            if dt_policy is not None else np.dtype(np.float32)
+
+        # placement: params committed once (Predictor discipline); with
+        # a mesh both params and cache lanes take their layout specs —
+        # the kv_cache rule shards slots over the data axes and heads
+        # over tp, so tensor-parallel serving composes with the PR 9
+        # training mesh
+        self._mesh = parallel.resolve_mesh(mesh)
+        L, H = cfg["n_layers"], cfg["n_heads"]
+        dh = cfg["d_model"] // H
+        cache_shape = (L, self._slots, H, self._cache_len, dh)
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding
+
+            layout_obj = parallel.layout.resolve_layout(layout,
+                                                        self._mesh)
+            self.layout_name = layout_obj.name
+            res = layout_obj.resolve(
+                [(p.name, tuple(p.shape)) for p in params], self._mesh)
+            self._params = tuple(
+                jax.device_put(p.data()._data,
+                               NamedSharding(self._mesh, res.spec(p.name)))
+                for p in params)
+            cres = layout_obj.resolve(
+                [("cache_k", cache_shape), ("cache_v", cache_shape)],
+                self._mesh)
+            self._cache_sharding = NamedSharding(self._mesh,
+                                                 cres.spec("cache_k"))
+        else:
+            self.layout_name = None
+            dev = device if device is not None else jax.devices()[0]
+            self._params = tuple(
+                jax.device_put(p.data()._data, dev) for p in params)
+            self._cache_sharding = dev
+        jax.block_until_ready(self._params)
+        self._cache_k = jax.device_put(
+            jnp.zeros(cache_shape, self._cache_dtype),
+            self._cache_sharding)
+        self._cache_v = jax.device_put(
+            jnp.zeros(cache_shape, self._cache_dtype),
+            self._cache_sharding)
+
+        # host-side lane state (the continuous-batching control plane)
+        self._pos = np.zeros(self._slots, np.int32)
+        self._active = np.zeros(self._slots, bool)
+        self._cur_tok = np.zeros(self._slots, np.int32)
+        self._free = collections.deque(range(self._slots))
+        self._zero_key = jax.random.PRNGKey(0)
+
+        gluon_params = params
+        scfg = self.sampling
+        vocab = cfg["vocab_size"]
+
+        def _cast_params(tree):
+            if dt_policy is None:
+                return tree
+            return tuple(dt_policy.cast_compute(n, a) for n, a in
+                         zip(self._param_names, tree))
+
+        def _traced(fn, params_):
+            """Run ``fn`` with the model's parameters swapped to the
+            (policy-cast) traced arrays — the shared param-swap trace
+            recipe (gluon.block.swapped_params) under the dtype-policy
+            scope."""
+            with _dtp.scope(dt_policy), \
+                    block_mod.swapped_params(gluon_params,
+                                             _cast_params(params_)):
+                return fn()
+
+        def _cast_logits(arr):
+            if dt_policy is not None:
+                return dt_policy.cast_output(arr)
+            return arr
+
+        S, B = self._cache_len, self._slots
+        cache_dtype = self._cache_dtype
+
+        def prefill_fn(params_, cache_k, cache_v, tokens, n_valid, slot,
+                       key):
+            """tokens (1, Tb) int32; writes the sequence's K/V into
+            ring lane ``slot`` (positions 0..Tb-1), samples the first
+            generated token from the last VALID position's logits."""
+            from jax import lax
+
+            def run():
+                logits_nd, caches = net.prefill_forward(NDArray(tokens))
+                return logits_nd._data, [(k, v) for k, v in caches]
+
+            logits, caches = _traced(run, params_)
+            last = lax.dynamic_slice(
+                logits, (0, jnp.maximum(n_valid - 1, 0), 0),
+                (1, 1, vocab)).reshape((1, vocab))
+            last = _cast_logits(last)
+            next_tok = sample_logits(last, key, scfg)
+            for li, (k, v) in enumerate(caches):
+                kpad = jnp.zeros((1, H, S, dh), cache_dtype)
+                kpad = lax.dynamic_update_slice(
+                    kpad, k.astype(cache_dtype), (0, 0, 0, 0))
+                vpad = jnp.zeros((1, H, S, dh), cache_dtype)
+                vpad = lax.dynamic_update_slice(
+                    vpad, v.astype(cache_dtype), (0, 0, 0, 0))
+                cache_k = lax.dynamic_update_slice(
+                    cache_k, kpad.reshape((1, 1, H, S, dh)),
+                    (li, slot, 0, 0, 0))
+                cache_v = lax.dynamic_update_slice(
+                    cache_v, vpad.reshape((1, 1, H, S, dh)),
+                    (li, slot, 0, 0, 0))
+            return next_tok, last, cache_k, cache_v
+
+        def decode_fn(params_, cache_k, cache_v, tokens, pos, key):
+            """One token step over all ``slots`` lanes (fixed shape)."""
+            def run():
+                caches = [(cache_k[li], cache_v[li]) for li in range(L)]
+                logits_nd, new = net.decode_forward(tokens, caches, pos)
+                return logits_nd._data, new
+
+            logits, new = _traced(run, params_)
+            logits = _cast_logits(logits)
+            next_tok = sample_logits(logits, key, scfg)
+            new_k = jnp.stack([k for k, _v in new])
+            new_v = jnp.stack([v for _k, v in new])
+            return (next_tok, logits, new_k.astype(cache_dtype),
+                    new_v.astype(cache_dtype))
+
+        # jit sites: cache donated (in-place ring update), threaded
+        # through aot=/dtype_policy= like every other front end.  Each
+        # prefill BUCKET is a distinct signature -> its own AOT
+        # manifest row; so is each (slots, cache_len) decode shape.
+        self._jit_prefill = jax.jit(prefill_fn, donate_argnums=(1, 2))
+        self._jit_decode = jax.jit(decode_fn, donate_argnums=(1, 2))
+        self._aot_spec = aot_spec or ("lm_decode:slots%dxlen%d"
+                                      % (B, S))
+        store = _aot.resolve_aot(aot)
+        if store is not None:
+            dtag = _dtp.policy_tag(dt_policy)
+            fp = "dtype=%s;sampling=%s" % (dtag, scfg.tag)
+            mext = {"dtype_policy": dtag, "sampling": scfg.tag}
+            self._jit_prefill = _aot.AOTFunction(
+                self._jit_prefill, "generate:prefill", store,
+                fingerprint_extra=fp, manifest_kind="generate",
+                manifest_spec=self._aot_spec, manifest_extra=mext)
+            self._jit_decode = _aot.AOTFunction(
+                self._jit_decode, "generate:decode", store,
+                fingerprint_extra=fp, manifest_kind="generate",
+                manifest_spec=self._aot_spec, manifest_extra=mext)
+        self._H, self._dh, self._L = H, dh, L
+
+    # -- introspection ---------------------------------------------------
+
+    @property
+    def slots(self):
+        return self._slots
+
+    @property
+    def cache_len(self):
+        return self._cache_len
+
+    @property
+    def buckets(self):
+        """Prefill length buckets (sorted; one compiled program each)."""
+        return list(self._buckets)
+
+    @property
+    def dtype_policy_tag(self):
+        from . import dtype_policy as _dtp
+
+        return _dtp.policy_tag(self._dtype_policy)
+
+    @property
+    def cache_dtype(self):
+        return self._cache_dtype
+
+    @property
+    def mesh_shape(self):
+        from . import parallel
+
+        return parallel.mesh_shape(self._mesh)
+
+    def active_slots(self):
+        return [int(i) for i in np.nonzero(self._active)[0]]
+
+    def free_slots(self):
+        return len(self._free)
+
+    def position(self, slot):
+        """Tokens resident for ``slot`` (prompt + generated so far)."""
+        return int(self._pos[slot])
+
+    @property
+    def last_logits(self):
+        """f32 logits of the most recent prefill ((1, V), the admitted
+        sequence's last valid position) or decode step ((slots, V)) —
+        already computed by the dispatch, fetched here for tests and
+        logprob-surfacing callers."""
+        out = getattr(self, "_last_logits", None)
+        return None if out is None else np.asarray(out)
+
+    def occupancy(self):
+        """Cache occupancy snapshot: active lanes, resident tokens vs
+        ring capacity (the serving-dashboard gauges)."""
+        active = int(self._active.sum())
+        tokens = int(np.minimum(self._pos[self._active],
+                                self._cache_len).sum()) if active else 0
+        cap = self._slots * self._cache_len
+        return {"active_slots": active, "slots": self._slots,
+                "cache_tokens": tokens, "cache_capacity": cap,
+                "occupancy": tokens / cap if cap else 0.0}
+
+    def _note_occupancy(self):
+        occ = self.occupancy()
+        _telemetry.DECODE_ACTIVE_SLOTS.set(occ["active_slots"])
+        _telemetry.DECODE_CACHE_TOKENS.set(occ["cache_tokens"])
+
+    def bucket_for(self, length):
+        """Smallest prefill bucket >= ``length`` (raises when the
+        prompt exceeds every bucket)."""
+        for b in self._buckets:
+            if length <= b:
+                return b
+        raise MXNetError(
+            "prompt length %d exceeds the largest prefill bucket %d "
+            "(cache_len=%d; shorten the prompt or build the engine "
+            "with a longer cache)" % (length, self._buckets[-1],
+                                      self._cache_len))
+
+    def _next_key(self):
+        if self.sampling.greedy:
+            # greedy consumes nothing from the framework stream — the
+            # constant key keeps the compiled signature stable
+            return self._zero_key
+        from . import random as _random
+
+        return _random.next_key()
+
+    # -- lifecycle of one sequence ---------------------------------------
+
+    def admit(self, token_ids, slot=None):
+        """Prefill ``token_ids`` into a free lane.  Returns
+        ``(slot, first_token)`` — the first generated token (the TTFT
+        token), sampled inside the prefill dispatch.  Raises
+        :class:`Overloaded` (reason ``slots``) when no lane is free."""
+        import jax
+
+        token_ids = np.asarray(token_ids).astype(np.int32).reshape(-1)
+        n = token_ids.size
+        if n < 1:
+            raise MXNetError("admit needs at least one prompt token")
+        bucket = self.bucket_for(n)
+        if slot is None:
+            if not self._free:
+                raise Overloaded("slots", "all %d decode slots busy"
+                                 % self._slots)
+            slot = self._free.popleft()
+        else:
+            self._free.remove(slot)
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, :n] = token_ids
+        key = self._next_key()
+        try:
+            next_tok, _logits, ck, cv = self._jit_prefill(
+                self._params, self._cache_k, self._cache_v, padded,
+                np.int32(n), np.int32(slot), key)
+        except Exception:
+            # donation makes the old cache unusable on failure; the
+            # lane goes back to the pool and the engine stays usable
+            # only if the cache arrays survived (non-donating fallback)
+            self._free.appendleft(slot)
+            raise
+        self._cache_k, self._cache_v = ck, cv
+        self._last_logits = _logits
+        tok = int(jax.device_get(next_tok)[0])
+        self._pos[slot] = n
+        self._cur_tok[slot] = tok
+        self._active[slot] = True
+        self._note_occupancy()
+        return slot, tok
+
+    def decode_step(self):
+        """One token for every active lane.  Returns ``{slot: token}``
+        (empty when nothing is active).  Inactive lanes compute
+        alongside (fixed shape) but their output is discarded."""
+        if not self._active.any():
+            return {}
+        key = self._next_key()
+        t0 = time.perf_counter()
+        next_tok, _logits, ck, cv = self._jit_decode(
+            self._params, self._cache_k, self._cache_v,
+            self._cur_tok.copy(), self._pos.copy(), key)
+        self._cache_k, self._cache_v = ck, cv
+        self._last_logits = _logits
+        toks = np.asarray(next_tok)
+        _telemetry.DECODE_STEP_SECONDS.observe(time.perf_counter() - t0)
+        out = {}
+        for slot in np.nonzero(self._active)[0]:
+            slot = int(slot)
+            tok = int(toks[slot])
+            out[slot] = tok
+            self._cur_tok[slot] = tok
+            self._pos[slot] += 1
+        _telemetry.DECODE_TOKENS.inc(len(out))
+        _telemetry.DECODE_BATCH_TOKENS.observe(len(out))
+        self._note_occupancy()
+        return out
+
+    def evict(self, slot, reason):
+        """Free lane ``slot`` (reason: ``eos`` / ``deadline`` /
+        ``length`` / ``cancelled`` / ``drain``).  The lane's ring is
+        overwritten by the next admit — no device work."""
+        if not self._active[slot]:
+            return
+        self._active[slot] = False
+        self._pos[slot] = 0
+        # LIFO reuse: the same request sequence lands on the same
+        # lanes run after run, which keeps SAMPLED generation
+        # reproducible under mx.random.seed (categorical splits its
+        # key per lane row)
+        self._free.appendleft(int(slot))
+        _telemetry.DECODE_EVICTIONS.inc(reason=reason)
+        self._note_occupancy()
+
+    def at_capacity(self, slot):
+        """True when ``slot`` exhausted the model's positions (the
+        ``length`` eviction the server applies): the ring slides past
+        ``cache_len``, but learned positions end at ``max_len``."""
+        return self._pos[slot] >= self.model_config["max_len"]
+
+    def prewarm(self):
+        """Compile — or load from the AOT store — the decode step and
+        every prefill bucket without generating (donation-safe: AOT
+        prewarm never executes).  Returns acquisition info dicts like
+        ``Predictor.prewarm``."""
+        from . import aot as _aot
+
+        infos = []
+        key = self._zero_key
+        if isinstance(self._jit_decode, _aot.AOTFunction):
+            infos.append(self._jit_decode.prewarm(
+                self._params, self._cache_k, self._cache_v,
+                np.zeros(self._slots, np.int32),
+                np.zeros(self._slots, np.int32), key))
+        for b in self._buckets:
+            if isinstance(self._jit_prefill, _aot.AOTFunction):
+                infos.append(self._jit_prefill.prewarm(
+                    self._params, self._cache_k, self._cache_v,
+                    np.zeros((1, b), np.int32), np.int32(1),
+                    np.int32(0), key))
+        if not infos:
+            infos.append({"label": "generate", "status": "disabled"})
+        return infos
+
+
+# ---------------------------------------------------------------------------
+# continuous-batching token serving
+# ---------------------------------------------------------------------------
+
+class GenerationResult(dict):
+    """Resolution payload of one generation request: ``tokens`` (ids,
+    prompt excluded), ``finish_reason`` (``eos`` / ``length``),
+    ``ttft_s`` (submit -> first token)."""
+
+    @property
+    def tokens(self):
+        return self["tokens"]
+
+    @property
+    def finish_reason(self):
+        return self["finish_reason"]
+
+    @property
+    def ttft_s(self):
+        return self["ttft_s"]
+
+
+class _GenRequest:
+    __slots__ = ("tokens", "future", "deadline", "t_submit", "max_new",
+                 "out", "slot", "ttft")
+
+    def __init__(self, tokens, deadline, max_new):
+        self.tokens = tokens
+        self.future = None
+        self.deadline = deadline
+        self.t_submit = time.monotonic()
+        self.max_new = max_new
+        self.out = []
+        self.slot = None
+        self.ttft = None
+
+
+class TokenServer:
+    """Continuous-batching token front end over one
+    :class:`GenerationEngine`.
+
+    ``submit`` admits a prompt through a bounded queue and returns a
+    :class:`ServingFuture` resolving to a :class:`GenerationResult`.
+    A background loop admits queued prompts into free decode slots
+    (prefill), steps every active slot one token per iteration, and
+    evicts on EOS, deadline, length cap, or cancellation.  The typed
+    degradation contract is the serving_async taxonomy applied
+    per-token:
+
+    * admission: :class:`Overloaded` — ``queue`` (queue full), ``slo``
+      (TTFT burn-rate shedding), ``shutdown``; cooperative
+      backpressure via ``block=True``.
+    * deadlines: :class:`DeadlineExceeded` with ``stage="prefill"``
+      (expired waiting or during prefill) or ``stage="decode"``
+      (expired mid-generation; the partial tokens are dropped and the
+      slot evicted with reason ``deadline``).
+    * shutdown: ``close(drain=True)`` stops admission, lets active
+      sequences finish (bounded), and fails the rest
+      :class:`Cancelled`.
+    """
+
+    def __init__(self, engine, queue_depth=None, deadline_ms=None,
+                 max_new_tokens=None, slo_ms=None, shed_error_budget=0.1,
+                 shed_burn_threshold=2.0, shed_window_s=30.0,
+                 shed_hist=None):
+        self._engine = engine
+        if queue_depth is None:
+            queue_depth = _config.get("MXNET_DECODE_QUEUE")
+        self._depth = int(queue_depth)
+        if self._depth < 1:
+            raise MXNetError("queue_depth must be >= 1, got %r"
+                             % (queue_depth,))
+        if deadline_ms is None:
+            deadline_ms = _config.get("MXNET_DECODE_DEADLINE_MS")
+        self._deadline_s = float(deadline_ms) / 1e3 if deadline_ms \
+            else None
+        if max_new_tokens is None:
+            max_new_tokens = _config.get("MXNET_DECODE_MAX_NEW")
+        self._max_new = int(max_new_tokens)
+        self._shedder = None
+        if slo_ms:
+            # burn-rate shedding over TIME-TO-FIRST-TOKEN: the latency
+            # a decode tier's clients feel first (serving_async sheds
+            # over whole-request latency; per-token serving degrades at
+            # admission before queues melt)
+            self._shedder = BurnRateShedder(
+                float(slo_ms) / 1e3, error_budget=shed_error_budget,
+                burn_threshold=shed_burn_threshold, window_s=shed_window_s,
+                hist=shed_hist if shed_hist is not None
+                else _telemetry.DECODE_TTFT_SECONDS)
+        self._cond = threading.Condition()
+        self._queue = collections.deque()
+        self._by_slot = {}
+        self._running = True
+        self._closed = False
+        self._worker = threading.Thread(target=self._loop,
+                                        name="decode-server", daemon=True)
+        self._worker.start()
+
+    # -- admission -------------------------------------------------------
+
+    def _admission_error_locked(self, deadline, now):
+        if self._closed or not self._running:
+            return Overloaded("shutdown")
+        if self._shedder is not None and self._shedder.shedding:
+            return Overloaded("slo", "TTFT burn rate %.2fx"
+                              % self._shedder.burn)
+        if deadline is not None and now >= deadline:
+            return DeadlineExceeded("prefill", "expired before admission")
+        if len(self._queue) >= self._depth:
+            return Overloaded("queue", "depth %d" % self._depth)
+        return None
+
+    def submit(self, token_ids, deadline_ms=_UNSET, max_new_tokens=None,
+               block=False, timeout=None):
+        """Admit one prompt; returns its :class:`ServingFuture`.
+
+        Non-blocking by default (typed :class:`Overloaded` on a full
+        queue); ``block=True`` waits up to ``timeout`` seconds for
+        queue space (``slo``/``shutdown`` still raise immediately).
+        ``deadline_ms`` overrides the server default; None/0 = no
+        deadline.  ``max_new_tokens`` caps generation for this request
+        (finish_reason ``length``)."""
+        token_ids = np.asarray(token_ids).astype(np.int32).reshape(-1)
+        if token_ids.size < 1:
+            raise MXNetError("submit needs at least one prompt token")
+        self._engine.bucket_for(token_ids.size)  # fail-fast: too long
+        now = time.monotonic()
+        if deadline_ms is _UNSET:
+            deadline_s = self._deadline_s
+        else:
+            deadline_s = float(deadline_ms) / 1e3 if deadline_ms else None
+        deadline = now + deadline_s if deadline_s is not None else None
+        max_new = int(max_new_tokens) if max_new_tokens else self._max_new
+        wait_until = now + timeout if timeout is not None else None
+        with self._cond:
+            while True:
+                err = self._admission_error_locked(deadline,
+                                                   time.monotonic())
+                if err is None:
+                    break
+                blockable = isinstance(err, Overloaded) and \
+                    err.reason == "queue"
+                if not block or not blockable:
+                    if isinstance(err, Overloaded):
+                        _telemetry.SERVING_SHED.inc(reason=err.reason)
+                    else:
+                        _telemetry.SERVING_DEADLINE_EXCEEDED.inc(
+                            stage="prefill")
+                    raise err
+                remaining = None
+                if wait_until is not None:
+                    remaining = wait_until - time.monotonic()
+                    if remaining <= 0:
+                        _telemetry.SERVING_SHED.inc(reason=err.reason)
+                        raise err
+                self._cond.wait(remaining if remaining is not None
+                                else 0.1)
+            req = _GenRequest(token_ids, deadline, max_new)
+            req.future = ServingFuture(owner=self, req=req)
+            self._queue.append(req)
+            _telemetry.DECODE_QUEUE_DEPTH.set(len(self._queue))
+            self._cond.notify_all()
+        return req.future
+
+    def generate(self, token_ids, timeout=None, **kwargs):
+        """Blocking convenience: ``submit`` (backpressure-admitting) +
+        ``result``."""
+        t_end = time.monotonic() + timeout if timeout is not None \
+            else None
+        fut = self.submit(token_ids, block=True, timeout=timeout,
+                          **kwargs)
+        remaining = None
+        if t_end is not None:
+            remaining = max(0.0, t_end - time.monotonic())
+        return fut.result(remaining)
+
+    def _cancel(self, req):
+        """ServingFuture.cancel hook: dequeue a waiting request, or
+        flag an active one for eviction at the next loop tick."""
+        with self._cond:
+            resolved = req.future._resolve(
+                exc=Cancelled("request cancelled"))
+            if resolved and req.slot is None and req in self._queue:
+                self._queue.remove(req)
+                _telemetry.DECODE_QUEUE_DEPTH.set(len(self._queue))
+            self._cond.notify_all()
+            return resolved
+
+    # -- the decode loop -------------------------------------------------
+
+    def _finish(self, req, reason):
+        _telemetry.DECODE_REQUESTS_FINISHED.inc(reason=reason)
+        req.future._resolve(result=GenerationResult(
+            tokens=list(req.out), finish_reason=reason,
+            ttft_s=req.ttft))
+
+    def _fail(self, req, exc, stage=None):
+        if isinstance(exc, DeadlineExceeded):
+            _telemetry.SERVING_DEADLINE_EXCEEDED.inc(stage=exc.stage)
+        req.future._resolve(exc=exc)
+
+    def _admit_locked_pop(self):
+        """Pop the next admissible queued request (dropping expired
+        ones, typed) — caller holds the lock."""
+        now = time.monotonic()
+        while self._queue:
+            req = self._queue.popleft()
+            _telemetry.DECODE_QUEUE_DEPTH.set(len(self._queue))
+            self._cond.notify_all()    # queue space freed: wake any
+                                       # block=True submitter
+            if req.future.done():      # cancelled while queued
+                continue
+            if req.deadline is not None and now >= req.deadline:
+                self._fail(req, DeadlineExceeded(
+                    "prefill", "expired waiting for a decode slot"))
+                continue
+            return req
+        return None
+
+    def _sweep_queue(self):
+        """Expire queued deadlines even while every slot is busy — a
+        request must not discover its deadline only when a slot frees."""
+        now = time.monotonic()
+        with self._cond:
+            expired = [r for r in self._queue
+                       if r.deadline is not None and now >= r.deadline
+                       and not r.future.done()]
+            if not expired and not any(r.future.done()
+                                       for r in self._queue):
+                return
+            self._queue = collections.deque(
+                r for r in self._queue
+                if r not in expired and not r.future.done())
+            _telemetry.DECODE_QUEUE_DEPTH.set(len(self._queue))
+            self._cond.notify_all()
+        for req in expired:
+            self._fail(req, DeadlineExceeded(
+                "prefill", "expired waiting for a decode slot"))
+
+    def _admissions(self):
+        eng = self._engine
+        while eng.free_slots() > 0:
+            with self._cond:
+                req = self._admit_locked_pop()
+            if req is None:
+                return
+            t_pick = time.monotonic()
+            _telemetry.DECODE_QUEUE_WAIT_SECONDS.observe(
+                t_pick - req.t_submit)
+            try:
+                slot, tok = eng.admit(req.tokens)
+            except ServingError as e:
+                self._fail(req, e)
+                continue
+            except Exception as e:
+                self._fail(req, ReplicaFailed(
+                    "prefill dispatch failed: %s" % (e,), cause=e))
+                continue
+            req.slot = slot
+            req.ttft = time.monotonic() - req.t_submit
+            _telemetry.DECODE_TTFT_SECONDS.observe(req.ttft)
+            with self._cond:
+                self._by_slot[slot] = req
+            self._deliver(req, slot, tok)
+
+    def _deliver(self, req, slot, tok):
+        """Append one generated token and apply the finish/evict
+        rules.  Returns False when the request left its slot."""
+        eng = self._engine
+        if req.future.done():                      # cancelled mid-run
+            self._release(slot)
+            eng.evict(slot, "cancelled")
+            return False
+        now = time.monotonic()
+        if req.deadline is not None and now >= req.deadline:
+            stage = "decode" if req.out else "prefill"
+            self._fail(req, DeadlineExceeded(
+                stage, "deadline hit after %d token(s)" % len(req.out)))
+            self._release(slot)
+            eng.evict(slot, "deadline")
+            return False
+        req.out.append(tok)
+        eos = self._engine.sampling.eos_id
+        if eos is not None and tok == eos:
+            self._finish(req, "eos")
+            self._release(slot)
+            eng.evict(slot, "eos")
+            return False
+        if len(req.out) >= req.max_new or eng.at_capacity(slot):
+            self._finish(req, "length")
+            self._release(slot)
+            eng.evict(slot, "length")
+            return False
+        return True
+
+    def _release(self, slot):
+        with self._cond:
+            self._by_slot.pop(slot, None)
+            self._cond.notify_all()
+
+    def _loop(self):
+        while True:
+            with self._cond:
+                while self._running and not self._queue \
+                        and not self._by_slot:
+                    self._cond.wait(0.02)
+                if not self._running:
+                    return
+            try:
+                self._sweep_queue()
+                self._admissions()
+                toks = self._engine.decode_step()
+                for slot, tok in toks.items():
+                    with self._cond:
+                        req = self._by_slot.get(slot)
+                    if req is None:
+                        self._engine.evict(slot, "cancelled")
+                        continue
+                    self._deliver(req, slot, tok)
+                if self._shedder is not None:
+                    self._shedder.update()
+            except Exception as e:
+                # a broken engine (failed dispatch after donation) can
+                # serve nobody: fail everything typed and stop
+                _logger.exception("decode loop failed; shutting down")
+                with self._cond:
+                    self._closed = True
+                    self._running = False
+                    victims = list(self._by_slot.values()) \
+                        + list(self._queue)
+                    self._by_slot.clear()
+                    self._queue.clear()
+                    _telemetry.DECODE_QUEUE_DEPTH.set(0)
+                for req in victims:
+                    self._fail(req, ReplicaFailed(
+                        "decode loop failed: %s" % (e,), cause=e))
+                return
+
+    # -- lifecycle -------------------------------------------------------
+
+    def close(self, drain=True, timeout=None):
+        """Stop admission; with ``drain`` (default) let active
+        sequences finish (bounded by ``timeout`` seconds, else a
+        30 s no-progress guard), then fail the remainder
+        :class:`Cancelled`.  Idempotent."""
+        deadline = time.monotonic() + timeout if timeout is not None \
+            else None
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        if drain:
+            last_busy = None
+            last_progress = time.monotonic()
+            while True:
+                with self._cond:
+                    busy = len(self._queue) + len(self._by_slot)
+                    if not busy or not self._running:
+                        break
+                now = time.monotonic()
+                if last_busy is None or busy < last_busy:
+                    last_busy, last_progress = busy, now
+                elif now - last_progress > 30.0:
+                    _logger.warning(
+                        "close(): no drain progress in 30s with %d "
+                        "request(s) live; cancelling the remainder",
+                        busy)
+                    break
+                if deadline is not None and now >= deadline:
+                    break
+                time.sleep(0.005)
+        with self._cond:
+            self._running = False
+            self._cond.notify_all()
+        # join BEFORE touching engine state: the worker may be
+        # mid-iteration, and engine.evict/admit are single-consumer —
+        # evicting concurrently would double-free a KV lane
+        self._worker.join(timeout=5.0)
+        worker_gone = not self._worker.is_alive()
+        with self._cond:
+            victims = list(self._by_slot.values()) + list(self._queue)
+            self._by_slot.clear()
+            self._queue.clear()
+            _telemetry.DECODE_QUEUE_DEPTH.set(0)
+            self._cond.notify_all()
+        for req in victims:
+            if not req.future.done():
+                req.future._resolve(exc=Cancelled(
+                    "token server shut down before completion"))
+            if req.slot is not None and worker_gone:
+                # a worker stuck in a device call could still race the
+                # lane; leave it active then (the engine is unusable
+                # anyway) rather than double-free it
+                self._engine.evict(req.slot, "drain")
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    def stats(self):
+        with self._cond:
+            return {
+                "queue_depth": len(self._queue),
+                "active": len(self._by_slot),
+                "free_slots": self._engine.free_slots(),
+                "shedding": (self._shedder.shedding
+                             if self._shedder else False),
+                "closed": self._closed,
+            }

@@ -15,6 +15,7 @@ re-randomize every step (unlike a baked constant).
 """
 from __future__ import annotations
 
+import contextlib
 import copy
 import re
 import threading
@@ -47,6 +48,35 @@ _trace_state = threading.local()
 
 def _is_tracing():
     return getattr(_trace_state, "active", False)
+
+
+@contextlib.contextmanager
+def swapped_params(params, arrays, training=False):
+    """Trace a block's forward against externally supplied parameter
+    arrays: swaps each gluon ``Parameter``'s device array for the
+    matching entry of ``arrays`` (typically jit tracers), activates the
+    NDArray trace state, pins autograd ``training``, and restores
+    everything on exit.  The one param-swap recipe shared by the traced
+    front-ends (``serving.Predictor.from_block``'s pattern;
+    ``generate.GenerationEngine`` and ``tools/bench_decode.py`` use
+    this helper directly)."""
+    from .. import autograd
+
+    saved = []
+    prev_train = autograd.set_training(training)
+    prev_trace = getattr(_trace_state, "active", False)
+    _trace_state.active = True
+    try:
+        for p, arr in zip(params, arrays):
+            d = p.data()
+            saved.append((d, d._data))
+            d._data = arr
+        yield
+    finally:
+        _trace_state.active = prev_trace
+        autograd.set_training(prev_train)
+        for d, old in saved:
+            d._data = old
 
 
 def _abstract_eval_forward(block, args):

@@ -280,16 +280,29 @@ register_layout(Layout("data_parallel", [
     SpecRule("replicated", r".*", ()),
 ]))
 
+# decode KV-cache lanes (generate.GenerationEngine): rank-5
+# (layers, slots, heads, ring, d_head) arrays named cache_k/cache_v —
+# slots shard over the data axes (each data shard serves its own
+# sequences), heads over tp (each tp shard attends over its own heads,
+# composing with the column-parallel proj_q/k/v below: the K/V a shard
+# caches are exactly the ones its projections produce)
+_KV_CACHE_FSDP = SpecRule("kv_cache", r"cache_(k|v)$",
+                          (None, ("dp", "fsdp")), rank=5)
+_KV_CACHE_TP = SpecRule("kv_cache", r"cache_(k|v)$",
+                        (None, ("dp", "fsdp"), "tp"), rank=5)
+
 register_layout(Layout("fsdp", [
     # ZeRO-3: shard dim 0 of every matrix/conv kernel and the only dim
     # of every vector along fsdp; scalars replicated.  Optimizer state
     # follows its parameter (parallel.train places m/v/mom identically).
+    _KV_CACHE_FSDP,
     SpecRule("matrix_dim0", r".*", ("fsdp",), min_rank=2),
     SpecRule("vector", r".*", ("fsdp",), rank=1),
     SpecRule("scalar", r".*", (), rank=0),
 ]))
 
 register_layout(Layout("fsdp_tp", [
+    _KV_CACHE_TP,
     # Megatron pairing on the mxnet (out_features, in_features) weight
     # convention: qkv/up projections column-parallel (tp on dim 0), the
     # following out/down projections row-parallel (tp on dim 1), so the

@@ -79,7 +79,9 @@ class Overloaded(ServingError):
     (queue full), ``inflight`` (in-flight cap), ``wait`` (estimated
     wait exceeds the SLO/deadline budget), ``slo`` (burn-rate
     shedding), ``unhealthy`` (no healthy replica), ``shutdown``
-    (predictor closed).  Retryable by the client after backoff."""
+    (predictor closed), or — from the decode tier
+    (``generate.TokenServer``) — ``slots`` (every KV-cache lane busy).
+    Retryable by the client after backoff (HTTP mapping: 429)."""
 
     def __init__(self, reason, detail=""):
         super().__init__("overloaded (%s)%s"
@@ -91,7 +93,11 @@ class DeadlineExceeded(ServingError):
     """Request failed by its deadline.  ``stage`` says where: ``queue``
     (swept while waiting), ``pickup`` (expired when the batch former
     reached it), ``dispatch`` (expired while a replica computed),
-    ``completion`` (result arrived too late to honor)."""
+    ``completion`` (result arrived too late to honor).  The decode
+    tier (``generate.TokenServer``) tags ``prefill`` (expired waiting
+    for, or during, prompt prefill) vs ``decode`` (expired
+    mid-generation; the slot is evicted) so the HTTP front end maps
+    both predict and per-token failures to 504 uniformly."""
 
     def __init__(self, stage, detail=""):
         super().__init__("deadline exceeded (%s)%s"

@@ -702,6 +702,50 @@ SERVING_WARM_POOL_SPARES = gauge(
     "mxnet_tpu_serving_warm_pool_spares",
     "Pre-built spare replicas available to heal the next ejection.")
 
+# LM generation / decode tier (generate.GenerationEngine + TokenServer;
+# see docs/lm_serving.md) — scraped through the PR 12 /metrics endpoint
+# so the serving dashboards see the decode tier next to predict
+DECODE_ACTIVE_SLOTS = gauge(
+    "mxnet_tpu_decode_active_slots",
+    "Decode slots (KV-cache lanes) currently generating a sequence.")
+DECODE_CACHE_TOKENS = gauge(
+    "mxnet_tpu_decode_cache_tokens",
+    "Tokens resident across all active KV-cache lanes (occupancy = "
+    "this over slots x cache_len; GenerationEngine.occupancy()).")
+DECODE_EVICTIONS = counter(
+    "mxnet_tpu_decode_evictions_total",
+    "Sequences evicted from their decode slot, by reason (eos = "
+    "sampled the EOS token, deadline = per-request deadline hit "
+    "mid-generation, length = max_new_tokens/position cap, cancelled "
+    "= future cancelled, drain = server shutdown).", ("reason",))
+DECODE_QUEUE_DEPTH = gauge(
+    "mxnet_tpu_decode_queue_depth",
+    "TokenServer prompts waiting in the bounded admission queue.")
+DECODE_QUEUE_WAIT_SECONDS = histogram(
+    "mxnet_tpu_decode_queue_wait_seconds",
+    "Submit to prefill-pickup wait per generation request.")
+DECODE_TTFT_SECONDS = histogram(
+    "mxnet_tpu_decode_ttft_seconds",
+    "Time-to-first-token: submit to the prefill-sampled first token "
+    "(the latency a decode client feels first; feeds the TokenServer "
+    "TTFT burn-rate shedder).")
+DECODE_TOKENS = counter(
+    "mxnet_tpu_decode_tokens_total",
+    "Tokens generated across all decode slots.")
+DECODE_STEP_SECONDS = histogram(
+    "mxnet_tpu_decode_step_seconds",
+    "Wall time of one fixed-shape decode dispatch (all slots advance "
+    "one token).")
+DECODE_BATCH_TOKENS = histogram(
+    "mxnet_tpu_decode_batch_tokens",
+    "Active slots per decode step (the continuous-batching batch-size "
+    "histogram: how full the fixed-shape step runs).",
+    buckets=BATCH_SIZE_BUCKETS)
+DECODE_REQUESTS_FINISHED = counter(
+    "mxnet_tpu_decode_requests_finished_total",
+    "Generation requests resolved successfully, by finish reason "
+    "(eos / length).", ("reason",))
+
 # device memory (sampled per train step by tracing.sample_device_memory)
 DEVICE_MEMORY_BYTES_IN_USE = gauge(
     "mxnet_tpu_device_memory_bytes_in_use",

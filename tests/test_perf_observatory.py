@@ -229,11 +229,18 @@ def _records_bench_io():
     return bench_io.ledger_records(312.0, 81.5, 2048, 4)
 
 
+def _records_bench_decode():
+    import bench_decode
+
+    return bench_decode.ledger_records(bench_decode.CANNED_RESULT)
+
+
 @pytest.mark.parametrize("builder", [
     _records_bench, _records_bench_lm, _records_bench_serving,
     _records_bench_fusion, _records_bench_checkpoint, _records_bench_io,
+    _records_bench_decode,
 ], ids=["bench", "bench_lm", "bench_serving", "bench_fusion",
-        "bench_checkpoint", "bench_io"])
+        "bench_checkpoint", "bench_io", "bench_decode"])
 def test_every_emitter_builds_schema_valid_records(builder):
     recs = builder()
     assert recs, "emitter produced no records"

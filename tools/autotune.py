@@ -133,6 +133,60 @@ def profile_lm(args):
     return meta, hot, shapes
 
 
+def profile_decode(args):
+    """Run the KV-cache decode engine (tools/bench_decode.py model) for
+    a few steps under the unified trace and return its hot-op ranking
+    plus the SMALL-BATCH, cache-length-keyed operand shapes decode
+    actually runs — token-step GEMMs are (slots x d_model)-thin and
+    the attention softmax·V chain is keyed by the ring length, shapes
+    the train-profile corpus never sees."""
+    import tempfile
+
+    import jax
+
+    import bench_decode
+    from mxnet_tpu import generate, profiler, telemetry, tracing
+
+    tracing.enable()
+    profiler.set_config(aggregate_stats=True)
+    telemetry.enable()
+    log("profiling KV-cache decode engine (%d steps)"
+        % args.decode_steps)
+    lm, cfg = bench_decode.build_lm(max_len=args.decode_cache_len)
+    eng = generate.GenerationEngine(
+        lm, slots=args.decode_slots, cache_len=args.decode_cache_len,
+        dtype_policy=args.dtype_policy)
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    for s in range(min(eng.slots, 4)):
+        eng.admit(rng.randint(0, cfg["vocab"], 8))
+    out = None
+    for _ in range(max(1, args.decode_steps)):
+        out = eng.decode_step()
+    jax.block_until_ready(eng._cache_k)
+    del out
+    path = os.path.join(tempfile.mkdtemp(prefix="mxnet_tpu_decode_"),
+                        "decode_trace.json")
+    tracing.export_trace(path)
+    hot = rank_trace_ops(path)
+    B, D, V = eng.slots, cfg["d_model"], cfg["vocab"]
+    H, S = cfg["n_heads"], eng.cache_len
+    # decode's dominant GEMM operand shapes: the (slots x D) token-step
+    # projections/FFN/head, and the (slots*heads x ring) attention
+    # score/value rows the softmax·V fusion would act on
+    shapes = [(B, D), (B, 4 * D), (B, V), (B * H, S)]
+    meta = {"model": {k: cfg[k] for k in ("vocab", "d_model", "n_heads",
+                                          "n_layers")},
+            "slots": B, "cache_len": S, "steps": args.decode_steps,
+            "shapes": [list(s) for s in shapes],
+            "trace": path,
+            "hot_ops": [{"name": n, "total_ms": round(ms, 3), "calls": c,
+                         "est_hbm_bytes": est}
+                        for n, ms, c, est in hot]}
+    return meta, hot, shapes
+
+
 def run_migrate(path, max_age_days):
     """Rewrite a pre-dtype (legacy) table in place: every key gains the
     f32 tag its measurements were taken under, then the migrated table
@@ -184,6 +238,17 @@ def run_tune(args):
         for name, ms, n, est in lm_hot:
             log("  %-40s %10.3f %6d %s"
                 % (name, ms, n, "%12.0f" % est if est else "           -"))
+    decode_meta = None
+    if args.decode:
+        decode_meta, dec_hot, dec_shapes = profile_decode(args)
+        log("decode timeline ranking (total ms | calls | est HBM "
+            "bytes):")
+        for name, ms, n, est in dec_hot:
+            log("  %-40s %10.3f %6d %s"
+                % (name, ms, n, "%12.0f" % est if est else "           -"))
+        for s in dec_shapes:
+            if s not in lm_shapes:
+                lm_shapes.append(s)
 
     names = ([p for p in args.patterns.split(",") if p]
              if args.patterns else F.list_patterns())
@@ -210,6 +275,8 @@ def run_tune(args):
              "est_hbm_bytes": est} for n, ms, c, est in hot]
     if lm_meta is not None:
         table.meta["lm_profile"] = lm_meta
+    if decode_meta is not None:
+        table.meta["decode_profile"] = decode_meta
 
     for name in names:
         pattern = F.get_pattern(name)
@@ -290,6 +357,20 @@ def main(argv=None):
     p.add_argument("--lm-mesh", default=None,
                    help="--lm: mesh spec for the profiled LM trainer "
                         "(default: MXNET_MESH, else single device)")
+    p.add_argument("--decode", action="store_true",
+                   help="profile the KV-cache decode engine "
+                        "(mxnet_tpu/generate.py via tools/"
+                        "bench_decode.py's model) live and fold its "
+                        "small-batch, cache-length-keyed hot shapes "
+                        "into the tuning run — the shapes token decode "
+                        "actually runs")
+    p.add_argument("--decode-steps", type=int, default=4,
+                   help="--decode: traced decode steps (default 4)")
+    p.add_argument("--decode-slots", type=int, default=8,
+                   help="--decode: engine decode slots (default 8)")
+    p.add_argument("--decode-cache-len", type=int, default=128,
+                   help="--decode: KV ring length profiled (default "
+                        "128)")
     p.add_argument("--patterns", help="comma list (default: all "
                                       "registered)")
     p.add_argument("--shapes", nargs="*",

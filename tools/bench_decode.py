@@ -1,0 +1,333 @@
+"""Benchmark: LM generation — KV-cache decode vs full re-forward.
+
+The framework's first LATENCY-bound hot path (ISSUE 13): where
+``bench_lm.py`` measures train tokens/s and MFU, this bench measures
+the serving side of the same transformer LM through
+``mxnet_tpu/generate.py`` — tokens/s/user, time-to-first-token
+p50/p99, KV-cache occupancy, and the continuous-batching batch-size
+profile — against the no-cache baseline that re-runs the full context
+for every token (what decode costs without the engine).
+
+Two measured phases after warmup, both jit-compiled (the comparison is
+the algorithm, not eager dispatch overhead):
+
+1. **Baseline**: one fixed-shape full-context forward per generated
+   token (compiled once at ``--ctx``), greedy next-token on the host.
+2. **Engine**: ``GenerationEngine`` + ``TokenServer`` serving
+   ``--users`` concurrent prompts with the KV-cache decode step; plus
+   a single-user pass for the apples-to-apples per-sequence rate.
+
+Emits TWO ``BENCH {json}`` records through the perf ledger (the
+``lm_decode`` record kind): ``lm_decode_tokens_per_sec_per_user``
+(tokens/sec/user, higher-better) and ``lm_decode_ttft_p99_ms`` (ms,
+LOWER-better — ``tools/perf_gate.py`` gates latency units upward).
+``cache_speedup`` carries the acceptance number: aggregate KV-cache
+tokens/s over the re-forward baseline (>= 3x on CPU at ctx 256).
+
+    # CPU smoke (the committed numbers):
+    python tools/bench_decode.py
+
+    # real chip:
+    python tools/bench_decode.py --users 16 --ctx 512
+
+Progress goes to stderr; stdout is the marked record lines only.
+"""
+import argparse
+import os
+import sys
+import time
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+for p in (REPO, os.path.join(REPO, "examples")):
+    if p not in sys.path:
+        sys.path.insert(0, p)
+
+_T0 = time.time()
+
+
+def log(msg):
+    print("[bench_decode %6.1fs] %s" % (time.time() - _T0, msg),
+          file=sys.stderr, flush=True)
+
+
+# canonical canned result for the schema-guard tests (tests/
+# test_generate.py and tests/test_perf_observatory.py import THIS so
+# the two guards can never drift apart)
+CANNED_RESULT = {
+    "metric": "lm_decode_tokens_per_sec_per_user", "value": 225.1,
+    "unit": "tokens/sec/user", "tokens_per_sec": 1801.0,
+    "tokens_per_sec_single_user": 246.9,
+    "baseline_tokens_per_sec": 163.1, "cache_speedup": 11.0,
+    "ttft_ms": {"p50": 10.3, "p99": 19.8}, "cache_occupancy": 0.24,
+    "batch_tokens_mean": 8.0, "users": 8, "slots": 8, "cache_len": 256,
+    "buckets": [32, 64, 128, 256], "ctx": 256, "prompt_len": 16,
+    "gen_tokens": 48, "sampling": "greedy", "dtype_policy": "f32",
+    "mesh_shape": {}, "layout": None, "devices": 1,
+}
+
+
+def ledger_records(result):
+    """perf_ledger records for one bench_decode run: the ``lm_decode``
+    record kind — a tokens/sec/user throughput row and a TTFT p99
+    latency row (lower-better by unit), topology/precision stamping
+    provenance.  The tier-1 schema guard calls this with a canned
+    result."""
+    from mxnet_tpu import perf_ledger
+
+    prov = {"mesh_shape": result.get("mesh_shape"),
+            "layout": result.get("layout"),
+            "dtype_policy": result.get("dtype_policy")}
+    fields = {k: v for k, v in result.items()
+              if k not in ("metric", "value", "unit")}
+    recs = [perf_ledger.make_record(
+        result["metric"], result["value"], result["unit"], prov=prov,
+        **fields)]
+    ttft = result.get("ttft_ms") or {}
+    if ttft.get("p99") is not None:
+        recs.append(perf_ledger.make_record(
+            "lm_decode_ttft_p99_ms", ttft["p99"], "ms", prov=prov,
+            ttft_p50_ms=ttft.get("p50"), users=result.get("users"),
+            slots=result.get("slots"),
+            prompt_len=result.get("prompt_len")))
+    return recs
+
+
+def build_lm(vocab=None, d_model=None, n_heads=None, n_layers=None,
+             max_len=256):
+    """The decode benchmark-of-record model: bench_lm's CPU-smoke /
+    TPU defaults at inference shapes, shared with tests and
+    ``tools/autotune.py --decode``."""
+    import jax
+
+    import mxnet_tpu as mx
+    from transformer_lm import TransformerLM
+
+    on_tpu = any(d.platform != "cpu" for d in jax.devices())
+    vocab = vocab or (32000 if on_tpu else 256)
+    d_model = d_model or (512 if on_tpu else 64)
+    n_heads = n_heads or (8 if on_tpu else 4)
+    n_layers = n_layers or (8 if on_tpu else 2)
+    mx.random.seed(0)
+    lm = TransformerLM(vocab_size=vocab, d_model=d_model,
+                       n_heads=n_heads, n_layers=n_layers,
+                       max_len=max_len)
+    lm.initialize(mx.init.Xavier())
+    cfg = dict(vocab=vocab, d_model=d_model, n_heads=n_heads,
+               n_layers=n_layers, max_len=max_len, on_tpu=on_tpu)
+    return lm, cfg
+
+
+def make_full_forward(lm):
+    """One jitted full-context forward over committed params — the
+    no-cache re-forward baseline's compiled program."""
+    import jax
+
+    from mxnet_tpu.gluon import block as block_mod
+    from mxnet_tpu.ndarray import NDArray
+
+    params = list(lm.collect_params().values())
+    arrays = tuple(jax.device_put(p.data()._data) for p in params)
+
+    def forward(tokens, params_):
+        with block_mod.swapped_params(params, params_):
+            return lm(NDArray(tokens))._data
+
+    return jax.jit(forward), arrays
+
+
+def run_baseline(lm, ctx, prompt, gen_tokens):
+    """Greedy generation by full-context re-forward at ONE compiled
+    shape (1, ctx): the cost of decode without a KV cache."""
+    fwd, arrays = make_full_forward(lm)
+    toks = np.zeros((1, ctx), np.int32)
+    n = prompt.size
+    toks[0, :n] = prompt
+    gen_tokens = min(gen_tokens, ctx - n)
+    # warmup: the one compile
+    np.asarray(fwd(toks, arrays))
+    t0 = time.perf_counter()
+    pos = n - 1
+    for _ in range(gen_tokens):
+        logits = np.asarray(fwd(toks, arrays))
+        nxt = int(logits[0, pos].argmax())
+        pos += 1
+        toks[0, pos] = nxt
+    dt = time.perf_counter() - t0
+    log("[baseline] %d tokens by re-forward @ ctx %d in %.3fs "
+        "(%.1f tok/s)" % (gen_tokens, ctx, dt, gen_tokens / dt))
+    return gen_tokens / dt
+
+
+def run(users=None, slots=None, ctx=256, prompt_len=16, gen_tokens=None,
+        dtype_policy=None, mesh=None, layout=None, trace_out=None,
+        baseline=True, **model_kw):
+    import jax
+
+    from mxnet_tpu import generate, telemetry, tracing
+
+    telemetry.enable()
+    if trace_out:
+        tracing.enable()
+        from mxnet_tpu import profiler
+
+        profiler.set_config(aggregate_stats=True)
+    lm, cfg = build_lm(max_len=ctx, **model_kw)
+    if slots is None:
+        slots = 16 if cfg["on_tpu"] else 8
+    if users is None:
+        users = slots
+    if gen_tokens is None:
+        gen_tokens = 128 if cfg["on_tpu"] else 48
+    gen_tokens = min(gen_tokens, ctx - prompt_len)
+    rng = np.random.RandomState(0)
+    prompt = rng.randint(0, cfg["vocab"], prompt_len).astype(np.int32)
+
+    if dtype_policy is None:
+        dtype_policy = os.environ.get("BENCH_DTYPE_POLICY") or \
+            ("bf16_mixed" if cfg["on_tpu"] else None)
+    eng = generate.GenerationEngine(
+        lm, slots=slots, cache_len=ctx, mesh=mesh, layout=layout,
+        dtype_policy=dtype_policy,
+        sampling=generate.SamplingConfig(greedy=True))
+    log("engine: slots=%d cache_len=%d buckets=%s dtype=%s mesh=%s"
+        % (eng.slots, eng.cache_len, eng.buckets, eng.dtype_policy_tag,
+           eng.mesh_shape))
+
+    baseline_tps = None
+    if baseline:
+        baseline_tps = run_baseline(lm, ctx, prompt, gen_tokens)
+
+    srv = generate.TokenServer(eng, queue_depth=max(users, 4),
+                               max_new_tokens=gen_tokens)
+    # warmup: one short request compiles the prompt's prefill bucket +
+    # the decode step (or loads them from the AOT store)
+    srv.generate(prompt, max_new_tokens=2, timeout=600)
+    telemetry.reset()
+
+    # phase 1 — single user: the apples-to-apples per-sequence rate
+    t0 = time.perf_counter()
+    r1 = srv.generate(prompt, max_new_tokens=gen_tokens, timeout=600)
+    dt1 = time.perf_counter() - t0
+    single_tps = len(r1.tokens) / dt1
+    log("[engine 1 user] %d tokens in %.3fs (%.1f tok/s)"
+        % (len(r1.tokens), dt1, single_tps))
+
+    # phase 2 — continuous batching at --users concurrency
+    telemetry.reset()
+    t0 = time.perf_counter()
+    futs = [srv.submit(prompt, block=True, timeout=600)
+            for _ in range(users)]
+    # peak cache occupancy, polled while the batch decodes (admissions
+    # land on the worker thread after submit returns)
+    occ_peak = 0.0
+    while not all(f.done() for f in futs):
+        occ_peak = max(occ_peak, eng.occupancy()["occupancy"])
+        time.sleep(0.002)
+    results = [f.result(timeout=600) for f in futs]
+    dt = time.perf_counter() - t0
+    total = sum(len(r.tokens) for r in results)
+    agg_tps = total / dt
+    per_user = agg_tps / users
+    ttfts = sorted(r.ttft_s for r in results)
+    p50 = float(np.percentile(ttfts, 50)) * 1e3
+    p99 = float(np.percentile(ttfts, 99)) * 1e3
+    bt_count = telemetry.DECODE_BATCH_TOKENS.count()
+    bt_mean = (telemetry.DECODE_BATCH_TOKENS.sum() / bt_count) \
+        if bt_count else None
+    srv.close()
+    log("[engine %d users] %d tokens in %.3fs (%.1f tok/s aggregate, "
+        "%.1f tok/s/user, TTFT p50 %.1f ms p99 %.1f ms)"
+        % (users, total, dt, agg_tps, per_user, p50, p99))
+
+    result = {
+        "metric": "lm_decode_tokens_per_sec_per_user",
+        "value": round(per_user, 2),
+        "unit": "tokens/sec/user",
+        "tokens_per_sec": round(agg_tps, 2),
+        "tokens_per_sec_single_user": round(single_tps, 2),
+        "baseline_tokens_per_sec": round(baseline_tps, 2)
+        if baseline_tps else None,
+        "cache_speedup": round(agg_tps / baseline_tps, 2)
+        if baseline_tps else None,
+        "ttft_ms": {"p50": round(p50, 2), "p99": round(p99, 2)},
+        "cache_occupancy": round(occ_peak, 4),
+        "batch_tokens_mean": round(bt_mean, 2)
+        if bt_mean is not None else None,
+        "users": users,
+        "slots": eng.slots,
+        "cache_len": eng.cache_len,
+        "buckets": eng.buckets,
+        "ctx": ctx,
+        "prompt_len": prompt_len,
+        "gen_tokens": gen_tokens,
+        "sampling": eng.sampling.tag,
+        "dtype_policy": eng.dtype_policy_tag,
+        "mesh_shape": eng.mesh_shape,
+        "layout": eng.layout_name,
+        "devices": len(jax.devices()),
+    }
+    if baseline_tps:
+        log("cache speedup vs re-forward @ ctx %d: %.2fx (aggregate), "
+            "%.2fx (single user)" % (ctx, agg_tps / baseline_tps,
+                                     single_tps / baseline_tps))
+    if trace_out:
+        from mxnet_tpu import tracing as _tr
+
+        _tr.export_trace(trace_out)
+        log("unified trace written to %s" % trace_out)
+    return result
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--users", type=int, default=None,
+                   help="concurrent generation requests (default: "
+                        "= slots)")
+    p.add_argument("--slots", type=int, default=None,
+                   help="decode slots / KV-cache lanes (default 8 CPU, "
+                        "16 TPU)")
+    p.add_argument("--ctx", type=int, default=256,
+                   help="context window: cache ring length AND the "
+                        "baseline's fixed re-forward shape (default "
+                        "256 — the acceptance shape)")
+    p.add_argument("--prompt-len", type=int, default=16)
+    p.add_argument("--gen-tokens", type=int, default=None,
+                   help="tokens generated per request (default 48 CPU, "
+                        "128 TPU)")
+    p.add_argument("--dtype-policy", default=None,
+                   help="engine dtype policy (cache dtype follows its "
+                        "compute dtype; default BENCH_DTYPE_POLICY, "
+                        "else bf16_mixed on TPU)")
+    p.add_argument("--mesh", default=None,
+                   help="mesh spec for tp-sharded serving, e.g. "
+                        "dp=1,tp=8 (default: MXNET_MESH)")
+    p.add_argument("--layout", default=None)
+    p.add_argument("--no-baseline", action="store_true",
+                   help="skip the re-forward baseline phase")
+    p.add_argument("--trace-out", default=None,
+                   help="write the measured run's unified chrome trace "
+                        "(tools/autotune.py --decode consumes it)")
+    p.add_argument("--vocab", type=int, default=None)
+    p.add_argument("--d-model", type=int, default=None)
+    p.add_argument("--n-heads", type=int, default=None)
+    p.add_argument("--n-layers", type=int, default=None)
+    a = p.parse_args(argv)
+    result = run(users=a.users, slots=a.slots, ctx=a.ctx,
+                 prompt_len=a.prompt_len, gen_tokens=a.gen_tokens,
+                 dtype_policy=a.dtype_policy, mesh=a.mesh,
+                 layout=a.layout, trace_out=a.trace_out,
+                 baseline=not a.no_baseline, vocab=a.vocab,
+                 d_model=a.d_model, n_heads=a.n_heads,
+                 n_layers=a.n_layers)
+    from mxnet_tpu import perf_ledger
+
+    for rec in ledger_records(result):
+        perf_ledger.emit(rec)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -48,10 +48,16 @@ sys.path.insert(0, HERE)
 
 from perf_report import backfill_file, group_runs, pl  # noqa: E402
 
-# metrics where a *drop* is the regression vs where a *rise* is
+# metrics where a *drop* is the regression vs where a *rise* is.
+# Latency units regress UPWARD: the decode tier's TTFT/per-token
+# records (tools/bench_decode.py) are the first latency-bound headline
+# metrics, and gating them higher-is-better would wave regressions
+# through.
 _HIGHER_BETTER_UNITS = {"images/sec", "img/s", "tokens/sec", "qps", "x",
-                        "bool", "flops", "gb/s"}
-_LOWER_BETTER_UNITS = {"seconds", "s", "ms", "us", "bytes"}
+                        "bool", "flops", "gb/s", "tokens/sec/user",
+                        "tokens/s/user"}
+_LOWER_BETTER_UNITS = {"seconds", "s", "ms", "us", "bytes", "ms/token",
+                       "ms/request"}
 
 
 def higher_is_better(metric, unit):
@@ -62,7 +68,7 @@ def higher_is_better(metric, unit):
         return False
     m = str(metric).lower()
     if m.endswith(("_seconds", "_ms", "_latency", "_overhead_ms_per_save",
-                   "_bytes")):
+                   "_bytes", "_ttft_p50", "_ttft_p99")):
         return False
     return True
 

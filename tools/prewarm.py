@@ -198,6 +198,36 @@ def _resnet50_serving_int8(store, batch=None, dtype_policy=None):
         yield info
 
 
+@model("lm_decode", "transformer-LM generation tier: the KV-cache "
+                    "decode step plus every prefill length bucket "
+                    "(one manifest row per bucket) — warms the "
+                    "latency-bound executables a decode replica "
+                    "needs at spawn")
+def _lm_decode(store, batch=None, dtype_policy=None):
+    import mxnet_tpu as mx
+    from mxnet_tpu import generate
+
+    ex_dir = os.path.join(REPO, "examples")
+    if ex_dir not in sys.path:
+        sys.path.insert(0, ex_dir)
+    from transformer_lm import TransformerLM
+
+    # the bench_decode.py CPU-smoke decode configuration (the chip
+    # spec passes --batch to widen slots); cache_len kept modest so
+    # the prewarm stays seconds-level
+    slots = int(batch or 4)
+    mx.random.seed(0)
+    lm = TransformerLM(vocab_size=256, d_model=64, n_heads=4,
+                       n_layers=2, max_len=64)
+    lm.initialize(mx.init.Xavier())
+    eng = generate.GenerationEngine(
+        lm, slots=slots, cache_len=64, buckets=[16, 32, 64],
+        aot=store, aot_spec="lm_decode", dtype_policy=dtype_policy,
+        sampling=generate.SamplingConfig(greedy=True))
+    for info in eng.prewarm():
+        yield info
+
+
 # ---------------------------------------------------------------------------
 # modes
 # ---------------------------------------------------------------------------
