@@ -99,6 +99,7 @@ from . import remat  # noqa: F401
 from . import dtype_policy  # noqa: F401  (MXNET_DTYPE_POLICY default)
 from . import telemetry  # noqa: F401  (MXNET_TELEMETRY enables at import)
 from . import tracing  # noqa: F401  (MXNET_TRACE / MXNET_FLIGHT_RECORDER)
+from . import events  # noqa: F401  (MXNET_EVENTS wide-event layer)
 from . import checkpoint  # noqa: F401
 from .checkpoint import CheckpointManager  # noqa: F401
 

@@ -51,6 +51,7 @@ import time
 import warnings
 
 from . import config as _config
+from . import events as _events
 from . import telemetry as _telemetry
 from . import tracing as _tracing
 
@@ -697,6 +698,10 @@ class AOTFunction:
                     _telemetry.AOT_CACHE_HITS.inc()
                     _telemetry.AOT_LOAD_SECONDS.observe(
                         time.perf_counter() - t0)
+                if _events.enabled():
+                    _events.emit("aot_load",
+                                 dur_s=time.perf_counter() - t0,
+                                 label=self.label, key=key[:16])
                 if info is not None:
                     info["status"] = "hit"
                     meta = self.store.load_meta(key) or {}
@@ -717,6 +722,9 @@ class AOTFunction:
                         sp.end()
                 if tel:
                     _telemetry.AOT_COMPILE_SECONDS.observe(compile_s)
+                if _events.enabled():
+                    _events.emit("aot_compile", dur_s=compile_s,
+                                 label=self.label, key=key[:16])
                 self._persist(key, compiled, sig, fp, compile_s)
                 if info is not None:
                     info["status"] = "compiled"
@@ -731,6 +739,10 @@ class AOTFunction:
                        "(%s: %s); falling back to jit"
                        % (self.label, type(e).__name__, e))
             self._note_fallback("acquire")
+            if _events.enabled():
+                _events.emit("aot_compile", outcome="error",
+                             error_kind="acquire", label=self.label,
+                             detail="%s: %s" % (type(e).__name__, e))
             with self._lock:
                 self._compiled[sig] = self._FALLBACK
             return self._FALLBACK
@@ -812,3 +824,38 @@ class AOTFunction:
     def _note_fallback(reason):
         if _telemetry.enabled():
             _telemetry.AOT_FALLBACKS.inc(reason=reason)
+
+
+# ---------------------------------------------------------------------------
+# /statusz subsystem view
+# ---------------------------------------------------------------------------
+
+def _statusz():
+    """AOT store health for the introspection snapshot: hit/miss
+    counters live in telemetry's base view; this adds the manifest's
+    shape and staleness — row count, parse problems, age of the newest
+    recorded signature (a stale manifest means prewarm has not run
+    since the last deploy)."""
+    store = resolve_aot(None)
+    if store is None:
+        return {"store": None, "enabled": False}
+    out = {"store": store.path, "enabled": True}
+    try:
+        entries, problems = store.manifest_entries()
+        out["manifest_rows"] = len(entries)
+        out["manifest_problems"] = len(problems)
+        newest = None
+        for e in entries:
+            c = e.get("created")
+            if c and (newest is None or c > newest):
+                newest = c
+        out["manifest_newest"] = newest
+        if newest:
+            out["manifest_age_seconds"] = \
+                _telemetry.iso_age_seconds(newest)
+    except Exception as e:
+        out["manifest_error"] = "%s: %s" % (type(e).__name__, e)
+    return out
+
+
+_telemetry.register_status_provider("aot", _statusz)

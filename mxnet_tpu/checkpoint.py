@@ -50,6 +50,7 @@ import warnings
 
 import numpy as np
 
+from . import events as _events
 from . import telemetry as _telemetry
 from .base import MXNetError
 
@@ -404,10 +405,16 @@ class CheckpointManager:
         # (the async-overlap contract — order preserved, none dropped)
         self.wait()
         if block:
-            with _telemetry.span("CheckpointManager.save",
-                                 _telemetry.CHECKPOINT_SAVE_SECONDS,
-                                 mode="sync"):
-                self._write(step, host, blobs, meta)
+            t0 = time.perf_counter()
+            try:
+                with _telemetry.span("CheckpointManager.save",
+                                     _telemetry.CHECKPOINT_SAVE_SECONDS,
+                                     mode="sync"):
+                    self._write(step, host, blobs, meta)
+            except BaseException as e:
+                self._note_save_event(step, "sync", t0, e)
+                raise
+            self._note_save_event(step, "sync", t0, None)
             return
         t = threading.Thread(target=self._write_guarded,
                              args=(step, host, blobs, meta),
@@ -424,16 +431,31 @@ class CheckpointManager:
             raise
 
     def _write_guarded(self, step, host, blobs, meta):
+        t0 = time.perf_counter()
         try:
             with _telemetry.span("CheckpointManager.save",
                                  _telemetry.CHECKPOINT_SAVE_SECONDS,
                                  mode="async"):
                 self._write(step, host, blobs, meta)
+            self._note_save_event(step, "async", t0, None)
         except BaseException as e:  # surfaced on wait()/next save
+            self._note_save_event(step, "async", t0, e)
             with self._lock:
                 self._pending_error = e
         finally:
             _telemetry.CHECKPOINT_QUEUE_DEPTH.dec()
+
+    @staticmethod
+    def _note_save_event(step, mode, t0, exc):
+        """One wide event per checkpoint save (events.py; no-op when
+        emission is off)."""
+        if not _events.enabled():
+            return
+        _events.emit(
+            "checkpoint_save",
+            outcome="ok" if exc is None else "error",
+            error_kind=type(exc).__name__ if exc is not None else None,
+            dur_s=time.perf_counter() - t0, step=step, mode=mode)
 
     def _write(self, step, host, blobs, meta):
         payload = {_ARRAY_KEY + k: v for k, v in host.items()}
@@ -551,18 +573,38 @@ class CheckpointManager:
         """_load_one + telemetry: load latency on success (the span
         skips failed scopes), a digest-failure count on any
         verification/structure rejection."""
+        t0 = time.perf_counter()
         try:
             with _telemetry.span("CheckpointManager.load",
                                  _telemetry.CHECKPOINT_LOAD_SECONDS):
-                return self._load_one(step, verify=verify)
+                out = self._load_one(step, verify=verify)
         except CheckpointCorruptError as e:
             _telemetry.CHECKPOINT_DIGEST_FAILURES.inc()
+            self._note_load_event(step, t0, "digest")
             from . import tracing as _tracing
 
             _tracing.record_crash("digest_failure", e,
                                   extra={"step": step,
                                          "directory": self.directory})
             raise
+        except BaseException as e:
+            # any other failure (unreadable path, interrupt) still
+            # files the load's ONE wide event — saves and loads keep
+            # the same one-record-per-unit-of-work contract
+            self._note_load_event(step, t0, type(e).__name__)
+            raise
+        self._note_load_event(step, t0, None)
+        return out
+
+    @staticmethod
+    def _note_load_event(step, t0, error_kind):
+        if not _events.enabled():
+            return
+        _events.emit(
+            "checkpoint_load",
+            outcome="ok" if error_kind is None else "error",
+            error_kind=error_kind,
+            dur_s=time.perf_counter() - t0, step=step)
 
     def load(self, step=None, verify=True, fallback=True):
         """Load (and digest-verify) a checkpoint.

@@ -205,6 +205,26 @@ FLAGS = {
         "http://0.0.0.0:PORT/metrics with a /healthz readiness probe "
         "for the process lifetime (telemetry.serve_scrape; 0 = off).  "
         "Pair with MXNET_TELEMETRY=1 for non-zero series"),
+    "MXNET_EVENTS": (
+        "0", _pbool, "honored",
+        "wide-event request observability (events.py): one structured "
+        "JSONL record per unit of work (serving request, TokenServer "
+        "generation, train-step window, checkpoint save/load, AOT "
+        "compile/load) with typed outcome, stage latency split, trace "
+        "id, and perf_ledger provenance; off = one branch per call "
+        "site.  Sheds/deadline/error outcomes are always kept"),
+    "MXNET_EVENTS_PATH": (
+        "", str, "honored",
+        "JSONL file the bounded background event writer appends kept "
+        "wide events to (O_APPEND; a full queue drops + counts, never "
+        "blocks serving).  '' = in-memory ring only (/requestz and "
+        "flight-recorder bundles still see the last 512 events)"),
+    "MXNET_EVENTS_SAMPLE": (
+        "1.0", _pfloat, "honored",
+        "keep probability for ok-outcome wide events below the tail "
+        "threshold (head sampling).  Errors, sheds, deadline-exceeded, "
+        "evictions and the slowest percentile per kind are ALWAYS "
+        "kept regardless of this knob"),
     "MXNET_PERF_LEDGER": (
         "", str, "honored",
         "append-only JSONL run ledger every bench emitter "
@@ -447,6 +467,18 @@ def enable_telemetry(on=True):
         telemetry.enable()
     else:
         telemetry.disable()
+
+
+def enable_events(on=True, path=None, sample=None):
+    """Toggle wide-event emission (same switch as ``MXNET_EVENTS``;
+    ``path``/``sample`` override ``MXNET_EVENTS_PATH`` /
+    ``MXNET_EVENTS_SAMPLE``)."""
+    from . import events
+
+    if on:
+        events.enable(path=path, sample=sample)
+    else:
+        events.disable()
 
 
 def enable_tracing(on=True):

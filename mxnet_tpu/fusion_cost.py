@@ -201,11 +201,12 @@ def migrate_legacy_table(data):
 class CostTable:
     """In-memory view of a measured cost table (see module doc)."""
 
-    __slots__ = ("entries", "meta")
+    __slots__ = ("entries", "meta", "_sha_cache")
 
     def __init__(self, entries=None, meta=None):
         self.entries = dict(entries or {})
         self.meta = dict(meta or {})
+        self._sha_cache = None
 
     @classmethod
     def from_dict(cls, data, source="<dict>"):
@@ -236,7 +237,19 @@ class CostTable:
                  datetime.timezone.utc).isoformat(timespec="seconds")}
         e.update(extra)
         self.entries[key] = e
+        self._sha_cache = None   # content changed, even on overwrite
         return e
+
+    def content_sha(self):
+        """16-hex content hash of the table (cached until the next
+        :meth:`add`) — the /statusz and provenance identity: two runs
+        fusing from different measurements are not comparable."""
+        if self._sha_cache is None:
+            import hashlib
+
+            blob = json.dumps(self.to_dict(), sort_keys=True).encode()
+            self._sha_cache = hashlib.sha256(blob).hexdigest()[:16]
+        return self._sha_cache
 
 
 def load_table(path):
@@ -459,3 +472,41 @@ def runtime_decision(pattern, shape, dtype, default_on=False, axis=None,
     if ok:
         note_fired(pattern, site, key)
     return ok
+
+
+# ---------------------------------------------------------------------------
+# /statusz subsystem view
+# ---------------------------------------------------------------------------
+
+def _statusz():
+    """Fusion cost-table identity for the introspection snapshot: the
+    content sha (two processes fusing from different tables are not
+    comparable; cached on the table until its next add) and the age of
+    the newest measurement — a table that pre-dates the last autotune
+    sweep is stale evidence."""
+    table = current_table()
+    if table is None:
+        return {"table": None}
+    from . import telemetry as _telemetry
+
+    out = {"table_sha": table.content_sha(),
+           "entries": len(table.entries),
+           "version": table.meta.get("version")}
+    newest = None
+    for e in table.entries.values():
+        m = e.get("measured_at") if isinstance(e, dict) else None
+        if m and (newest is None or m > newest):
+            newest = m
+    out["measured_newest"] = newest
+    if newest:
+        out["measured_age_seconds"] = _telemetry.iso_age_seconds(newest)
+    return out
+
+
+def _register_statusz():
+    from . import telemetry as _telemetry
+
+    _telemetry.register_status_provider("fusion", _statusz)
+
+
+_register_statusz()

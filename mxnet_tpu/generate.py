@@ -49,11 +49,14 @@ import collections
 import logging
 import threading
 import time
+import weakref
 
 import numpy as np
 
 from . import config as _config
+from . import events as _events
 from . import telemetry as _telemetry
+from . import tracing as _tracing
 from .base import MXNetError
 from .serving_async import (Cancelled, DeadlineExceeded, Overloaded,
                             ReplicaFailed, ServingError, ServingFuture,
@@ -66,6 +69,41 @@ __all__ = ["SamplingConfig", "GenerationEngine", "TokenServer",
 _logger = logging.getLogger("mxnet_tpu.generate")
 
 _UNSET = object()
+
+# live TokenServers (weak), feeding the /statusz decode subsystem
+# (slot occupancy, TTFT burn rate) and the /healthz readiness
+# contract — a decode process stops being ready the moment a drained
+# close() starts.  The lock serializes explicit add/discard/iterate
+# across threads (see serving_async._live_predictors).
+_live_servers = weakref.WeakSet()
+_live_lock = threading.Lock()
+
+
+def _live_snapshot():
+    with _live_lock:
+        return list(_live_servers)
+
+
+def _decode_statusz():
+    out = {"servers": []}
+    for s in _live_snapshot():
+        st = s.stats()
+        st["occupancy"] = s._engine.occupancy()
+        if s._shedder is not None:
+            st["ttft_burn_rate"] = round(s._shedder.burn, 4)
+        out["servers"].append(st)
+    return out
+
+
+def _decode_ready():
+    servers = _live_snapshot()
+    if not servers:
+        return True
+    return any(not s._closed and s._running for s in servers)
+
+
+_telemetry.register_status_provider("decode", _decode_statusz)
+_telemetry.register_readiness("decode", _decode_ready)
 
 
 # ---------------------------------------------------------------------------
@@ -611,9 +649,9 @@ class GenerationResult(dict):
 
 class _GenRequest:
     __slots__ = ("tokens", "future", "deadline", "t_submit", "max_new",
-                 "out", "slot", "ttft")
+                 "out", "slot", "ttft", "span", "t_pickup")
 
-    def __init__(self, tokens, deadline, max_new):
+    def __init__(self, tokens, deadline, max_new, span=None):
         self.tokens = tokens
         self.future = None
         self.deadline = deadline
@@ -622,6 +660,8 @@ class _GenRequest:
         self.out = []
         self.slot = None
         self.ttft = None
+        self.span = span           # detached root span (tracing on)
+        self.t_pickup = None       # queue -> prefill pickup time
 
 
 class TokenServer:
@@ -685,6 +725,8 @@ class TokenServer:
         self._worker = threading.Thread(target=self._loop,
                                         name="decode-server", daemon=True)
         self._worker.start()
+        with _live_lock:
+            _live_servers.add(self)
 
     # -- admission -------------------------------------------------------
 
@@ -722,6 +764,27 @@ class TokenServer:
         deadline = now + deadline_s if deadline_s is not None else None
         max_new = int(max_new_tokens) if max_new_tokens else self._max_new
         wait_until = now + timeout if timeout is not None else None
+        span = _tracing.begin("decode.request", activate=False,
+                              args={"prompt_tokens": int(token_ids.size)}) \
+            if _tracing.enabled() else None
+
+        def _rejected(err):
+            """Typed admission failure: count it, close the span, and
+            file the request's ONE wide event."""
+            if isinstance(err, Overloaded):
+                _telemetry.SERVING_SHED.inc(reason=err.reason)
+                outcome = {"outcome": "shed", "reason": err.reason}
+            else:
+                _telemetry.SERVING_DEADLINE_EXCEEDED.inc(stage="prefill")
+                outcome = {"outcome": "deadline", "stage": "prefill"}
+            if span is not None:
+                span.set(error=type(err).__name__).end(error=True)
+            if _events.enabled():
+                _events.emit("token_request",
+                             span_id=span.span_id if span is not None
+                             else None,
+                             prompt_tokens=int(token_ids.size), **outcome)
+
         with self._cond:
             while True:
                 err = self._admission_error_locked(deadline,
@@ -731,21 +794,17 @@ class TokenServer:
                 blockable = isinstance(err, Overloaded) and \
                     err.reason == "queue"
                 if not block or not blockable:
-                    if isinstance(err, Overloaded):
-                        _telemetry.SERVING_SHED.inc(reason=err.reason)
-                    else:
-                        _telemetry.SERVING_DEADLINE_EXCEEDED.inc(
-                            stage="prefill")
+                    _rejected(err)
                     raise err
                 remaining = None
                 if wait_until is not None:
                     remaining = wait_until - time.monotonic()
                     if remaining <= 0:
-                        _telemetry.SERVING_SHED.inc(reason=err.reason)
+                        _rejected(err)
                         raise err
                 self._cond.wait(remaining if remaining is not None
                                 else 0.1)
-            req = _GenRequest(token_ids, deadline, max_new)
+            req = _GenRequest(token_ids, deadline, max_new, span=span)
             req.future = ServingFuture(owner=self, req=req)
             self._queue.append(req)
             _telemetry.DECODE_QUEUE_DEPTH.set(len(self._queue))
@@ -770,6 +829,10 @@ class TokenServer:
         with self._cond:
             resolved = req.future._resolve(
                 exc=Cancelled("request cancelled"))
+            if resolved:
+                self._emit_event(req, outcome="evicted",
+                                 reason="cancelled",
+                                 evicted=req.slot is not None)
             if resolved and req.slot is None and req in self._queue:
                 self._queue.remove(req)
                 _telemetry.DECODE_QUEUE_DEPTH.set(len(self._queue))
@@ -778,16 +841,66 @@ class TokenServer:
 
     # -- the decode loop -------------------------------------------------
 
+    def _emit_event(self, req, evicted=False, **kw):
+        """The request's ONE wide event, filed at resolution (callers
+        guard on the future's first-writer-wins _resolve, so a
+        deadline racing a finish files exactly one).  Stage split:
+        ``queue`` (submit -> prefill pickup), ``prefill`` (pickup ->
+        first token; sampling is fused into the compiled dispatch),
+        ``decode`` (first token -> resolution)."""
+        if req.span is not None:
+            err = kw.get("outcome", "ok") != "ok"
+            req.span.set(tokens=len(req.out), **{k: v
+                         for k, v in kw.items() if v is not None})
+            req.span.end(error=err)
+        if not _events.enabled():
+            return
+        now = time.monotonic()
+        stages = {}
+        if req.t_pickup is not None:
+            stages["queue"] = req.t_pickup - req.t_submit
+            if req.ttft is not None:
+                stages["prefill"] = \
+                    (req.t_submit + req.ttft) - req.t_pickup
+                stages["decode"] = now - (req.t_submit + req.ttft)
+            else:
+                # picked up but no first token: the time went into the
+                # (failed/expired) prefill dispatch — error-path
+                # events are always kept, their split must add up too
+                stages["prefill"] = now - req.t_pickup
+        else:
+            stages["queue"] = now - req.t_submit
+        _events.emit(
+            "token_request", dur_s=now - req.t_submit, stages_s=stages,
+            tokens=len(req.out), prompt_tokens=int(req.tokens.size),
+            ttft_s=req.ttft, slot=req.slot,
+            evicted=True if evicted else None,
+            span_id=req.span.span_id if req.span is not None else None,
+            **kw)
+
     def _finish(self, req, reason):
         _telemetry.DECODE_REQUESTS_FINISHED.inc(reason=reason)
-        req.future._resolve(result=GenerationResult(
-            tokens=list(req.out), finish_reason=reason,
-            ttft_s=req.ttft))
+        if req.future._resolve(result=GenerationResult(
+                tokens=list(req.out), finish_reason=reason,
+                ttft_s=req.ttft)):
+            self._emit_event(req, outcome="ok", reason=reason)
 
     def _fail(self, req, exc, stage=None):
         if isinstance(exc, DeadlineExceeded):
             _telemetry.SERVING_DEADLINE_EXCEEDED.inc(stage=exc.stage)
-        req.future._resolve(exc=exc)
+        if not req.future._resolve(exc=exc):
+            return
+        if isinstance(exc, DeadlineExceeded):
+            self._emit_event(req, outcome="deadline", stage=exc.stage,
+                             evicted=req.slot is not None)
+        elif isinstance(exc, Overloaded):
+            self._emit_event(req, outcome="shed", reason=exc.reason)
+        elif isinstance(exc, Cancelled):
+            self._emit_event(req, outcome="evicted", reason="cancelled",
+                             evicted=req.slot is not None)
+        else:
+            self._emit_event(req, outcome="error",
+                             error_kind=type(exc).__name__)
 
     def _admit_locked_pop(self):
         """Pop the next admissible queued request (dropping expired
@@ -835,8 +948,12 @@ class TokenServer:
             if req is None:
                 return
             t_pick = time.monotonic()
+            req.t_pickup = t_pick
+            ex = {"trace_id": _tracing.TRACE_ID,
+                  "span_id": req.span.span_id} \
+                if req.span is not None else None
             _telemetry.DECODE_QUEUE_WAIT_SECONDS.observe(
-                t_pick - req.t_submit)
+                t_pick - req.t_submit, exemplar=ex)
             try:
                 slot, tok = eng.admit(req.tokens)
             except ServingError as e:
@@ -848,7 +965,7 @@ class TokenServer:
                 continue
             req.slot = slot
             req.ttft = time.monotonic() - req.t_submit
-            _telemetry.DECODE_TTFT_SECONDS.observe(req.ttft)
+            _telemetry.DECODE_TTFT_SECONDS.observe(req.ttft, exemplar=ex)
             with self._cond:
                 self._by_slot[slot] = req
             self._deliver(req, slot, tok)
@@ -974,13 +1091,20 @@ class TokenServer:
             self._cond.notify_all()
         for req in victims:
             if not req.future.done():
-                req.future._resolve(exc=Cancelled(
-                    "token server shut down before completion"))
+                if req.future._resolve(exc=Cancelled(
+                        "token server shut down before completion")):
+                    self._emit_event(req, outcome="evicted",
+                                     reason="drain",
+                                     evicted=req.slot is not None)
             if req.slot is not None and worker_gone:
                 # a worker stuck in a device call could still race the
                 # lane; leave it active then (the engine is unusable
                 # anyway) rather than double-free it
                 self._engine.evict(req.slot, "drain")
+        # readiness: 503 while close() drains, then this server stops
+        # counting (see AsyncPredictor.close)
+        with _live_lock:
+            _live_servers.discard(self)
 
     def __enter__(self):
         return self

@@ -25,6 +25,7 @@ import numpy as np
 from ..base import MXNetError
 from ..ndarray.ndarray import NDArray
 from .. import autograd
+from .. import events as _events
 from .. import random as _random
 from .. import telemetry as _telemetry
 from .. import tracing as _tracing
@@ -1116,7 +1117,10 @@ class ShardedTrainer:
         from .. import profiler as _profiler
 
         tel = _telemetry.enabled()
-        t_step0 = _time.perf_counter() if tel else None
+        # the step timestamp serves both telemetry and the wide-event
+        # layer — each is independently enableable
+        t_step0 = _time.perf_counter() if tel or _events.enabled() \
+            else None
         if tel and self._last_dispatch_end is not None:
             # dispatch-to-dispatch idle: host time spent OUTSIDE step
             # dispatch (data wait, metric bookkeeping) — the quantity
@@ -1359,24 +1363,26 @@ class ShardedTrainer:
         dispatch-queue backpressure.  Under the sync metric path the
         flush already blocked on the device, so the window covers
         execution (the historical semantics)."""
-        # t_step0 is None when telemetry was off at dispatch time — an
-        # enable() racing in mid-step must not crash the accounting
+        # t_step0 is None when both layers were off at dispatch time —
+        # an enable() racing in mid-step must not crash the accounting
         tel = _telemetry.enabled() and t_step0 is not None
-        if tel:
-            for ax, op, b in self._collective_plan:
-                _telemetry.COLLECTIVE_BYTES.inc(b * n, axis=ax, op=op)
-            if self._cast_bytes:
-                _telemetry.DTYPE_CAST_BYTES.inc(
-                    self._cast_bytes * n, policy=self.dtype_policy_tag)
+        ev_on = _events.enabled() and t_step0 is not None
+        if tel or ev_on:
             dt = _time.perf_counter() - t_step0
-            _telemetry.TRAIN_STEP_SECONDS.observe(dt / n, loop="sharded")
-            _telemetry.TRAIN_STEPS.inc(n, loop="sharded")
             bs = 0
             for a in (raw_label,) + tuple(raw_in):
                 shp = getattr(a, "shape", None)
                 if shp:
                     bs = int(shp[0])
                     break
+        if tel:
+            for ax, op, b in self._collective_plan:
+                _telemetry.COLLECTIVE_BYTES.inc(b * n, axis=ax, op=op)
+            if self._cast_bytes:
+                _telemetry.DTYPE_CAST_BYTES.inc(
+                    self._cast_bytes * n, policy=self.dtype_policy_tag)
+            _telemetry.TRAIN_STEP_SECONDS.observe(dt / n, loop="sharded")
+            _telemetry.TRAIN_STEPS.inc(n, loop="sharded")
             if bs and dt > 0:
                 _telemetry.TRAIN_SAMPLES_PER_SEC.set(bs * n / dt)
             self._record_step_cost(raw_in, raw_label)
@@ -1387,6 +1393,18 @@ class ShardedTrainer:
                     _telemetry.TRAIN_MFU.set(self._step_flops * n / dt
                                              / peak)
             self._last_dispatch_end = _time.perf_counter()
+        if ev_on:
+            # one wide event per dispatch window (n steps under the
+            # fused K-step loop): the per-step evidence row the
+            # steady-state histograms anonymize.  OK-sampled like
+            # every ok outcome; slow windows survive via tail-keep.
+            # Independent of telemetry — each knob stands alone.
+            _events.emit(
+                "train_step", dur_s=dt, steps=n,
+                step=self.global_step, loop="sharded",
+                batch_rows=bs or None,
+                samples_per_sec=round(bs * n / dt, 3)
+                if bs and dt > 0 else None)
         if tel or _tracing.enabled():
             # per-step HBM watermark sample: live/peak gauges per device
             # plus a counter track in the exported chrome trace

@@ -468,8 +468,13 @@ class Predictor:
                     pos = i + 1
                     if t0 is not None:
                         # latency = upload submission -> output on host
+                        # (exemplar: the request's own detached root
+                        # span — the contextvar lookup would miss it)
                         _telemetry.SERVING_REQUEST_SECONDS.observe(
-                            _time.perf_counter() - t0)
+                            _time.perf_counter() - t0,
+                            exemplar={"trace_id": _tracing.TRACE_ID,
+                                      "span_id": sp.span_id}
+                            if sp is not None else None)
                         _telemetry.SERVING_IN_FLIGHT.dec()
                         outstanding[0] -= 1
                     if sp is not None:

@@ -49,10 +49,12 @@ import collections
 import logging
 import threading
 import time
+import weakref
 
 import numpy as np
 
 from . import config as _config
+from . import events as _events
 from . import telemetry as _telemetry
 from . import tracing as _tracing
 from .serving import Predictor
@@ -64,6 +66,38 @@ __all__ = ["AsyncPredictor", "ServingFuture", "BurnRateShedder",
 _logger = logging.getLogger("mxnet_tpu.serving_async")
 
 _UNSET = object()
+
+# live AsyncPredictors (weak: a dropped predictor leaves the snapshot)
+# feeding the /statusz serving subsystem and the /healthz readiness
+# contract: a process with a serving tier is ready only while at least
+# one predictor is open with a healthy replica — readiness flips to
+# 503 during drained shutdown and stays 200 for non-serving processes.
+# The lock serializes explicit add/discard/iterate across threads (a
+# probe hitting the scrape thread mid-construction must not read a
+# spurious 503 from 'set changed size during iteration'; GC removals
+# are already iteration-safe via WeakSet's own deferral).
+_live_predictors = weakref.WeakSet()
+_live_lock = threading.Lock()
+
+
+def _live_snapshot():
+    with _live_lock:
+        return list(_live_predictors)
+
+
+def _serving_statusz():
+    return {"predictors": [p.stats() for p in _live_snapshot()]}
+
+
+def _serving_ready():
+    preds = _live_snapshot()
+    if not preds:
+        return True
+    return any(p.is_ready() for p in preds)
+
+
+_telemetry.register_status_provider("serving", _serving_statusz)
+_telemetry.register_readiness("serving", _serving_ready)
 
 
 # ---------------------------------------------------------------------------
@@ -195,7 +229,7 @@ class ServingFuture:
 
 class _Request:
     __slots__ = ("batch", "rows", "future", "t_submit", "deadline",
-                 "span", "retries", "state", "replica")
+                 "span", "retries", "state", "replica", "t_pickup")
 
     def __init__(self, batch, rows, deadline, span):
         self.batch = batch
@@ -207,6 +241,9 @@ class _Request:
         self.retries = 0
         self.state = "queued"      # queued -> claimed -> done
         self.replica = None
+        self.t_pickup = None       # batch-former claim time (the
+                                   # queue/dispatch stage split of the
+                                   # request's wide event)
 
 
 class _Replica:
@@ -423,6 +460,8 @@ class AsyncPredictor:
         self._sweeper = threading.Thread(
             target=self._sweep_loop, name="serving-sweeper", daemon=True)
         self._sweeper.start()
+        with _live_lock:
+            _live_predictors.add(self)
 
     # -- construction ----------------------------------------------------
 
@@ -601,6 +640,11 @@ class AsyncPredictor:
         _telemetry.SERVING_SHED.inc(reason=err.reason)
         if span is not None:
             span.set(shed=err.reason).end(error=True)
+        if _events.enabled():
+            _events.emit("serving_request", outcome="shed",
+                         reason=err.reason,
+                         span_id=span.span_id if span is not None
+                         else None)
 
     def predict(self, batch, deadline_ms=_UNSET, timeout=None):
         """Blocking convenience: backpressure-admitting ``submit`` +
@@ -632,6 +676,8 @@ class AsyncPredictor:
         # without taking self._cond
         if isinstance(exc, DeadlineExceeded):
             _telemetry.SERVING_DEADLINE_EXCEEDED.inc(stage=exc.stage)
+        if _events.enabled():
+            self._emit_event(req, exc)
         req.future._resolve(result=result, exc=exc)
         if req.span is not None:
             if exc is not None:
@@ -642,6 +688,31 @@ class AsyncPredictor:
                             req.span.span_id if req.span else "-", exc)
         self._cond.notify_all()
         return True
+
+    def _emit_event(self, req, exc):
+        """One wide event per resolved request (exactly once:
+        _finish_locked's state guard already ran).  Outcome taxonomy:
+        ok / deadline{stage} / evicted{reason=cancelled} /
+        error{kind}; sheds emit at admission in :meth:`_shed`."""
+        now = time.monotonic()
+        stages = {"queue": (req.t_pickup - req.t_submit)
+                  if req.t_pickup is not None else now - req.t_submit}
+        if req.t_pickup is not None:
+            stages["dispatch"] = now - req.t_pickup
+        kw = {"outcome": "ok"}
+        if isinstance(exc, DeadlineExceeded):
+            kw = {"outcome": "deadline", "stage": exc.stage}
+        elif isinstance(exc, Cancelled):
+            kw = {"outcome": "evicted", "reason": "cancelled"}
+        elif exc is not None:
+            kw = {"outcome": "error",
+                  "error_kind": type(exc).__name__}
+        _events.emit(
+            "serving_request", dur_s=now - req.t_submit,
+            stages_s=stages, rows=req.rows,
+            retries=req.retries or None, replica=req.replica,
+            span_id=req.span.span_id if req.span is not None else None,
+            **kw)
 
     def _cancel(self, req):
         with self._cond:
@@ -721,10 +792,14 @@ class AsyncPredictor:
                     self._queued_rows -= req.rows
                     req.state = "claimed"
                     req.replica = rep.idx
+                    req.t_pickup = now
                     self._claimed.add(req)
                     taken.append(req)
                     _telemetry.SERVING_QUEUE_WAIT_SECONDS.observe(
-                        now - req.t_submit)
+                        now - req.t_submit,
+                        exemplar={"trace_id": _tracing.TRACE_ID,
+                                  "span_id": req.span.span_id}
+                        if req.span is not None else None)
                 full = n_batches >= chain and cur_fill >= self._rows
                 if full or head_blocked or not self._running:
                     break
@@ -1209,6 +1284,13 @@ class AsyncPredictor:
                 # bound the join so close() cannot hang on it
                 t.join(timeout=1.0)
         self._sweeper.join(timeout=1.0)
+        # readiness: /healthz reads 503 WHILE close() drains (closed
+        # was set above); once shutdown completes this predictor stops
+        # counting, like one that never existed — a process that
+        # closes a serving phase and lives on must not pin the probe
+        # at 503 for as long as it holds the reference
+        with _live_lock:
+            _live_predictors.discard(self)
 
     def __enter__(self):
         return self
@@ -1217,6 +1299,16 @@ class AsyncPredictor:
         self.close()
 
     # -- introspection ---------------------------------------------------
+
+    def is_ready(self):
+        """Readiness contract for ``/healthz``: open for admission
+        with at least one healthy replica.  False from the moment a
+        drained shutdown starts (close() sets ``_closed`` before
+        draining), so the probe flips to 503 while in-flight work
+        finishes."""
+        with self._cond:
+            return self._running and not self._closed and \
+                self._healthy_count_locked() > 0
 
     def stats(self):
         """Point-in-time control-state snapshot (debugging/tests)."""

@@ -39,6 +39,9 @@ __all__ = ["Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
            "span", "scrape", "dump", "collect", "reset",
            "TelemetryReporter", "set_peak_flops", "peak_flops",
            "serve_scrape", "stop_scrape", "scrape_server",
+           "set_exemplar_source", "register_status_provider",
+           "unregister_status_provider", "statusz", "varz",
+           "register_readiness", "unregister_readiness", "readiness",
            "DEFAULT_TIME_BUCKETS", "BATCH_SIZE_BUCKETS"]
 
 _enabled = False
@@ -215,12 +218,36 @@ class Gauge(_Metric):
         return s[0] if s is not None else 0.0
 
 
+# tracing installs a callable here (set_exemplar_source) returning the
+# active {trace_id, span_id} labels, or None when tracing is off — the
+# lazy hook keeps telemetry import-light (tracing imports telemetry,
+# never the reverse)
+_exemplar_source = None
+
+
+def set_exemplar_source(fn):
+    """Install the callable ``Histogram.observe`` consults for the
+    active trace/span exemplar labels (``tracing`` does this at
+    import; pass None to uninstall)."""
+    global _exemplar_source
+    _exemplar_source = fn
+
+
 class Histogram(_Metric):
     """Fixed-boundary histogram with Prometheus bucket semantics.
 
     Per-series state is ``[per-bucket counts..., +Inf count, sum]``;
     exposition emits *cumulative* ``_bucket{le=...}`` counts plus
     ``_sum``/``_count`` like prometheus-client.
+
+    **Exemplars** (trace<->metric correlation): when tracing is on (or
+    the caller passes ``exemplar=``), each observation also records
+    ``(value, {trace_id, span_id}, time)`` against the bucket it landed
+    in — last-writer-wins per bucket, so the rare tail buckets keep
+    their spike's trace id while the busy low buckets just churn.
+    ``scrape()`` emits them in OpenMetrics exemplar syntax
+    (``... # {trace_id="..."} value ts``) so a p999 outlier in a
+    dashboard links straight to its trace span and wide event.
     """
 
     kind = "histogram"
@@ -234,12 +261,13 @@ class Histogram(_Metric):
         if b[-1] == _INF:
             b = b[:-1]
         self.buckets = b
+        self._exemplars = {}   # series key -> {bucket_i: (v, labels, t)}
         super().__init__(name, help, label_names)
 
     def _new_series(self):
         return [0] * (len(self.buckets) + 1) + [0.0]
 
-    def observe(self, value, **labels):
+    def observe(self, value, exemplar=None, **labels):
         if not _enabled:
             return
         value = float(value)
@@ -248,9 +276,32 @@ class Histogram(_Metric):
         n = len(self.buckets)
         while i < n and value > self.buckets[i]:
             i += 1
+        if exemplar is None and _exemplar_source is not None:
+            exemplar = _exemplar_source()
         with self._lock:
             s[i] += 1
             s[-1] += value
+            if exemplar:
+                self._exemplars.setdefault(self._key(labels), {})[i] = (
+                    value, dict(exemplar), time.time())
+
+    def exemplars(self, **labels):
+        """{bucket_upper_bound: (value, labels, time)} for the series
+        (None entries absent) — the recorded trace exemplars."""
+        with self._lock:
+            # copy under the lock: observe() inserts concurrently, and
+            # iterating the live dict from the scrape thread would
+            # raise mid-/metrics on the first new-bucket exemplar
+            ex = dict(self._exemplars.get(self._key(labels)) or {})
+        if not ex:
+            return {}
+        bounds = self.buckets + (_INF,)
+        return {bounds[i]: v for i, v in ex.items()}
+
+    def clear(self):
+        with self._lock:
+            self._exemplars.clear()
+        super().clear()
 
     def count(self, **labels):
         s = self._series.get(self._key(labels))
@@ -348,12 +399,20 @@ class Registry:
             series = []
             for labels in m.series_labels():
                 if m.kind == "histogram":
-                    series.append({
+                    row = {
                         "labels": labels,
                         "buckets": [[_json_num(ub), c]
                                     for ub, c in m.cumulative(**labels)],
                         "sum": m.sum(**labels),
-                        "count": m.count(**labels)})
+                        "count": m.count(**labels)}
+                    ex = m.exemplars(**labels)
+                    if ex:
+                        row["exemplars"] = {
+                            str(_json_num(ub)): {
+                                "value": v, "labels": el,
+                                "time": round(t, 3)}
+                            for ub, (v, el, t) in ex.items()}
+                    series.append(row)
                 else:
                     series.append({"labels": labels,
                                    "value": _json_num(m.value(**labels))})
@@ -362,19 +421,46 @@ class Registry:
                            "series": series}
         return out
 
-    def scrape(self):
-        """Prometheus text exposition (format 0.0.4)."""
+    def scrape(self, openmetrics=False):
+        """Prometheus text exposition.
+
+        Default (``openmetrics=False``): classic format 0.0.4 —
+        exemplars are NOT emitted, because the classic text parser
+        rejects the ``# {...}`` suffix as a malformed sample.  With
+        ``openmetrics=True`` (the HTTP endpoint selects it when the
+        client's Accept header negotiates
+        ``application/openmetrics-text``): bucket lines carry the
+        recorded trace exemplars in OpenMetrics exemplar syntax and
+        the exposition ends with the ``# EOF`` terminator."""
         lines = []
         for m in self.metrics():
-            lines.append("# HELP %s %s" % (m.name, _escape_help(m.help)))
-            lines.append("# TYPE %s %s" % (m.name, m.kind))
+            # OpenMetrics names the counter *family* without the
+            # _total suffix (samples keep it); the classic 0.0.4
+            # format declares the suffixed name.  Strict OM parsers
+            # reject the 0.0.4 spelling.
+            fam = m.name[:-len("_total")] \
+                if openmetrics and m.kind == "counter" \
+                and m.name.endswith("_total") else m.name
+            lines.append("# HELP %s %s" % (fam, _escape_help(m.help)))
+            lines.append("# TYPE %s %s" % (fam, m.kind))
             for labels in m.series_labels():
                 if m.kind == "histogram":
+                    exs = m.exemplars(**labels) if openmetrics else {}
                     for ub, c in m.cumulative(**labels):
-                        lines.append("%s_bucket%s %s" % (
+                        line = "%s_bucket%s %s" % (
                             m.name,
                             _label_str(labels, extra=[("le", _fmt(ub))]),
-                            _fmt(c)))
+                            _fmt(c))
+                        ex = exs.get(ub)
+                        if ex is not None:
+                            # OpenMetrics exemplar syntax: the tail
+                            # bucket's last observation links to its
+                            # trace span (and through it, the wide
+                            # event) — see docs/observability.md
+                            v, el, t = ex
+                            line += " # %s %s %.3f" % (
+                                _label_str(el) or "{}", _fmt(v), t)
+                        lines.append(line)
                     lines.append("%s_sum%s %s" % (
                         m.name, _label_str(labels), _fmt(m.sum(**labels))))
                     lines.append("%s_count%s %s" % (
@@ -384,6 +470,8 @@ class Registry:
                     lines.append("%s%s %s" % (
                         m.name, _label_str(labels),
                         _fmt(m.value(**labels))))
+        if openmetrics:
+            lines.append("# EOF")
         return "\n".join(lines) + "\n"
 
     def dump(self, path):
@@ -410,6 +498,13 @@ def _label_str(labels, extra=()):
     return "{%s}" % ",".join('%s="%s"' % kv for kv in pairs)
 
 
+def _json_body(payload):
+    """UTF-8 JSON bytes for the introspection endpoints (default=str:
+    a snapshot must render, never 500 on an odd value)."""
+    return json.dumps(payload, sort_keys=True,
+                      default=str).encode("utf-8")
+
+
 REGISTRY = Registry()
 
 
@@ -430,8 +525,8 @@ def collect():
     return REGISTRY.collect()
 
 
-def scrape():
-    return REGISTRY.scrape()
+def scrape(openmetrics=False):
+    return REGISTRY.scrape(openmetrics=openmetrics)
 
 
 def dump(path):
@@ -770,6 +865,24 @@ FLIGHT_BUNDLES = counter(
     "Flight-recorder postmortem bundles written, by trigger reason.",
     ("reason",))
 
+# wide-event layer (events.py; see docs/observability.md)
+EVENTS_EMITTED = counter(
+    "mxnet_tpu_events_emitted_total",
+    "Wide events kept (post-sampling) by unit-of-work kind.", ("kind",))
+EVENTS_SAMPLED_OUT = counter(
+    "mxnet_tpu_events_sampled_out_total",
+    "OK-outcome wide events discarded by head sampling "
+    "(MXNET_EVENTS_SAMPLE; errors/sheds/deadline/tail are never "
+    "sampled out).")
+EVENTS_DROPPED = counter(
+    "mxnet_tpu_events_dropped_total",
+    "Wide events lost at the bounded writer queue (or to a failed "
+    "write): the event layer sheds evidence under pressure, it never "
+    "blocks the request path.")
+EVENTS_WRITTEN = counter(
+    "mxnet_tpu_events_written_total",
+    "Wide events committed to the MXNET_EVENTS_PATH JSONL stream.")
+
 
 # ---------------------------------------------------------------------------
 # jax.monitoring bridge: compile + compilation-cache events
@@ -872,6 +985,169 @@ def peak_flops():
 
 
 # ---------------------------------------------------------------------------
+# live introspection: /statusz subsystems, /varz, readiness
+# ---------------------------------------------------------------------------
+
+_status_providers = {}     # name -> callable() -> dict (merged in)
+_readiness_checks = {}     # name -> callable() -> bool
+
+
+def register_status_provider(name, fn):
+    """Register a subsystem snapshot callable for :func:`statusz`.
+    The dict it returns is merged over the built-in view of the same
+    subsystem name; a raising provider is reported, never fatal."""
+    _status_providers[str(name)] = fn
+
+
+def unregister_status_provider(name):
+    _status_providers.pop(str(name), None)
+
+
+def register_readiness(name, fn):
+    """Register a readiness check for ``/healthz``: a callable
+    returning truthy when the subsystem can take traffic.  With any
+    registered check failing, /healthz answers 503 — the signal a
+    fleet scheduler drains on (serving tiers register themselves, so
+    readiness flips during drained shutdown).  No checks registered =
+    process-up = ready (the historical behavior)."""
+    _readiness_checks[str(name)] = fn
+
+
+def unregister_readiness(name):
+    _readiness_checks.pop(str(name), None)
+
+
+def readiness():
+    """(ready, {check_name: bool}) over every registered check — a
+    raising check counts as not ready (fail closed: a broken serving
+    tier must not keep taking traffic)."""
+    checks = {}
+    for name, fn in sorted(_readiness_checks.items()):
+        try:
+            checks[name] = bool(fn())
+        except Exception:
+            checks[name] = False
+    return all(checks.values()), checks
+
+
+def _label_values(metric, label):
+    """{label_value: series value} over a one-label counter/gauge."""
+    out = {}
+    for labels in metric.series_labels():
+        if labels:
+            out[labels[label]] = metric.value(**labels)
+    return out
+
+
+def iso_age_seconds(stamp):
+    """Age in seconds of an ISO-8601 timestamp (naive stamps read as
+    UTC), or None when unparseable — the shared staleness arithmetic
+    of the /statusz providers (AOT manifest age, fusion-table age)."""
+    if not stamp:
+        return None
+    import datetime
+
+    try:
+        created = datetime.datetime.fromisoformat(str(stamp))
+    except ValueError:
+        return None
+    if created.tzinfo is None:
+        created = created.replace(tzinfo=datetime.timezone.utc)
+    now = datetime.datetime.now(datetime.timezone.utc)
+    return round((now - created).total_seconds(), 1)
+
+
+def statusz():
+    """One JSON-able snapshot of every runtime subsystem — the
+    ``/statusz`` payload.
+
+    Schema-stable: the core subsystem keys (``aot``, ``fusion``,
+    ``serving``, ``decode``, ``checkpoint``, ``events``, ``process``)
+    are always present, built from the always-registered metric
+    catalog; live objects (AOT store, fusion table, AsyncPredictors,
+    TokenServers, event writer) enrich their subsystem through
+    :func:`register_status_provider`.
+    """
+    t = time.time()
+    subs = {
+        "process": {"pid": os.getpid(), "time": round(t, 3),
+                    "telemetry_enabled": _enabled},
+        "aot": {
+            "hits": AOT_CACHE_HITS.value(),
+            "misses": AOT_CACHE_MISSES.value(),
+            "saves": AOT_SAVES.value(),
+            "fallbacks": _label_values(AOT_FALLBACKS, "reason"),
+        },
+        "fusion": {
+            "rewrites": _label_values(FUSION_REWRITES, "pattern"),
+        },
+        "serving": {
+            "replicas_healthy": SERVING_REPLICAS_HEALTHY.value(),
+            "warm_pool_spares": SERVING_WARM_POOL_SPARES.value(),
+            "queue_depth": SERVING_QUEUE_DEPTH.value(),
+            "in_flight": SERVING_IN_FLIGHT.value(),
+            "shed": _label_values(SERVING_SHED, "reason"),
+            "deadline_exceeded": _label_values(
+                SERVING_DEADLINE_EXCEEDED, "stage"),
+            "autoheals": _label_values(SERVING_AUTOHEALS, "mode"),
+        },
+        "decode": {
+            "active_slots": DECODE_ACTIVE_SLOTS.value(),
+            "cache_tokens": DECODE_CACHE_TOKENS.value(),
+            "queue_depth": DECODE_QUEUE_DEPTH.value(),
+            "tokens_total": DECODE_TOKENS.value(),
+            "ttft_p99_ms": (lambda q: round(q * 1e3, 3)
+                            if q is not None else None)(
+                DECODE_TTFT_SECONDS.quantile(0.99)),
+            "evictions": _label_values(DECODE_EVICTIONS, "reason"),
+        },
+        "checkpoint": {
+            "async_queue_depth": CHECKPOINT_QUEUE_DEPTH.value(),
+            "digest_failures": CHECKPOINT_DIGEST_FAILURES.value(),
+            "saves": (CHECKPOINT_SAVE_SECONDS.count(mode="sync")
+                      + CHECKPOINT_SAVE_SECONDS.count(mode="async")),
+            "loads": CHECKPOINT_LOAD_SECONDS.count(),
+            "reshards": CHECKPOINT_RESHARDS.value(),
+        },
+        "events": {"enabled": False},
+    }
+    try:
+        # events registers its provider on import; importing here makes
+        # the subsystem live even when nothing else pulled events in
+        from . import events as _events  # noqa: F401
+    except Exception:
+        pass
+    for name, fn in sorted(_status_providers.items()):
+        try:
+            view = fn()
+        except Exception as e:
+            view = {"provider_error": "%s: %s" % (type(e).__name__, e)}
+        if isinstance(view, dict):
+            subs.setdefault(name, {}).update(view)
+        else:
+            subs[name] = view
+    ready, checks = readiness()
+    out = {"format_version": 1, "time": round(t, 3),
+           "pid": os.getpid(), "ready": ready, "readiness": checks,
+           "subsystems": subs}
+    try:
+        from . import tracing as _tracing
+
+        out["trace_id"] = _tracing.TRACE_ID
+    except Exception:
+        pass
+    return out
+
+
+def varz():
+    """Resolved configuration knobs (the ``/varz`` payload): every
+    registered ``MXNET_*``/``DMLC_*`` flag with its *parsed, effective*
+    value — what the process is actually running with, env overrides
+    applied."""
+    return {name: _config.get(name) for name in sorted(_config.FLAGS)}
+
+
+# ---------------------------------------------------------------------------
 # Prometheus HTTP scrape endpoint
 # ---------------------------------------------------------------------------
 
@@ -880,12 +1156,24 @@ _scrape_lock = threading.Lock()
 
 
 class _ScrapeServer:
-    """Background HTTP server exposing the registry.
+    """Background HTTP server exposing the registry + introspection.
 
-    Routes: ``/metrics`` (Prometheus text exposition, the
-    :func:`scrape` body) and ``/healthz`` (readiness probe: 200 "ok"
-    once the server thread accepts connections — the contract fleet
-    schedulers and the future network front end gate rollout on).
+    Routes:
+
+    * ``/metrics`` — Prometheus text exposition (the :func:`scrape`
+      body, exemplar-bearing when tracing is on);
+    * ``/healthz`` — readiness probe: 200 "ok" while every registered
+      :func:`register_readiness` check passes (none registered =
+      process-up = ready), **503** with a JSON body naming the failing
+      checks otherwise — flips during drained serving shutdown and
+      before the first replica is ready, the contract fleet schedulers
+      gate rollout on;
+    * ``/statusz`` — one JSON snapshot of every runtime subsystem
+      (:func:`statusz`);
+    * ``/requestz`` — the last-N sampled wide events
+      (``?n=`` caps the window; ``events.recent``);
+    * ``/varz`` — resolved config knobs (:func:`varz`).
+
     Everything else is 404.  Daemon threads; :meth:`stop` is
     synchronous.
     """
@@ -895,17 +1183,56 @@ class _ScrapeServer:
 
         class Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server API)
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
+                status = 200
                 if path == "/metrics":
-                    body = scrape().encode("utf-8")
-                    ctype = "text/plain; version=0.0.4; charset=utf-8"
+                    # content negotiation: exemplars are OpenMetrics
+                    # syntax, which the classic 0.0.4 text parser
+                    # rejects — only clients that ask for OpenMetrics
+                    # (modern Prometheus does) get them
+                    accept = self.headers.get("Accept", "")
+                    om = "application/openmetrics-text" in accept
+                    body = scrape(openmetrics=om).encode("utf-8")
+                    ctype = ("application/openmetrics-text; "
+                             "version=1.0.0; charset=utf-8") if om \
+                        else "text/plain; version=0.0.4; charset=utf-8"
                 elif path == "/healthz":
-                    body = b"ok\n"
-                    ctype = "text/plain; charset=utf-8"
+                    ready, checks = readiness()
+                    if ready:
+                        body = b"ok\n"
+                        ctype = "text/plain; charset=utf-8"
+                    else:
+                        status = 503
+                        body = _json_body({
+                            "ready": False,
+                            "failing": sorted(k for k, v in checks.items()
+                                              if not v),
+                            "checks": checks})
+                        ctype = "application/json; charset=utf-8"
+                elif path == "/statusz":
+                    body = _json_body(statusz())
+                    ctype = "application/json; charset=utf-8"
+                elif path == "/requestz":
+                    n = 64
+                    for part in query.split("&"):
+                        if part.startswith("n="):
+                            try:
+                                n = max(1, int(part[2:]))
+                            except ValueError:
+                                pass
+                    from . import events as _events
+
+                    body = _json_body({
+                        "stats": _events.stats(),
+                        "events": _events.recent(n)})
+                    ctype = "application/json; charset=utf-8"
+                elif path == "/varz":
+                    body = _json_body(varz())
+                    ctype = "application/json; charset=utf-8"
                 else:
                     self.send_error(404, "unknown path %r" % path)
                     return
-                self.send_response(200)
+                self.send_response(status)
                 self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()

@@ -140,6 +140,23 @@ def current_span():
     return _current.get()
 
 
+def _exemplar_labels():
+    """Active {trace_id, span_id} for Histogram exemplars, or None
+    when tracing is off — installed into telemetry below so a tail
+    histogram observation links back to its trace (and through the
+    span id, to its wide event)."""
+    if not _enabled:
+        return None
+    out = {"trace_id": TRACE_ID}
+    sp = _current.get()
+    if sp is not None:
+        out["span_id"] = sp.span_id
+    return out
+
+
+_telemetry.set_exemplar_source(_exemplar_labels)
+
+
 def new_request_id():
     """A fresh ID from the span-ID space (used for request correlation
     on error paths when tracing is off and no root span exists)."""
@@ -531,6 +548,18 @@ def _write_bundle(reason, exc, extra):
     try:
         export_trace(os.path.join(tmp, "trace.json"))
         _telemetry.REGISTRY.dump(os.path.join(tmp, "telemetry.json"))
+        try:
+            # the recent-events ring: per-request evidence for the
+            # window leading into the crash (best effort — a broken
+            # events layer must not cost the bundle)
+            from . import events as _events
+
+            atomic_write(os.path.join(tmp, "events.json"),
+                         json.dumps({"stats": _events.stats(),
+                                     "events": _events.recent()},
+                                    default=str))
+        except Exception:
+            logger.exception("flight-recorder events.json failed")
         atomic_write(os.path.join(tmp, "stacks.txt"), _format_stacks())
         atomic_write(os.path.join(tmp, "info.json"),
                      json.dumps(_bundle_info(reason, exc, extra), indent=1,

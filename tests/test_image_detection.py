@@ -131,6 +131,11 @@ def test_random_crop_keeps_box_on_pixels():
 
 
 def test_random_pad_scales_boxes():
+    # deterministic pad geometry: the box-frames-patch assertion below
+    # is edge-sensitive for some random draws, and this test's outcome
+    # must not depend on how much global-RNG stream earlier tests
+    # consumed
+    np.random.seed(7)
     img = np.zeros((20, 20, 3), np.float32)
     img[5:15, 5:15, 2] = 200.0
     label = np.array([[0, 0.25, 0.25, 0.75, 0.75]], np.float32)

@@ -351,6 +351,37 @@ def test_serve_scrape_metrics_and_healthz(registry):
     assert tel.scrape_server() is None
 
 
+def test_healthz_readiness_flips_to_503(registry):
+    """The probe answers 503 while any registered readiness check
+    fails — e.g. a serving tier that has not brought its first
+    replica up yet — and recovers when it passes (regression: the old
+    probe answered 200 for process lifetime regardless of serving
+    state; the drained-shutdown flip is driven end-to-end in
+    tests/test_events.py)."""
+    srv = tel.serve_scrape(port=0)
+    base = "http://127.0.0.1:%d" % srv.port
+    replica_up = []
+    tel.register_readiness("gateway", lambda: bool(replica_up))
+    try:
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/healthz")
+        assert ei.value.code == 503
+        payload = json.loads(ei.value.read())
+        assert payload["failing"] == ["gateway"]
+        replica_up.append(True)          # first replica ready
+        hz = urllib.request.urlopen(base + "/healthz")
+        assert hz.status == 200 and hz.read() == b"ok\n"
+        # a RAISING check fails closed, it does not read as ready
+        tel.register_readiness("broken", lambda: 1 / 0)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(base + "/healthz")
+        assert ei.value.code == 503
+    finally:
+        tel.unregister_readiness("gateway")
+        tel.unregister_readiness("broken")
+        tel.stop_scrape()
+
+
 # ---------------------------------------------------------------------------
 # the regression gate (synthetic ledgers; pure stdlib)
 # ---------------------------------------------------------------------------
@@ -544,4 +575,38 @@ def test_report_single_run_and_attributed_diff(tmp_path, capsys):
                           [_gate_rec("only", 100.0, 2183.0, 6.0)])
     assert perf_report.main(["--ledger", single, "--diff", "latest",
                              "prev"]) == 2
+    capsys.readouterr()
+
+
+def test_diff_against_backfilled_baseline_zero_fills_attribution(
+        tmp_path, capsys):
+    """--diff where one side is pre-schema backfilled history: the
+    baseline run carries NO attribution (and the schema'd side may
+    carry bucket names the other lacks) — missing buckets read as
+    zero and the story still renders, instead of raising or silently
+    dropping the section."""
+    import perf_report
+
+    ledger = str(tmp_path / "hist.jsonl")
+    # a real backfilled baseline (provenance unknown, no attribution)
+    assert perf_report.main(
+        ["--ledger", ledger, "--backfill",
+         os.path.join(REPO, "BENCH_r05.json")]) == 0
+    capsys.readouterr()
+    # a modern run whose attribution has an extra custom bucket
+    rec = _gate_rec("runNew", 300.0, 2100.0, 12.0,
+                    metric="resnet50_train_images_per_sec_per_chip",
+                    unit="images/sec")
+    rec["attribution"]["buckets_ms_per_step"]["custom_wait"] = 3.0
+    with open(ledger, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    assert perf_report.main(
+        ["--ledger", ledger, "--diff", "prev", "latest"]) == 0
+    out = capsys.readouterr().out
+    assert "read as zero" in out
+    assert "device_compute" in out and "custom_wait" in out
+    assert "story:" in out
+    # the reverse direction (attribution -> none) renders too
+    assert perf_report.main(
+        ["--ledger", ledger, "--diff", "latest", "prev"]) == 0
     capsys.readouterr()

@@ -111,6 +111,30 @@ def test_metric_names_registered_at_import_are_lint_clean():
             assert m.name.endswith("_total"), m.name
 
 
+def test_metric_catalog_doc_parity():
+    """Every metric registered in code has a row in the
+    docs/observability.md catalog table, and every row there still
+    names a live metric — a stale row fails, not rots."""
+    doc_path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "docs", "observability.md")
+    doc = set()
+    with open(doc_path, encoding="utf-8") as f:
+        for line in f:
+            m = re.match(r"^\| `(mxnet_tpu_[a-z0-9_]+)`", line)
+            if m:
+                doc.add(m.group(1))
+    assert len(doc) >= 20, "catalog table not found/parsed"
+    code = {m.name for m in tel.REGISTRY.metrics()}
+    missing = sorted(code - doc)
+    stale = sorted(doc - code)
+    assert not missing, (
+        "metrics registered in code but missing a docs/observability.md "
+        "catalog row: %s" % ", ".join(missing))
+    assert not stale, (
+        "docs/observability.md catalog rows naming metrics that no "
+        "longer exist in code: %s" % ", ".join(stale))
+
+
 def test_scrape_is_valid_prometheus_exposition(registry):
     tel.TRAIN_STEPS.inc(loop="sharded")
     tel.TRAIN_STEP_SECONDS.observe(0.01, loop="sharded")

@@ -254,7 +254,8 @@ def test_nan_step_dumps_one_bundle(tiny_trainer, traced, tmp_path):
     dirs = tracing.bundles(fr)
     assert len(dirs) == 1
     b = dirs[0]
-    assert sorted(os.listdir(b)) == ["info.json", "stacks.txt",
+    assert sorted(os.listdir(b)) == ["events.json",
+                                     "info.json", "stacks.txt",
                                      "telemetry.json", "trace.json"]
     info = json.loads(open(os.path.join(b, "info.json")).read())
     assert info["reason"] == "nonfinite"

@@ -187,7 +187,7 @@ def render_run(run_id, recs, trace=None, telemetry=None):
         lines.append("  where did the milliseconds go "
                      "(%s steps, %.3f ms wall/step):"
                      % (attr.get("steps", "?"), wall))
-        buckets = attr.get("buckets_ms_per_step", {})
+        buckets = _buckets_of(attr)
         order = [b for b in pl.BREAKDOWN_BUCKETS if b in buckets] + \
             sorted(set(buckets) - set(pl.BREAKDOWN_BUCKETS))
         for name in order:
@@ -280,12 +280,22 @@ def render_diff(run_a, recs_a, run_b, recs_b):
     if only_b:
         lines.append("  only in %s: %s" % (run_b, ", ".join(only_b)))
     attr_a, attr_b = _attribution_of(recs_a), _attribution_of(recs_b)
-    if attr_a and attr_b:
-        lines.append("  attribution (ms/step):")
+    if attr_a or attr_b:
+        # one-sided attribution is the NORMAL case against backfilled
+        # pre-schema history (provenance=unknown rows carry none):
+        # missing buckets read as zero so the story still renders,
+        # instead of raising / silently dropping the whole section
+        ba = _buckets_of(attr_a)
+        bb = _buckets_of(attr_b)
+        lines.append("  attribution (ms/step%s):"
+                     % ("; %s has none, read as zero"
+                        % (run_a if not ba else run_b)
+                        if not (ba and bb) else ""))
         parts = []
-        ba = attr_a.get("buckets_ms_per_step", {})
-        bb = attr_b.get("buckets_ms_per_step", {})
-        for name in pl.BREAKDOWN_BUCKETS:
+        names = [n for n in pl.BREAKDOWN_BUCKETS
+                 if n in ba or n in bb] or list(pl.BREAKDOWN_BUCKETS)
+        names += sorted((set(ba) | set(bb)) - set(names))
+        for name in names:
             a, b = ba.get(name, 0.0), bb.get(name, 0.0)
             pct = (100.0 * (b - a) / a) if a else (100.0 if b else 0.0)
             lines.append("    %-15s %10.3f -> %10.3f  (%+.1f%%)"
@@ -295,6 +305,18 @@ def render_diff(run_a, recs_a, run_b, recs_b):
         if parts:
             lines.append("  story: " + ", ".join(parts))
     return lines
+
+
+def _buckets_of(attr):
+    """The buckets_ms_per_step dict of one side's attribution, {} when
+    the side has no attribution or a malformed one (backfilled rows)."""
+    if not isinstance(attr, dict):
+        return {}
+    buckets = attr.get("buckets_ms_per_step")
+    if not isinstance(buckets, dict):
+        return {}
+    return {k: v for k, v in buckets.items()
+            if isinstance(v, (int, float))}
 
 
 def main(argv=None):

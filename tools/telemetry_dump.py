@@ -1,12 +1,16 @@
-"""Pretty-print (and diff) mxnet_tpu telemetry JSON snapshots.
+"""Pretty-print (and diff) mxnet_tpu telemetry snapshots.
 
-Reads the artifact written by ``mxnet_tpu.telemetry.dump(path)`` (or by
-a running ``TelemetryReporter``'s ``path=``) and renders the top-N
-series as a table: counters/gauges by value, histograms as
-count/sum/mean/p50/p99.
+Reads the JSON artifact written by ``mxnet_tpu.telemetry.dump(path)``
+(or a ``TelemetryReporter``'s ``path=``) **or** a saved Prometheus/
+OpenMetrics text exposition (``curl :9100/metrics > snap.txt``) — the
+exposition parser understands the exemplar suffix the tracing-enabled
+scrape emits (``... # {trace_id="..."} value ts``) instead of crashing
+on it.  Renders the top-N series as a table: counters/gauges by value,
+histograms as count/sum/mean/p50/p99.
 
     python tools/telemetry_dump.py snap.json [--top 20]
     python tools/telemetry_dump.py --diff before.json after.json
+    python tools/telemetry_dump.py --diff before.txt after.txt  # scrapes
 
 ``--diff`` aligns series by (metric, labels) and prints deltas —
 the before/after view for bench runs (counter/histogram deltas are the
@@ -14,22 +18,124 @@ work done between the snapshots; gauges show old -> new).
 """
 import argparse
 import json
+import re
 import sys
 
 _INF = float("inf")
+
+_TYPE_RE = re.compile(r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) (\w+)$")
+_HELP_RE = re.compile(r"^# HELP ([a-zA-Z_:][a-zA-Z0-9_:]*) (.*)$")
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)"
+    r"(?:\s+-?[0-9.eE+]+)?"   # optional 0.0.4 sample timestamp
+    r"(?:\s+#\s+\{.*)?$")     # trailing "# {...} v ts" = exemplar
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def _parse_value(tok):
+    if tok == "+Inf":
+        return _INF
+    if tok == "-Inf":
+        return -_INF
+    if tok == "NaN":
+        return float("nan")
+    return float(tok)
+
+
+def _parse_exposition(text):
+    """Prometheus/OpenMetrics text -> the telemetry.dump() JSON shape.
+
+    Exemplar suffixes (`` # {trace_id="..."} value ts``) are stripped:
+    they annotate a bucket observation, they are not part of the
+    sample value this tool aggregates."""
+    types, helps = {}, {}
+    hist_series = {}   # (family, labels_key) -> row dict
+    scalar_series = {}  # name -> [(labels, value)]
+    order = []
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line:
+            continue
+        m = _TYPE_RE.match(line)
+        if m:
+            types[m.group(1)] = m.group(2)
+            order.append(m.group(1))
+            continue
+        m = _HELP_RE.match(line)
+        if m:
+            helps[m.group(1)] = m.group(2)
+            continue
+        if line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            raise ValueError("unparsable exposition line: %r" % line)
+        name, labelstr, valtok = m.group(1), m.group(2) or "", m.group(3)
+        labels = dict(_LABEL_RE.findall(labelstr))
+        value = _parse_value(valtok)
+        family = re.sub(r"_(bucket|sum|count)$", "", name)
+        if types.get(family) == "histogram" and name != family:
+            le = labels.pop("le", None)
+            key = (family, tuple(sorted(labels.items())))
+            row = hist_series.setdefault(
+                key, {"labels": labels, "buckets": [], "sum": 0.0,
+                      "count": 0})
+            if name.endswith("_bucket") and le is not None:
+                row["buckets"].append([_parse_value(le), value])
+            elif name.endswith("_sum"):
+                row["sum"] = value
+            elif name.endswith("_count"):
+                row["count"] = value
+        else:
+            scalar_series.setdefault(name, []).append((labels, value))
+    metrics = {}
+    for name in order:
+        kind = types[name]
+        series = []
+        out_name = name
+        if kind == "histogram":
+            for (fam, _lk), row in sorted(hist_series.items()):
+                if fam == name:
+                    row["buckets"].sort(key=lambda b: b[0])
+                    series.append(row)
+        else:
+            rows = scalar_series.get(name)
+            if rows is None and kind == "counter":
+                # OpenMetrics counter family: TYPE names the family
+                # without _total, samples carry it — normalize back
+                # to the suffixed (registry) name
+                rows = scalar_series.get(name + "_total")
+                if rows is not None:
+                    out_name = name + "_total"
+            for labels, value in rows or []:
+                series.append({"labels": labels, "value": value})
+        metrics[out_name] = {"type": kind, "help": helps.get(name, ""),
+                             "series": series}
+    if not metrics:
+        raise ValueError("no # TYPE lines — not an exposition")
+    return {"format_version": "exposition", "time": None,
+            "metrics": metrics}
 
 
 def _load(path):
     try:
         with open(path) as f:
-            data = json.load(f)
+            text = f.read()
     except OSError as e:
         raise SystemExit("%s: cannot read (%s)" % (path, e))
-    except ValueError as e:
-        # truncated/garbage file (e.g. a dump interrupted before the
-        # atomic-writer landed): a clear message + nonzero exit, not a
-        # json traceback
-        raise SystemExit("%s: malformed JSON (%s)" % (path, e))
+    try:
+        data = json.loads(text)
+    except ValueError as json_err:
+        # not JSON: a saved /metrics scrape parses too (exemplar
+        # suffixes included); anything else is a clear message +
+        # nonzero exit, not a traceback
+        if "# TYPE" in text:
+            try:
+                return _parse_exposition(text)
+            except ValueError as e:
+                raise SystemExit("%s: malformed exposition (%s)"
+                                 % (path, e))
+        raise SystemExit("%s: malformed JSON (%s)" % (path, json_err))
     if not isinstance(data, dict) or "metrics" not in data:
         raise SystemExit("%s: not a telemetry dump (no 'metrics' key)"
                          % path)

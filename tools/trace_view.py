@@ -189,6 +189,37 @@ def print_tree(data, top):
         walk(ev, 0)
 
 
+def print_bundle_events(path):
+    """Wide-event window of a flight-recorder bundle (events.json,
+    present since the events layer landed): outcome counts per kind +
+    the writer's drop accounting — the per-request face of the crash.
+    Silent when the bundle predates the events layer."""
+    try:
+        with open(path) as f:
+            payload = json.load(f)
+    except (OSError, ValueError):
+        return
+    evs = payload.get("events") or []
+    stats = payload.get("stats") or {}
+    print()
+    print("wide events in bundle: %d (emitted %s, dropped %s)"
+          % (len(evs), stats.get("emitted", "?"),
+             stats.get("dropped", "?")))
+    counts = {}
+    for ev in evs:
+        key = (str(ev.get("kind")), str(ev.get("outcome")))
+        counts[key] = counts.get(key, 0) + 1
+    for (kind, outcome), n in sorted(counts.items()):
+        print("  %-20s %-10s %d" % (kind, outcome, n))
+    bad = [e for e in evs if e.get("outcome") != "ok"]
+    for ev in bad[-5:]:
+        print("  last %s: span %s %s" % (
+            ev.get("outcome"), ev.get("span_id"),
+            " ".join("%s=%s" % (k, ev[k])
+                     for k in ("stage", "reason", "error_kind")
+                     if ev.get(k) is not None)))
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         description="Summarize/validate mxnet_tpu chrome-trace exports")
@@ -205,6 +236,8 @@ def main(argv=None):
     data = load_trace(args.path)
     problems = validate(data)
     summarize(data, args.top)
+    if os.path.isdir(args.path):
+        print_bundle_events(os.path.join(args.path, "events.json"))
     if args.top_ops:
         print_top_ops(data, args.top_ops)
     if args.tree:
