@@ -338,6 +338,47 @@ FLAGS = {
         "128", _pint, "honored",
         "default cap on generated tokens per request (finish_reason "
         "'length'); per-submit max_new_tokens= overrides"),
+    "MXNET_DECODE_PAGED": (
+        "0", _pint, "honored",
+        "tools default engine selection (bench_decode/prewarm): 1 "
+        "builds the paged engine (generate.PagedGenerationEngine: page "
+        "pool + prefix sharing + chunked prefill) instead of the "
+        "per-slot KV ring; library callers pick the class directly"),
+    "MXNET_DECODE_PAGE_SIZE": (
+        "16", _pint, "honored",
+        "positions per KV page in the paged engine's pool; a slot "
+        "holds ceil(cache_len/page_size) pages and prefix sharing is "
+        "page-aligned (smaller pages share more, dispatch more "
+        "scatter rows)"),
+    "MXNET_DECODE_PAGES": (
+        "0", _pint, "honored",
+        "total pages in the paged engine's pool, incl. the reserved "
+        "trash page (0 = auto: slots x pages_per_slot + 1, the floor "
+        "at which admission-time allocation can never starve a "
+        "mid-flight decode)"),
+    "MXNET_DECODE_PREFILL_CHUNK": (
+        "32", _pint, "honored",
+        "chunked-prefill chunk length: prompts stream into the paged "
+        "engine this many positions per dispatch, one chunk per "
+        "TokenServer loop tick, so a long admission interleaves with "
+        "decode steps instead of stalling active lanes' ITL"),
+    "MXNET_DECODE_SPEC_K": (
+        "0", _pint, "honored",
+        "n-gram speculative decoding draft length for the paged "
+        "engine (0 = off): each decode step carries up to K drafted "
+        "tokens and verifies them in one fixed-shape dispatch; "
+        "exact-match acceptance keeps output identical to "
+        "non-speculative sampling"),
+    "MXNET_DECODE_SPEC_NGRAM": (
+        "2", _pint, "honored",
+        "suffix length the n-gram speculator matches against the "
+        "sequence's own history (prompt + generated) to source drafts"),
+    "MXNET_DECODE_PREFIX_SHARE": (
+        "1", _pint, "honored",
+        "paged-engine prefix sharing: content-hash full prompt pages "
+        "and attach later prompts with the same page-aligned prefix "
+        "to the cached pages refcounted (copy-on-write by alignment; "
+        "0 disables)"),
     "DMLC_ROLE": ("worker", str, "honored", "dist kvstore role"),
     "DMLC_PS_ROOT_URI": ("", str, "honored", "dist kvstore server host"),
     "DMLC_PS_ROOT_PORT": ("9091", _pint, "honored",

@@ -35,12 +35,30 @@ compilation" line):
   drained ``close()``), so the future HTTP front end maps decode
   failures to 429/504 exactly like predict failures.
 
+* **Paged KV cache** — :class:`PagedGenerationEngine` replaces the
+  per-slot ring with a fixed-shape page pool
+  ``(layers, pages, heads, page_size, d_head)`` plus host-side page
+  tables (same "host state flips, compiled shape stays" trick): pages
+  buy prefix sharing (a shared system prompt prefills ONCE; new
+  requests attach to its pages refcounted, copy-on-write by page
+  alignment), chunked prefill (long prompts stream in fixed-size
+  chunks interleaved with decode steps so admission never freezes
+  active lanes), and n-gram self-speculative decoding (draft K tokens
+  from a suffix match over the sequence's own history, verify all of
+  them in ONE fixed-shape dispatch; exact-match acceptance over the
+  position-keyed sampler keeps spec output bit-identical to
+  non-speculative sampling).
+
 Model protocol: any net exposing ``prefill_forward(tokens)`` /
 ``decode_forward(tokens, caches, pos)`` (see
 ``examples/transformer_lm.py``) plus a ``config`` dict with
 ``vocab_size`` / ``d_model`` / ``n_heads`` / ``n_layers`` / ``max_len``
-plugs in.  Benchmarks: ``tools/bench_decode.py`` (tokens/s/user, TTFT
-p50/p99, the >=3x KV-cache-vs-reforward acceptance number); docs:
+plugs in; the paged engine instead drives the single
+``chunk_forward(tokens, caches, start)`` entry point (one compiled
+family covers prefill chunks, decode, and the verify step).
+Benchmarks: ``tools/bench_decode.py`` (tokens/s/user, TTFT p50/p99,
+the >=3x KV-cache-vs-reforward acceptance number, plus the paged /
+prefix-share / chunked-prefill / speculative modes); docs:
 ``docs/lm_serving.md``.
 """
 from __future__ import annotations
@@ -62,9 +80,10 @@ from .serving_async import (Cancelled, DeadlineExceeded, Overloaded,
                             ReplicaFailed, ServingError, ServingFuture,
                             BurnRateShedder)
 
-__all__ = ["SamplingConfig", "GenerationEngine", "TokenServer",
-           "GenerationResult", "sample_logits", "ServingError",
-           "Overloaded", "DeadlineExceeded", "Cancelled"]
+__all__ = ["SamplingConfig", "GenerationEngine",
+           "PagedGenerationEngine", "TokenServer", "GenerationResult",
+           "sample_logits", "ServingError", "Overloaded",
+           "DeadlineExceeded", "Cancelled"]
 
 _logger = logging.getLogger("mxnet_tpu.generate")
 
@@ -626,6 +645,738 @@ class GenerationEngine:
 
 
 # ---------------------------------------------------------------------------
+# paged engine
+# ---------------------------------------------------------------------------
+
+def _ngram_draft(history, ngram, k):
+    """Draft up to ``k`` continuation tokens by suffix match: find the
+    most recent earlier occurrence of the last ``ngram`` tokens of
+    ``history`` and propose the tokens that followed it.  Pure host
+    work, O(len * ngram) worst case; returns [] when the sequence has
+    never repeated its suffix (the verify step then degrades to a plain
+    one-token decode)."""
+    n = len(history)
+    if k <= 0 or ngram <= 0 or n < ngram + 1:
+        return []
+    pat = history[-ngram:]
+    for e in range(n - 2, ngram - 2, -1):
+        if history[e - ngram + 1: e + 1] == pat:
+            return list(history[e + 1: e + 1 + k])
+    return []
+
+
+def _prefix_page_hashes(token_ids, page_size, limit):
+    """Chained content hashes of the first ``limit`` FULL prompt pages:
+    ``h_i = sha1(h_{i-1} || tokens of page i)``.  The chain makes a
+    page's identity depend on everything before it, so two prompts
+    share page i only when they agree on all of pages 0..i — exactly
+    the prefix property page attachment needs."""
+    import hashlib
+
+    hashes = []
+    prev = b""
+    for i in range(limit):
+        block = token_ids[i * page_size:(i + 1) * page_size]
+        h = hashlib.sha1(prev + block.tobytes()).hexdigest()
+        hashes.append(h)
+        prev = h.encode()
+    return hashes
+
+
+class PagedGenerationEngine:
+    """Paged/block KV-cache generation over a chunk-protocol model.
+
+    Device state is one fixed-shape page pool per K/V —
+    ``(layers, pages, heads, page_size, d_head)``, donated through every
+    dispatch — and each decode slot maps its positions onto pool pages
+    through a host-side page table (page 0 is a write-through "trash"
+    page absorbing padded/invalid positions, so shapes never change).
+    One compiled ``chunk`` function covers all three dispatch shapes:
+
+    * **prefill chunk** ``(1, prefill_chunk)`` — prompts stream in
+      fixed-size chunks (:meth:`prefill_step`, one chunk per call) so a
+      long admission interleaves with decode steps instead of stalling
+      them;
+    * **decode** ``(slots, 1)`` — every active slot advances one token;
+    * **verify** ``(slots, spec_k + 1)`` — with n-gram speculation on,
+      each step carries the current token plus up to ``spec_k`` drafted
+      tokens and verifies them all at once.  Acceptance is exact-match
+      against the position-keyed sampler (each position's key is
+      ``fold_in(lane_key, position)``), so accepted output is
+      bit-identical to what non-speculative sampling would have
+      produced — distribution preservation by construction.
+
+    **Prefix sharing** is page-aligned copy-on-write: full prompt pages
+    are content-hashed (chained, so identity implies identical prefix)
+    and registered after prefill; a later admission attaches to matching
+    pages refcounted and prefills only the tail.  Shared pages are never
+    written again (a slot's writes start at its first un-shared
+    position), so sharing needs no device-side copy; pages whose
+    refcount drops to zero stay cached (LRU) until pool pressure
+    reclaims them.
+
+    Greedy decode is token-identical to :class:`GenerationEngine` on
+    the same model.  Single-consumer, like the ring engine.
+    """
+
+    # TokenServer switches to incremental admission (admit, then one
+    # prefill chunk per loop tick) when it sees this flag
+    incremental = True
+
+    def __init__(self, net, slots=None, cache_len=None, page_size=None,
+                 num_pages=None, prefill_chunk=None, spec_k=None,
+                 spec_ngram=None, prefix_share=None, mesh=None,
+                 layout=None, dtype_policy=None, aot=None, aot_spec=None,
+                 sampling=None, device=None):
+        import jax
+        import jax.numpy as jnp
+
+        from . import aot as _aot
+        from . import dtype_policy as _dtp
+        from . import autograd
+        from . import parallel
+        from .gluon import block as block_mod
+        from .ndarray.ndarray import NDArray
+
+        for attr in ("chunk_forward", "config"):
+            if not hasattr(net, attr):
+                raise MXNetError(
+                    "PagedGenerationEngine needs a model implementing "
+                    "the chunk protocol (chunk_forward / config — see "
+                    "examples/transformer_lm.py); %s lacks %r"
+                    % (type(net).__name__, attr))
+        cfg = dict(net.config)
+        for k in ("vocab_size", "d_model", "n_heads", "n_layers",
+                  "max_len"):
+            if k not in cfg:
+                raise MXNetError("model config lacks %r (decode "
+                                 "protocol)" % k)
+        self.model_config = cfg
+        if slots is None:
+            slots = _config.get("MXNET_DECODE_SLOTS")
+        self._slots = int(slots)
+        if self._slots < 1:
+            raise MXNetError("slots must be >= 1, got %r" % (slots,))
+        if cache_len is None:
+            cache_len = min(_config.get("MXNET_DECODE_CACHE_LEN"),
+                            cfg["max_len"])
+        cache_len = int(min(cache_len, cfg["max_len"]))
+        if page_size is None:
+            page_size = _config.get("MXNET_DECODE_PAGE_SIZE")
+        self._page_size = int(page_size)
+        if self._page_size < 1:
+            raise MXNetError("page_size must be >= 1, got %r"
+                             % (page_size,))
+        self._pages_per_slot = -(-cache_len // self._page_size)
+        self._capacity = self._pages_per_slot * self._page_size
+        if num_pages is None:
+            num_pages = _config.get("MXNET_DECODE_PAGES")
+        if not num_pages:
+            # safe floor: every slot can always back its full capacity
+            # (+1 trash page), so decode-time allocation cannot starve
+            num_pages = self._slots * self._pages_per_slot + 1
+        self._num_pages = int(num_pages)
+        if self._num_pages < self._pages_per_slot + 1:
+            raise MXNetError(
+                "num_pages=%d cannot back even one slot (%d pages per "
+                "slot + the trash page)" % (self._num_pages,
+                                            self._pages_per_slot))
+        if prefill_chunk is None:
+            prefill_chunk = _config.get("MXNET_DECODE_PREFILL_CHUNK")
+        self._chunk = max(1, int(prefill_chunk))
+        if spec_k is None:
+            spec_k = _config.get("MXNET_DECODE_SPEC_K")
+        self._spec_k = max(0, int(spec_k))
+        if spec_ngram is None:
+            spec_ngram = _config.get("MXNET_DECODE_SPEC_NGRAM")
+        self._spec_ngram = max(1, int(spec_ngram))
+        if prefix_share is None:
+            prefix_share = _config.get("MXNET_DECODE_PREFIX_SHARE")
+        self._prefix_share = bool(prefix_share)
+        self.sampling = sampling if sampling is not None \
+            else SamplingConfig()
+
+        probe = NDArray(jnp.zeros(
+            (1, min(8, cfg["max_len"])), jnp.float32))
+        with autograd.pause():
+            block_mod._abstract_eval_forward(net, [probe])
+        self._net = net
+        params = list(net.collect_params().values())
+        self._param_names = [p.name for p in params]
+        dt_policy = _dtp.resolve_policy(dtype_policy)
+        self._dtype_policy = dt_policy
+        _dtp.note_policy(dt_policy, "generate")
+        self._cache_dtype = np.dtype(dt_policy.compute_dtype) \
+            if dt_policy is not None else np.dtype(np.float32)
+
+        self._mesh = parallel.resolve_mesh(mesh)
+        L, H = cfg["n_layers"], cfg["n_heads"]
+        dh = cfg["d_model"] // H
+        pool_shape = (L, self._num_pages, H, self._page_size, dh)
+        if self._mesh is not None:
+            from jax.sharding import NamedSharding
+
+            layout_obj = parallel.layout.resolve_layout(layout,
+                                                        self._mesh)
+            self.layout_name = layout_obj.name
+            res = layout_obj.resolve(
+                [(p.name, tuple(p.shape)) for p in params], self._mesh)
+            self._params = tuple(
+                jax.device_put(p.data()._data,
+                               NamedSharding(self._mesh, res.spec(p.name)))
+                for p in params)
+            pres = layout_obj.resolve(
+                [("pool_k", pool_shape), ("pool_v", pool_shape)],
+                self._mesh)
+            self._pool_sharding = NamedSharding(self._mesh,
+                                                pres.spec("pool_k"))
+        else:
+            self.layout_name = None
+            dev = device if device is not None else jax.devices()[0]
+            self._params = tuple(
+                jax.device_put(p.data()._data, dev) for p in params)
+            self._pool_sharding = dev
+        jax.block_until_ready(self._params)
+        self._pool_k = jax.device_put(
+            jnp.zeros(pool_shape, self._cache_dtype), self._pool_sharding)
+        self._pool_v = jax.device_put(
+            jnp.zeros(pool_shape, self._cache_dtype), self._pool_sharding)
+
+        # host control plane: page tables + slot state + the prefix map
+        P = self._pages_per_slot
+        self._page_table = np.zeros((self._slots, P), np.int32)
+        self._pos = np.zeros(self._slots, np.int32)
+        self._active = np.zeros(self._slots, bool)
+        self._cur_tok = np.zeros(self._slots, np.int32)
+        self._free = collections.deque(range(self._slots))
+        self._lane_keys = np.zeros((self._slots, 2), np.uint32)
+        self._free_pages = collections.deque(range(1, self._num_pages))
+        self._page_ref = np.zeros(self._num_pages, np.int32)
+        self._prefix_map = {}                 # chain hash -> page id
+        self._page_hash = {}                  # page id -> chain hash
+        self._reclaim = collections.OrderedDict()  # refcnt-0 LRU
+        self._pending = collections.OrderedDict()  # slot -> prefill st
+        self._history = {}                    # slot -> prompt+emitted
+        self.last_prefix_hit_tokens = 0
+        self._prefix_hit_tokens = 0
+        self._prefix_lookup_tokens = 0
+        self._spec_drafted = 0
+        self._spec_accepted = 0
+        self._spec_steps = 0
+        self._chunks_run = 0
+
+        gluon_params = params
+        scfg = self.sampling
+        S = self._capacity
+        cache_dtype = self._cache_dtype
+        page = self._page_size
+
+        def _cast_params(tree):
+            if dt_policy is None:
+                return tree
+            return tuple(dt_policy.cast_compute(n, a) for n, a in
+                         zip(self._param_names, tree))
+
+        def _traced(fn, params_):
+            with _dtp.scope(dt_policy), \
+                    block_mod.swapped_params(gluon_params,
+                                             _cast_params(params_)):
+                return fn()
+
+        def _cast_logits(arr):
+            if dt_policy is not None:
+                return dt_policy.cast_output(arr)
+            return arr
+
+        def chunk_fn(params_, pool_k, pool_v, page_table, tokens, start,
+                     wpage, woff, lane_keys):
+            """The one paged dispatch: gather each row's pages into a
+            linear (B, H, S, dh) cache view, run the model's
+            chunk_forward, sample EVERY chunk position with its
+            position-derived key, and scatter the chunk's K/V back to
+            the pool at (wpage, woff) — trash page 0 absorbs padded
+            positions.  tokens (B, C); page_table (B, P); wpage/woff
+            flat (B*C,)."""
+            Bc, C = tokens.shape
+
+            def run():
+                gk = jnp.moveaxis(pool_k[:, page_table], 3, 2).reshape(
+                    (L, Bc, H, S, dh))
+                gv = jnp.moveaxis(pool_v[:, page_table], 3, 2).reshape(
+                    (L, Bc, H, S, dh))
+                caches = [(gk[li], gv[li]) for li in range(L)]
+                logits_nd, chunk_caches = net.chunk_forward(
+                    tokens, caches, start)
+                return logits_nd._data, chunk_caches
+
+            logits, chunk_caches = _traced(run, params_)
+            logits = _cast_logits(logits)              # (B, C, V) f32
+            if scfg.greedy:
+                sampled = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            else:
+                pos_ids = start[:, None] + jnp.arange(C, dtype=jnp.int32)
+                keys = jax.vmap(jax.vmap(jax.random.fold_in))(
+                    jnp.broadcast_to(lane_keys[:, None, :], (Bc, C, 2)),
+                    pos_ids)
+                sampled = jax.vmap(jax.vmap(
+                    lambda lg, kk: sample_logits(lg[None, :], kk,
+                                                 scfg)[0]))(logits, keys)
+            k_new = jnp.stack([k for k, _v in chunk_caches])
+            v_new = jnp.stack([v for _k, v in chunk_caches])
+            # scatter (advanced indices split by a slice move to the
+            # FRONT of the result): values must arrive (B*C, L, H, dh)
+            kvals = k_new.astype(cache_dtype).transpose(
+                1, 3, 0, 2, 4).reshape((Bc * C, L, H, dh))
+            vvals = v_new.astype(cache_dtype).transpose(
+                1, 3, 0, 2, 4).reshape((Bc * C, L, H, dh))
+            pool_k = pool_k.at[:, wpage, :, woff, :].set(kvals)
+            pool_v = pool_v.at[:, wpage, :, woff, :].set(vvals)
+            return sampled, logits, pool_k, pool_v
+
+        self._jit_chunk = jax.jit(chunk_fn, donate_argnums=(1, 2))
+        self._aot_spec = aot_spec or (
+            "lm_decode_paged:slots%dxpages%dxpg%d"
+            % (self._slots, self._num_pages, page))
+        store = _aot.resolve_aot(aot)
+        if store is not None:
+            dtag = _dtp.policy_tag(dt_policy)
+            fp = ("dtype=%s;sampling=%s;page=%d;chunk=%d;spec=%d"
+                  % (dtag, scfg.tag, page, self._chunk, self._spec_k))
+            mext = {"dtype_policy": dtag, "sampling": scfg.tag,
+                    "page_size": page, "prefill_chunk": self._chunk,
+                    "spec_k": self._spec_k}
+            self._jit_chunk = _aot.AOTFunction(
+                self._jit_chunk, "generate:paged_chunk", store,
+                fingerprint_extra=fp, manifest_kind="generate",
+                manifest_spec=self._aot_spec, manifest_extra=mext)
+        self._H, self._dh, self._L = H, dh, L
+
+    # -- introspection ---------------------------------------------------
+
+    @property
+    def slots(self):
+        return self._slots
+
+    @property
+    def cache_len(self):
+        """Positions one slot can hold (pages_per_slot x page_size)."""
+        return self._capacity
+
+    @property
+    def page_size(self):
+        return self._page_size
+
+    @property
+    def num_pages(self):
+        """Pool pages including the reserved trash page 0."""
+        return self._num_pages
+
+    @property
+    def pages_per_slot(self):
+        return self._pages_per_slot
+
+    @property
+    def prefill_chunk(self):
+        return self._chunk
+
+    @property
+    def spec_k(self):
+        return self._spec_k
+
+    @property
+    def dtype_policy_tag(self):
+        from . import dtype_policy as _dtp
+
+        return _dtp.policy_tag(self._dtype_policy)
+
+    @property
+    def cache_dtype(self):
+        return self._cache_dtype
+
+    @property
+    def mesh_shape(self):
+        from . import parallel
+
+        return parallel.mesh_shape(self._mesh)
+
+    def active_slots(self):
+        return [int(i) for i in np.nonzero(self._active)[0]]
+
+    def free_slots(self):
+        return len(self._free)
+
+    def pending_prefill(self):
+        """Slots admitted but still streaming prefill chunks."""
+        return len(self._pending)
+
+    def position(self, slot):
+        return int(self._pos[slot])
+
+    @property
+    def last_logits(self):
+        out = getattr(self, "_last_logits", None)
+        return None if out is None else np.asarray(out)
+
+    def pages_in_use(self):
+        """Distinct pool pages referenced by live slots (trash page and
+        retained-but-unreferenced prefix pages excluded)."""
+        live = np.unique(self._page_table)
+        return int((live != 0).sum())
+
+    def prefix_hit_rate(self):
+        """Fraction of shareable prompt tokens served from the prefix
+        cache (None before any lookup)."""
+        if not self._prefix_lookup_tokens:
+            return None
+        return self._prefix_hit_tokens / self._prefix_lookup_tokens
+
+    def spec_accept_rate(self):
+        """Fraction of drafted tokens accepted by verify steps (None
+        before any draft)."""
+        if not self._spec_drafted:
+            return None
+        return self._spec_accepted / self._spec_drafted
+
+    def spec_accepted_per_step(self):
+        """Mean drafted-and-accepted tokens per verify step that
+        carried at least one draft (each such step emits 1 + this)."""
+        if not self._spec_steps:
+            return None
+        return self._spec_accepted / self._spec_steps
+
+    def occupancy(self):
+        active = int(self._active.sum()) + len(self._pending)
+        tokens = int(np.minimum(self._pos[self._active],
+                                self._capacity).sum()) \
+            if self._active.any() else 0
+        cap = self._slots * self._capacity
+        out = {"active_slots": active, "slots": self._slots,
+               "cache_tokens": tokens, "cache_capacity": cap,
+               "occupancy": tokens / cap if cap else 0.0,
+               "pages_in_use": self.pages_in_use(),
+               "pages_total": self._num_pages - 1,
+               "page_size": self._page_size,
+               "prefix_cached_pages": len(self._prefix_map),
+               "pending_prefill": len(self._pending)}
+        hr = self.prefix_hit_rate()
+        if hr is not None:
+            out["prefix_hit_rate"] = round(hr, 4)
+        ar = self.spec_accept_rate()
+        if ar is not None:
+            out["spec_accept_rate"] = round(ar, 4)
+            out["spec_accepted_per_step"] = round(
+                self.spec_accepted_per_step(), 4)
+        return out
+
+    def _note_occupancy(self):
+        occ = self.occupancy()
+        _telemetry.DECODE_ACTIVE_SLOTS.set(occ["active_slots"])
+        _telemetry.DECODE_CACHE_TOKENS.set(occ["cache_tokens"])
+        _telemetry.DECODE_PAGES_IN_USE.set(occ["pages_in_use"])
+
+    def bucket_for(self, length):
+        """Admissibility check mirroring the ring engine's API: raises
+        when ``length`` exceeds a slot's page capacity, else returns the
+        chunk-padded prefill length (advisory; prefix hits shorten the
+        actual work)."""
+        limit = min(self._capacity, self.model_config["max_len"])
+        if length > limit:
+            raise MXNetError(
+                "prompt length %d exceeds the paged cache capacity %d "
+                "(%d pages x %d positions; shorten the prompt or build "
+                "the engine with a longer cache)"
+                % (length, limit, self._pages_per_slot, self._page_size))
+        return self._chunk * (-(-length // self._chunk))
+
+    def at_capacity(self, slot):
+        return self._pos[slot] >= min(self._capacity,
+                                      self.model_config["max_len"])
+
+    # -- page bookkeeping ------------------------------------------------
+
+    def _take_page(self):
+        """A free page, reclaiming the LRU retained prefix page when
+        the free list is dry (reclaim unregisters it)."""
+        if self._free_pages:
+            return self._free_pages.popleft()
+        if self._reclaim:
+            pg, h = self._reclaim.popitem(last=False)
+            del self._prefix_map[h]
+            del self._page_hash[pg]
+            return int(pg)
+        return None
+
+    def _release_slot_pages(self, slot):
+        row = self._page_table[slot]
+        for i in range(self._pages_per_slot):
+            pg = int(row[i])
+            if pg == 0:
+                continue
+            self._page_ref[pg] -= 1
+            if self._page_ref[pg] <= 0:
+                h = self._page_hash.get(pg)
+                if h is not None:
+                    # registered prefix page: retained (LRU) until
+                    # pool pressure reclaims it — a follow-up request
+                    # with the same prompt still hits
+                    self._reclaim[pg] = h
+                    self._reclaim.move_to_end(pg)
+                else:
+                    self._free_pages.appendleft(pg)
+        row[:] = 0
+
+    def _register_prefix(self, slot, token_ids, n):
+        """After a prompt fully prefilled: register its full pages in
+        the prefix map (first writer wins; an attached page is already
+        registered under the same chain hash)."""
+        limit = min((n - 1) // self._page_size, self._pages_per_slot)
+        if limit <= 0:
+            return
+        row = self._page_table[slot]
+        for i, h in enumerate(_prefix_page_hashes(
+                token_ids, self._page_size, limit)):
+            if h in self._prefix_map:
+                continue
+            pg = int(row[i])
+            self._prefix_map[h] = pg
+            self._page_hash[pg] = h
+
+    # -- lifecycle of one sequence ---------------------------------------
+
+    def admit_incremental(self, token_ids):
+        """Claim a slot for ``token_ids``: attach any shared prefix
+        pages, allocate the remainder of the slot's pages upfront (so
+        decode can never starve mid-flight), and queue the un-shared
+        prompt tail for chunked prefill.  Returns the slot; the first
+        token arrives from the :meth:`prefill_step` that completes the
+        prompt.  Raises :class:`Overloaded` (``slots`` / ``pages``)."""
+        token_ids = np.asarray(token_ids).astype(np.int32).reshape(-1)
+        n = token_ids.size
+        if n < 1:
+            raise MXNetError("admit needs at least one prompt token")
+        self.bucket_for(n)
+        if not self._free:
+            raise Overloaded("slots", "all %d decode slots busy"
+                             % self._slots)
+        # prefix attach: longest chain of already-registered full
+        # prompt pages (never the page holding token n-1 — the tail
+        # must prefill so the first token's logits exist)
+        attached = []
+        if self._prefix_share:
+            limit = min((n - 1) // self._page_size,
+                        self._pages_per_slot)
+            hashes = _prefix_page_hashes(token_ids, self._page_size,
+                                         limit)
+            for h in hashes:
+                pg = self._prefix_map.get(h)
+                if pg is None:
+                    break
+                attached.append((h, pg))
+            self._prefix_lookup_tokens += limit * self._page_size
+            self._prefix_hit_tokens += len(attached) * self._page_size
+            _telemetry.DECODE_PREFIX_LOOKUP_TOKENS.inc(
+                limit * self._page_size)
+            _telemetry.DECODE_PREFIX_HIT_TOKENS.inc(
+                len(attached) * self._page_size)
+        self.last_prefix_hit_tokens = len(attached) * self._page_size
+        fresh = []
+        for _ in range(self._pages_per_slot - len(attached)):
+            pg = self._take_page()
+            if pg is None:
+                for p in fresh:
+                    self._free_pages.appendleft(p)
+                raise Overloaded(
+                    "pages", "page pool exhausted (%d/%d in use)"
+                    % (self.pages_in_use(), self._num_pages - 1))
+            fresh.append(pg)
+        slot = self._free.popleft()
+        row = self._page_table[slot]
+        for i, (_h, pg) in enumerate(attached):
+            if self._page_ref[pg] == 0:
+                self._reclaim.pop(pg, None)
+            self._page_ref[pg] += 1
+            row[i] = pg
+        for j, pg in enumerate(fresh):
+            self._page_ref[pg] += 1
+            row[len(attached) + j] = pg
+        start = len(attached) * self._page_size
+        self._pending[slot] = {"tokens": token_ids, "filled": start,
+                               "n": n}
+        self._history[slot] = token_ids.tolist()
+        if self.sampling.greedy:
+            self._lane_keys[slot] = 0
+        else:
+            from . import random as _random
+
+            self._lane_keys[slot] = np.asarray(_random.next_key(),
+                                               np.uint32)
+        return slot
+
+    def prefill_step(self, slot=None):
+        """Run ONE prefill chunk (round-robin across pending slots, or
+        the given ``slot``).  Returns ``(slot, first_token)`` when that
+        chunk completed its prompt, else None.  The TokenServer calls
+        this once per loop tick, interleaving long prefills with decode
+        steps; the round-robin keeps a short prompt's TTFT from hiding
+        behind a long prompt admitted just before it."""
+        if not self._pending:
+            return None
+        if slot is None:
+            slot = next(iter(self._pending))
+            self._pending.move_to_end(slot)
+        st = self._pending[slot]
+        toks, filled, n = st["tokens"], st["filled"], st["n"]
+        count = min(self._chunk, n - filled)
+        chunk = np.zeros((1, self._chunk), np.int32)
+        chunk[0, :count] = toks[filled:filled + count]
+        wpage = np.zeros(self._chunk, np.int32)
+        woff = np.zeros(self._chunk, np.int32)
+        row = self._page_table[slot]
+        for j in range(count):
+            p = filled + j
+            wpage[j] = row[p // self._page_size]
+            woff[j] = p % self._page_size
+        sampled, logits, pk, pv = self._jit_chunk(
+            self._params, self._pool_k, self._pool_v,
+            self._page_table[slot:slot + 1].copy(), chunk,
+            np.asarray([filled], np.int32), wpage, woff,
+            self._lane_keys[slot:slot + 1].copy())
+        self._pool_k, self._pool_v = pk, pv
+        self._last_logits = logits
+        self._chunks_run += 1
+        _telemetry.DECODE_PREFILL_CHUNKS.inc()
+        if filled + count < n:
+            st["filled"] = filled + count
+            return None
+        tok = int(np.asarray(sampled)[0, count - 1])
+        del self._pending[slot]
+        self._pos[slot] = n
+        self._cur_tok[slot] = tok
+        self._active[slot] = True
+        self._history[slot].append(tok)
+        if self._prefix_share:
+            self._register_prefix(slot, toks, n)
+        self._note_occupancy()
+        return slot, tok
+
+    def admit(self, token_ids, slot=None):
+        """Synchronous admission (ring-engine drop-in): claim a slot
+        and run every prefill chunk back to back.  Returns
+        ``(slot, first_token)``."""
+        sl = self.admit_incremental(token_ids)
+        while True:
+            res = self.prefill_step(slot=sl)
+            if res is not None:
+                return res
+
+    def decode_step(self):
+        """One fixed-shape step for every active slot.  Returns
+        ``{slot: [tokens...]}`` — one token per slot without
+        speculation, up to ``spec_k + 1`` with it (drafted tokens that
+        verified, plus the one token sampling always yields).  Rejected
+        drafts leave K/V at positions >= the new ``pos``; those entries
+        are masked by ``start`` and overwritten as decode advances."""
+        if not self._active.any():
+            return {}
+        B, K = self._slots, self._spec_k
+        cap = min(self._capacity, self.model_config["max_len"])
+        active = [int(b) for b in np.nonzero(self._active)[0]]
+        C = K + 1 if K > 0 else 1
+        tokens = np.zeros((B, C), np.int32)
+        drafts = {}
+        for b in active:
+            tokens[b, 0] = self._cur_tok[b]
+            if K > 0:
+                room = cap - 1 - int(self._pos[b])
+                d = _ngram_draft(self._history[b], self._spec_ngram,
+                                 min(K, room)) if room > 0 else []
+                drafts[b] = d
+                tokens[b, 1:1 + len(d)] = d
+            else:
+                drafts[b] = []
+        wpage = np.zeros(B * C, np.int32)
+        woff = np.zeros(B * C, np.int32)
+        for b in active:
+            for j in range(len(drafts[b]) + 1):
+                p = int(self._pos[b]) + j
+                wpage[b * C + j] = self._page_table[b, p // self._page_size]
+                woff[b * C + j] = p % self._page_size
+        key = self._lane_keys.copy()
+        t0 = time.perf_counter()
+        sampled, logits, pk, pv = self._jit_chunk(
+            self._params, self._pool_k, self._pool_v,
+            self._page_table.copy(), tokens,
+            self._pos.astype(np.int32).copy(), wpage, woff, key)
+        self._pool_k, self._pool_v = pk, pv
+        self._last_logits = logits
+        sampled = np.asarray(sampled)
+        _telemetry.DECODE_STEP_SECONDS.observe(time.perf_counter() - t0)
+        out = {}
+        emitted_total = 0
+        for b in active:
+            d = drafts[b]
+            acc = 0
+            while acc < len(d) and d[acc] == sampled[b, acc]:
+                acc += 1
+            emitted = [int(t) for t in sampled[b, :acc + 1]]
+            if d:
+                self._spec_drafted += len(d)
+                self._spec_accepted += acc
+                self._spec_steps += 1
+                _telemetry.DECODE_SPEC_DRAFTED.inc(len(d))
+                _telemetry.DECODE_SPEC_ACCEPTED.inc(acc)
+            out[b] = emitted
+            emitted_total += len(emitted)
+            self._cur_tok[b] = emitted[-1]
+            self._pos[b] += len(emitted)
+            self._history[b].extend(emitted)
+        _telemetry.DECODE_TOKENS.inc(emitted_total)
+        _telemetry.DECODE_BATCH_TOKENS.observe(len(out))
+        self._note_occupancy()
+        return out
+
+    def evict(self, slot, reason):
+        """Free ``slot`` (mid-prefill pendings included): drop its
+        refcounts, return private pages to the free list, park
+        refcnt-0 prefix pages in the retained LRU."""
+        pending = slot in self._pending
+        if not pending and not self._active[slot]:
+            return
+        self._pending.pop(slot, None)
+        self._history.pop(slot, None)
+        self._active[slot] = False
+        self._pos[slot] = 0
+        self._release_slot_pages(slot)
+        # LIFO slot reuse, same reproducibility rationale as the ring
+        self._free.appendleft(int(slot))
+        _telemetry.DECODE_EVICTIONS.inc(reason=reason)
+        self._note_occupancy()
+
+    def prewarm(self):
+        """Compile — or AOT-load — the three chunk-family signatures
+        (prefill chunk, decode step, and the verify step when
+        speculation is on) without executing.  Each signature is its
+        own manifest row under ``kind=generate``."""
+        from . import aot as _aot
+
+        if not isinstance(self._jit_chunk, _aot.AOTFunction):
+            return [{"label": "generate", "status": "disabled"}]
+        infos = []
+        B, P, C = self._slots, self._pages_per_slot, self._chunk
+        shapes = [(1, C), (B, 1)]
+        if self._spec_k > 0:
+            shapes.append((B, self._spec_k + 1))
+        for (nb, nc) in shapes:
+            infos.append(self._jit_chunk.prewarm(
+                self._params, self._pool_k, self._pool_v,
+                np.zeros((nb, P), np.int32), np.zeros((nb, nc), np.int32),
+                np.zeros(nb, np.int32), np.zeros(nb * nc, np.int32),
+                np.zeros(nb * nc, np.int32),
+                np.zeros((nb, 2), np.uint32)))
+        return infos
+
+
+# ---------------------------------------------------------------------------
 # continuous-batching token serving
 # ---------------------------------------------------------------------------
 
@@ -649,7 +1400,7 @@ class GenerationResult(dict):
 
 class _GenRequest:
     __slots__ = ("tokens", "future", "deadline", "t_submit", "max_new",
-                 "out", "slot", "ttft", "span", "t_pickup")
+                 "out", "slot", "ttft", "span", "t_pickup", "prefix_hit")
 
     def __init__(self, tokens, deadline, max_new, span=None):
         self.tokens = tokens
@@ -662,6 +1413,7 @@ class _GenRequest:
         self.ttft = None
         self.span = span           # detached root span (tracing on)
         self.t_pickup = None       # queue -> prefill pickup time
+        self.prefix_hit = None     # prompt tokens served by prefix pages
 
 
 class TokenServer:
@@ -693,6 +1445,10 @@ class TokenServer:
                  shed_burn_threshold=2.0, shed_window_s=30.0,
                  shed_hist=None):
         self._engine = engine
+        # paged engines admit incrementally: the loop streams one
+        # prefill chunk per tick between decode steps instead of
+        # running the whole prompt inside admission
+        self._incremental = bool(getattr(engine, "incremental", False))
         if queue_depth is None:
             queue_depth = _config.get("MXNET_DECODE_QUEUE")
         self._depth = int(queue_depth)
@@ -874,6 +1630,7 @@ class TokenServer:
             "token_request", dur_s=now - req.t_submit, stages_s=stages,
             tokens=len(req.out), prompt_tokens=int(req.tokens.size),
             ttft_s=req.ttft, slot=req.slot,
+            prefix_hit_tokens=req.prefix_hit,
             evicted=True if evicted else None,
             span_id=req.span.span_id if req.span is not None else None,
             **kw)
@@ -954,6 +1711,25 @@ class TokenServer:
                 if req.span is not None else None
             _telemetry.DECODE_QUEUE_WAIT_SECONDS.observe(
                 t_pick - req.t_submit, exemplar=ex)
+            if self._incremental:
+                # claim the slot + pages only; chunks run one per loop
+                # tick (the TTFT clock keeps running until the chunk
+                # that completes the prompt samples the first token)
+                try:
+                    slot = eng.admit_incremental(req.tokens)
+                except ServingError as e:
+                    self._fail(req, e)
+                    continue
+                except Exception as e:
+                    self._fail(req, ReplicaFailed(
+                        "prefill admission failed: %s" % (e,), cause=e))
+                    continue
+                req.slot = slot
+                req.prefix_hit = getattr(
+                    eng, "last_prefix_hit_tokens", None) or None
+                with self._cond:
+                    self._by_slot[slot] = req
+                continue
             try:
                 slot, tok = eng.admit(req.tokens)
             except ServingError as e:
@@ -1005,6 +1781,44 @@ class TokenServer:
             self._by_slot.pop(slot, None)
             self._cond.notify_all()
 
+    def _prefill_tick(self):
+        """One chunked-prefill step (incremental engines): evict
+        cancelled/expired mid-prefill requests first — no point
+        streaming chunks for a dead request — then run ONE chunk; when
+        it completes a prompt, the sampled first token starts the
+        request's delivery (TTFT observed here)."""
+        eng = self._engine
+        with self._cond:
+            stale = [(s, r) for s, r in self._by_slot.items()
+                     if r.ttft is None and
+                     (r.future.done() or
+                      (r.deadline is not None
+                       and time.monotonic() >= r.deadline))]
+        for slot, req in stale:
+            if req.future.done():          # cancelled while prefilling
+                reason = "cancelled"
+            else:
+                reason = "deadline"
+                self._fail(req, DeadlineExceeded(
+                    "prefill", "deadline hit mid-prefill"))
+            self._release(slot)
+            eng.evict(slot, reason)
+        res = eng.prefill_step()
+        if res is None:
+            return
+        slot, tok = res
+        with self._cond:
+            req = self._by_slot.get(slot)
+        if req is None:
+            eng.evict(slot, "cancelled")
+            return
+        req.ttft = time.monotonic() - req.t_submit
+        ex = {"trace_id": _tracing.TRACE_ID,
+              "span_id": req.span.span_id} \
+            if req.span is not None else None
+        _telemetry.DECODE_TTFT_SECONDS.observe(req.ttft, exemplar=ex)
+        self._deliver(req, slot, tok)
+
     def _loop(self):
         while True:
             with self._cond:
@@ -1016,6 +1830,8 @@ class TokenServer:
             try:
                 self._sweep_queue()
                 self._admissions()
+                if self._incremental:
+                    self._prefill_tick()
                 toks = self._engine.decode_step()
                 for slot, tok in toks.items():
                     with self._cond:
@@ -1023,7 +1839,13 @@ class TokenServer:
                     if req is None:
                         self._engine.evict(slot, "cancelled")
                         continue
-                    self._deliver(req, slot, tok)
+                    # paged engines may emit several verified tokens
+                    # per step; _deliver's finish rules apply per token
+                    # (speculative overshoot past eos/max_new is
+                    # truncated here, so output matches non-spec)
+                    for t in (tok if isinstance(tok, list) else [tok]):
+                        if not self._deliver(req, slot, t):
+                            break
                 if self._shedder is not None:
                     self._shedder.update()
             except Exception as e:

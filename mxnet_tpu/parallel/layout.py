@@ -285,10 +285,18 @@ register_layout(Layout("data_parallel", [
 # slots shard over the data axes (each data shard serves its own
 # sequences), heads over tp (each tp shard attends over its own heads,
 # composing with the column-parallel proj_q/k/v below: the K/V a shard
-# caches are exactly the ones its projections produce)
-_KV_CACHE_FSDP = SpecRule("kv_cache", r"cache_(k|v)$",
+# caches are exactly the ones its projections produce).  The paged
+# engine's page pool (generate.PagedGenerationEngine) resolves under
+# the SAME rule via the pool_k/pool_v names: its rank-5
+# (layers, pages, heads, page_size, d_head) arrays put the page dim
+# where slots sat — pages shard over the data axes (page ids are
+# host-side bookkeeping, every shard holds the same page's slice of
+# heads), heads over tp exactly like the ring.  An indivisible pages
+# dim (the pool carries a +1 trash page, so it is usually odd)
+# degrades to replicated on those axes while heads stay tp-sharded.
+_KV_CACHE_FSDP = SpecRule("kv_cache", r"(cache|pool)_(k|v)$",
                           (None, ("dp", "fsdp")), rank=5)
-_KV_CACHE_TP = SpecRule("kv_cache", r"cache_(k|v)$",
+_KV_CACHE_TP = SpecRule("kv_cache", r"(cache|pool)_(k|v)$",
                         (None, ("dp", "fsdp"), "tp"), rank=5)
 
 register_layout(Layout("fsdp", [

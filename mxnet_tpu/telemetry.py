@@ -840,6 +840,34 @@ DECODE_REQUESTS_FINISHED = counter(
     "mxnet_tpu_decode_requests_finished_total",
     "Generation requests resolved successfully, by finish reason "
     "(eos / length).", ("reason",))
+DECODE_PAGES_IN_USE = gauge(
+    "mxnet_tpu_decode_pages_in_use",
+    "Distinct KV page-pool pages referenced by live decode slots "
+    "(PagedGenerationEngine; trash page and retained-but-idle prefix "
+    "pages excluded).")
+DECODE_PREFIX_LOOKUP_TOKENS = counter(
+    "mxnet_tpu_decode_prefix_lookup_tokens_total",
+    "Prompt tokens eligible for prefix-cache attachment at admission "
+    "(full-page-aligned prefix positions; the prefix hit rate's "
+    "denominator).")
+DECODE_PREFIX_HIT_TOKENS = counter(
+    "mxnet_tpu_decode_prefix_hit_tokens_total",
+    "Prompt tokens served by attaching shared prefix pages instead of "
+    "re-prefilling (the prefix hit rate's numerator).")
+DECODE_PREFILL_CHUNKS = counter(
+    "mxnet_tpu_decode_prefill_chunks_total",
+    "Fixed-size prefill chunk dispatches (chunked prefill interleaves "
+    "these with decode steps so long admissions never stall active "
+    "lanes).")
+DECODE_SPEC_DRAFTED = counter(
+    "mxnet_tpu_decode_spec_drafted_total",
+    "Tokens drafted by the n-gram speculator and carried into verify "
+    "steps.")
+DECODE_SPEC_ACCEPTED = counter(
+    "mxnet_tpu_decode_spec_accepted_total",
+    "Drafted tokens accepted by exact-match verification (acceptance "
+    "rate = this over drafted; each accepted token is one decode "
+    "dispatch saved).")
 
 # device memory (sampled per train step by tracing.sample_device_memory)
 DEVICE_MEMORY_BYTES_IN_USE = gauge(
@@ -1100,6 +1128,20 @@ def statusz():
                             if q is not None else None)(
                 DECODE_TTFT_SECONDS.quantile(0.99)),
             "evictions": _label_values(DECODE_EVICTIONS, "reason"),
+            # paged-engine view (zeros until a PagedGenerationEngine
+            # runs): page-pool fill, prefix-cache effectiveness, and
+            # the speculative-decoding win per verify dispatch
+            "pages_in_use": DECODE_PAGES_IN_USE.value(),
+            "prefill_chunks": DECODE_PREFILL_CHUNKS.value(),
+            "prefix_hit_rate": (lambda hit, seen: round(hit / seen, 4)
+                                if seen else None)(
+                DECODE_PREFIX_HIT_TOKENS.value(),
+                DECODE_PREFIX_LOOKUP_TOKENS.value()),
+            "spec_accept_rate": (lambda acc, drafted:
+                                 round(acc / drafted, 4)
+                                 if drafted else None)(
+                DECODE_SPEC_ACCEPTED.value(),
+                DECODE_SPEC_DRAFTED.value()),
         },
         "checkpoint": {
             "async_queue_depth": CHECKPOINT_QUEUE_DEPTH.value(),

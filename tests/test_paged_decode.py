@@ -1,0 +1,343 @@
+"""Paged KV-cache decode (ISSUE 16 tentpole): the block-pool engine's
+correctness contract against the ring engine, plus each serving lever.
+
+Tier-1 guards:
+* paged greedy decode is TOKEN-IDENTICAL to the ring engine — on one
+  device (f32) AND under a dp=2,tp=2 mesh (the pool resolves through
+  the layout registry's `pool_k|v` rule);
+* chunked prefill produces the same tokens and decode logits as a
+  single-chunk (monolithic) prefill of the same prompt;
+* speculative decoding emits exactly the non-speculative sequence —
+  greedy and sampled (the position-keyed PRNG stream makes the
+  accept/reject path consume the same keys either way);
+* prefix sharing attaches registered pages with refcounts, parks
+  refcount-0 pages in the retained LRU on eviction, re-attaches them,
+  and reclaims them under pool pressure;
+* admission raises the typed `Overloaded` reasons (``slots`` /
+  ``pages``) and the paged TokenServer end-to-end output (chunked +
+  shared + speculative) matches the ring TokenServer's;
+* the new bench-mode ledger metrics gate in the right direction.
+
+Engine programs stay tiny (d_model 32, cache 24) for the tier-1
+budget; every paged engine compiles at most three chunk signatures.
+"""
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import generate, nd
+from mxnet_tpu.generate import Overloaded
+
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "examples"))
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "tools"))
+
+from transformer_lm import TransformerLM  # noqa: E402
+
+VOCAB, D_MODEL, N_HEADS, N_LAYERS, MAX_LEN = 48, 32, 2, 2, 24
+
+
+@pytest.fixture(scope="module")
+def lm():
+    mx.random.seed(0)
+    net = TransformerLM(vocab_size=VOCAB, d_model=D_MODEL,
+                        n_heads=N_HEADS, n_layers=N_LAYERS,
+                        max_len=MAX_LEN)
+    net.initialize(mx.init.Xavier())
+    net(nd.array(np.zeros((1, 4), np.float32)))
+    return net
+
+
+@pytest.fixture(scope="module")
+def ring(lm):
+    return generate.GenerationEngine(
+        lm, slots=3, cache_len=MAX_LEN, buckets=[8, MAX_LEN],
+        sampling=generate.SamplingConfig(greedy=True))
+
+
+@pytest.fixture(scope="module")
+def paged(lm):
+    return generate.PagedGenerationEngine(
+        lm, slots=3, cache_len=MAX_LEN, page_size=4, prefill_chunk=8,
+        sampling=generate.SamplingConfig(greedy=True))
+
+
+def _prompt(n=5, seed=0):
+    return np.random.RandomState(seed).randint(0, VOCAB, n) \
+        .astype(np.int32)
+
+
+def _drain(eng, slot, steps):
+    """``steps`` decode ticks for one slot, flattening the paged
+    engine's per-step token lists."""
+    out = []
+    for _ in range(steps):
+        got = eng.decode_step()[slot]
+        out.extend(got if isinstance(got, list) else [got])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# paged == ring, single device and meshed
+# ---------------------------------------------------------------------------
+
+def test_paged_greedy_matches_ring(ring, paged):
+    """The tentpole's correctness bar: same prompt, same greedy
+    tokens, token for token — the page-table gather/scatter is
+    semantically the ring cache."""
+    prompt = _prompt(9, seed=3)
+    r_slot, r_tok = ring.admit(prompt)
+    ref = [r_tok] + _drain(ring, r_slot, 8)
+    ring.evict(r_slot, "length")
+    p_slot, p_tok = paged.admit(prompt)
+    got = [p_tok]
+    while len(got) < len(ref):
+        got.extend(_drain(paged, p_slot, 1))
+    paged.evict(p_slot, "length")
+    assert got == ref
+
+
+def test_paged_mesh_matches_single_device(lm, paged):
+    """dp=2,tp=2: the pool shards through the layout registry
+    (slots/pages over data axes, heads over tp) and decodes the same
+    greedy tokens as the single-device paged engine."""
+    e = generate.PagedGenerationEngine(
+        lm, slots=2, cache_len=16, page_size=4,
+        prefill_chunk=8, mesh="dp=2,tp=2",
+        sampling=generate.SamplingConfig(greedy=True))
+    assert e.layout_name == "fsdp_tp"
+    assert e.mesh_shape == {"dp": 2, "tp": 2}
+    prompt = _prompt(5, seed=3)
+    slot, tok = e.admit(prompt)
+    toks = [tok] + _drain(e, slot, 4)
+    e.evict(slot, "length")
+    p_slot, p_tok = paged.admit(prompt)
+    ref = [p_tok] + _drain(paged, p_slot, 4)
+    paged.evict(p_slot, "length")
+    assert toks == ref
+
+
+# ---------------------------------------------------------------------------
+# chunked prefill == monolithic prefill
+# ---------------------------------------------------------------------------
+
+def test_chunked_prefill_matches_monolithic(lm, paged):
+    """A 10-token prompt prefilled in 3-token chunks produces the same
+    first token, the same decode tokens, and the same decode-step
+    logits as the fixture's single-chunk prefill."""
+    chunked = generate.PagedGenerationEngine(
+        lm, slots=2, cache_len=MAX_LEN, page_size=4, prefill_chunk=3,
+        sampling=generate.SamplingConfig(greedy=True))
+    prompt = _prompt(10, seed=4)
+    c_slot, c_tok = chunked.admit(prompt)
+    m_slot, m_tok = paged.admit(prompt)  # chunk 8 < 10: still 2 chunks
+    assert c_tok == m_tok
+    c_toks, m_toks = [], []
+    for _ in range(5):
+        c_toks.extend(chunked.decode_step()[c_slot])
+        m_toks.extend(paged.decode_step()[m_slot])
+        np.testing.assert_allclose(chunked.last_logits[0],
+                                   paged.last_logits[0],
+                                   rtol=0, atol=2e-5)
+    chunked.evict(c_slot, "length")
+    paged.evict(m_slot, "length")
+    assert c_toks == m_toks
+
+
+# ---------------------------------------------------------------------------
+# speculative decoding == plain decoding
+# ---------------------------------------------------------------------------
+
+def _gen_tokens(eng, prompt, n):
+    slot, tok = eng.admit(prompt)
+    out = [tok]
+    while len(out) < n:
+        out.extend(eng.decode_step()[slot])
+    eng.evict(slot, "length")
+    return out[:n]
+
+
+def test_spec_greedy_matches_plain(lm):
+    """n-gram drafts + one-shot verify emit exactly the sequential
+    greedy tokens; a repetitive prompt guarantees drafts actually
+    fire (accept-path coverage, not just the no-draft fallback)."""
+    spec = generate.PagedGenerationEngine(
+        lm, slots=2, cache_len=MAX_LEN, page_size=4, prefill_chunk=8,
+        spec_k=3, spec_ngram=2,
+        sampling=generate.SamplingConfig(greedy=True))
+    plain = generate.PagedGenerationEngine(
+        lm, slots=2, cache_len=MAX_LEN, page_size=4, prefill_chunk=8,
+        spec_k=0, sampling=generate.SamplingConfig(greedy=True))
+    prompt = np.tile(_prompt(3, seed=7), 3)[:8].astype(np.int32)
+    a = _gen_tokens(spec, prompt, 15)
+    b = _gen_tokens(plain, prompt, 15)
+    assert a == b
+    assert spec.spec_accept_rate() is not None, \
+        "the repetitive prompt must have produced drafts"
+    assert spec._spec_accepted > 0, \
+        "at least one draft must verify (accept-path coverage)"
+
+
+def test_spec_sampling_matches_plain_under_seed(lm):
+    """Sampled decode: the verify step's position-keyed PRNG stream
+    (fold_in(lane_key, pos)) makes speculative output bit-identical to
+    the plain engine under the same mx.random.seed."""
+    scfg = generate.SamplingConfig(greedy=False, top_k=8,
+                                   temperature=0.9)
+    spec = generate.PagedGenerationEngine(
+        lm, slots=2, cache_len=MAX_LEN, page_size=4, prefill_chunk=8,
+        spec_k=3, spec_ngram=2, sampling=scfg)
+    plain = generate.PagedGenerationEngine(
+        lm, slots=2, cache_len=MAX_LEN, page_size=4, prefill_chunk=8,
+        spec_k=0, sampling=scfg)
+    prompt = np.tile(_prompt(3, seed=7), 3)[:8].astype(np.int32)
+    mx.random.seed(11)
+    a = _gen_tokens(spec, prompt, 15)
+    mx.random.seed(11)
+    b = _gen_tokens(plain, prompt, 15)
+    assert a == b
+    assert all(0 <= t < VOCAB for t in a)
+
+
+# ---------------------------------------------------------------------------
+# prefix sharing: attach / refcount / retained LRU / reclaim
+# ---------------------------------------------------------------------------
+
+def test_prefix_attach_refcount_and_eviction(lm):
+    e = generate.PagedGenerationEngine(
+        lm, slots=3, cache_len=MAX_LEN, page_size=4, prefill_chunk=8,
+        prefix_share=True,
+        sampling=generate.SamplingConfig(greedy=True))
+    prompt = _prompt(9, seed=7)        # 2 full shareable pages (8 tok)
+    s1, t1 = e.admit(prompt)
+    assert e.last_prefix_hit_tokens == 0, "cold admit cannot hit"
+    shared = [int(p) for p in e._page_table[s1][:2]]
+    s2, t2 = e.admit(prompt)
+    assert e.last_prefix_hit_tokens == 8
+    assert t2 == t1, "shared-prefix admission must sample the same token"
+    assert [int(p) for p in e._page_table[s2][:2]] == shared
+    assert all(e._page_ref[p] == 2 for p in shared)
+    # the two lanes must now decode identical greedy tokens
+    steps = {s: [] for s in (s1, s2)}
+    for _ in range(4):
+        out = e.decode_step()
+        for s in steps:
+            steps[s].extend(out[s])
+    assert steps[s1] == steps[s2]
+    # detach one user: refcount drops, pages stay mapped for the other
+    e.evict(s2, "eos")
+    assert all(e._page_ref[p] == 1 for p in shared)
+    # detach the last user: refcount-0 registered pages park in the
+    # retained LRU (still hittable), not the free list
+    e.evict(s1, "eos")
+    assert all(e._page_ref[p] == 0 for p in shared)
+    assert set(shared) <= set(e._reclaim)
+    assert e.occupancy()["prefix_cached_pages"] >= 2
+    s3, _t3 = e.admit(prompt)
+    assert e.last_prefix_hit_tokens == 8, "retained pages must re-attach"
+    assert [int(p) for p in e._page_table[s3][:2]] == shared
+    e.evict(s3, "eos")
+    # pool pressure: admitting DISTINCT prompts until pages run out
+    # must reclaim the retained pages (unregistering them) before
+    # raising Overloaded("pages")
+    held = []
+    with pytest.raises(Overloaded) as ei:
+        for i in range(e.slots + 1):
+            held.append(e.admit(_prompt(9, seed=20 + i))[0])
+    assert ei.value.reason in ("slots", "pages")
+    assert not (set(shared) & set(e._reclaim)), \
+        "pool pressure must reclaim retained prefix pages"
+    for s in held:
+        e.evict(s, "length")
+
+
+def test_paged_overloaded_pages(lm):
+    # one usable page against two slots: the second admission must
+    # fail typed on pages (slot still free) and roll back cleanly
+    e = generate.PagedGenerationEngine(
+        lm, slots=2, cache_len=4, page_size=4, prefill_chunk=4,
+        num_pages=2, prefix_share=False,
+        sampling=generate.SamplingConfig(greedy=True))
+    s1, _ = e.admit(_prompt(3, seed=1))
+    assert e.free_slots() == 1
+    with pytest.raises(Overloaded) as ei:
+        e.admit(_prompt(3, seed=2))
+    assert ei.value.reason == "pages"
+    assert len(e._free_pages) == 0, "failed admission must roll back"
+    e.evict(s1, "length")
+    assert len(e._free_pages) == 1
+
+
+def test_paged_overloaded_slots(lm):
+    e = generate.PagedGenerationEngine(
+        lm, slots=2, cache_len=4, page_size=4, prefill_chunk=4,
+        num_pages=3, prefix_share=False,
+        sampling=generate.SamplingConfig(greedy=True))
+    s1, _ = e.admit(_prompt(3, seed=1))
+    s2, _ = e.admit(_prompt(3, seed=2))
+    with pytest.raises(Overloaded) as ei:
+        e.admit(_prompt(3, seed=3))
+    assert ei.value.reason == "slots"
+    e.evict(s2, "eos")
+    s3, _ = e.admit(_prompt(3, seed=4))
+    assert s3 == s2, "evicted lane must be reused (LIFO)"
+    for s in (s1, s3):
+        e.evict(s, "length")
+
+
+# ---------------------------------------------------------------------------
+# TokenServer end to end: every lever on == ring output
+# ---------------------------------------------------------------------------
+
+def test_server_paged_levers_match_ring(lm, ring):
+    """The integration bar: a paged TokenServer with chunked prefill,
+    prefix sharing, AND speculation serves the same greedy tokens as
+    the ring TokenServer, prompt for prompt."""
+    paged_eng = generate.PagedGenerationEngine(
+        lm, slots=2, cache_len=MAX_LEN, page_size=4, prefill_chunk=3,
+        spec_k=2, spec_ngram=2, prefix_share=True,
+        sampling=generate.SamplingConfig(greedy=True))
+    prompts = [_prompt(9, seed=8), _prompt(5, seed=9),
+               _prompt(9, seed=8)]   # the repeat exercises the hit path
+    ref, got = [], []
+    srv = generate.TokenServer(ring, max_new_tokens=6)
+    try:
+        for p in prompts:
+            ref.append(srv.generate(p, max_new_tokens=6,
+                                    timeout=60).tokens)
+    finally:
+        srv.close()
+    srv = generate.TokenServer(paged_eng, max_new_tokens=6)
+    try:
+        for p in prompts:
+            got.append(srv.generate(p, max_new_tokens=6,
+                                    timeout=60).tokens)
+    finally:
+        srv.close()
+    assert got == ref
+    assert paged_eng.prefix_hit_rate() is not None
+    assert paged_eng.prefix_hit_rate() > 0, \
+        "the repeated prompt must hit the prefix cache"
+
+
+# ---------------------------------------------------------------------------
+# bench-mode metrics gate in the right direction
+# ---------------------------------------------------------------------------
+
+def test_perf_gate_directions_for_paged_metrics():
+    import perf_gate
+
+    assert perf_gate.higher_is_better(
+        "lm_decode_paged_tokens_per_sec_per_user", "tokens/sec/user")
+    assert perf_gate.higher_is_better(
+        "lm_decode_prefix_share_tokens_per_sec", "tokens/sec")
+    assert perf_gate.higher_is_better(
+        "lm_decode_prefix_hit_rate", "ratio")
+    assert perf_gate.higher_is_better(
+        "lm_decode_spec_accepted_per_step", "tokens/step")
+    assert not perf_gate.higher_is_better(
+        "lm_decode_ttft_interference_p99_ms", "ms")

@@ -230,9 +230,21 @@ def _records_bench_io():
 
 
 def _records_bench_decode():
+    # every bench_decode mode: the ring bench plus the four paged-lever
+    # modes (--paged / --prefix-share / --chunked-prefill / --spec),
+    # each with its own canned result and headline metric
     import bench_decode
 
-    return bench_decode.ledger_records(bench_decode.CANNED_RESULT)
+    recs = []
+    for mode, canned in sorted(bench_decode.CANNED_MODE_RESULTS.items()):
+        recs += bench_decode.ledger_records(canned)
+    metrics = {r["metric"] for r in recs}
+    assert {"lm_decode_paged_tokens_per_sec_per_user",
+            "lm_decode_prefix_share_tokens_per_sec",
+            "lm_decode_prefix_hit_rate",
+            "lm_decode_ttft_interference_p99_ms",
+            "lm_decode_spec_accepted_per_step"} <= metrics
+    return recs
 
 
 @pytest.mark.parametrize("builder", [

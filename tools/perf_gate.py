@@ -52,10 +52,13 @@ from perf_report import backfill_file, group_runs, pl  # noqa: E402
 # Latency units regress UPWARD: the decode tier's TTFT/per-token
 # records (tools/bench_decode.py) are the first latency-bound headline
 # metrics, and gating them higher-is-better would wave regressions
-# through.
+# through.  The paged-decode levers (ISSUE 16) gate by unit too:
+# ``ratio`` (prefix hit rate) and ``tokens/step`` (accepted drafts per
+# verify step) regress DOWNWARD, while the interference TTFT rides the
+# existing ``ms`` rule.
 _HIGHER_BETTER_UNITS = {"images/sec", "img/s", "tokens/sec", "qps", "x",
                         "bool", "flops", "gb/s", "tokens/sec/user",
-                        "tokens/s/user"}
+                        "tokens/s/user", "ratio", "rate", "tokens/step"}
 _LOWER_BETTER_UNITS = {"seconds", "s", "ms", "us", "bytes", "ms/token",
                        "ms/request"}
 
@@ -68,8 +71,12 @@ def higher_is_better(metric, unit):
         return False
     m = str(metric).lower()
     if m.endswith(("_seconds", "_ms", "_latency", "_overhead_ms_per_save",
-                   "_bytes", "_ttft_p50", "_ttft_p99")):
+                   "_bytes", "_ttft_p50", "_ttft_p99", "_interference_p99")):
         return False
+    # name fallback for unitless paged-decode levers: hit rates and
+    # accepted-drafts-per-step regress downward-is-bad (higher better),
+    # which is also the default — listed here so the intent survives a
+    # default flip
     return True
 
 

@@ -198,10 +198,11 @@ def _resnet50_serving_int8(store, batch=None, dtype_policy=None):
         yield info
 
 
-@model("lm_decode", "transformer-LM generation tier: the KV-cache "
-                    "decode step plus every prefill length bucket "
-                    "(one manifest row per bucket) — warms the "
-                    "latency-bound executables a decode replica "
+@model("lm_decode", "transformer-LM generation tier: the ring engine's "
+                    "decode step plus every prefill length bucket, AND "
+                    "the paged engine's chunk family (prefill chunk, "
+                    "decode, speculative verify) — one manifest row "
+                    "per signature; warms everything a decode replica "
                     "needs at spawn")
 def _lm_decode(store, batch=None, dtype_policy=None):
     import mxnet_tpu as mx
@@ -225,6 +226,17 @@ def _lm_decode(store, batch=None, dtype_policy=None):
         aot=store, aot_spec="lm_decode", dtype_policy=dtype_policy,
         sampling=generate.SamplingConfig(greedy=True))
     for info in eng.prewarm():
+        yield info
+    # the paged replica's three chunk-family signatures: a (1, chunk)
+    # prefill chunk, the (slots, 1) decode step, and the (slots, K+1)
+    # speculative verify — same model, same spec name, so a manifest
+    # replay rebuilds both engines from this one entry point
+    paged = generate.PagedGenerationEngine(
+        lm, slots=slots, cache_len=64, page_size=16, prefill_chunk=16,
+        spec_k=2, aot=store, aot_spec="lm_decode",
+        dtype_policy=dtype_policy,
+        sampling=generate.SamplingConfig(greedy=True))
+    for info in paged.prewarm():
         yield info
 
 
@@ -346,6 +358,53 @@ def run_manifest(args):
                     for i in infos) else 2
 
 
+def _check_paged_row(e):
+    """Shape-consistency problems for one ``generate:paged_chunk``
+    manifest row (empty list = healthy).  The paged engine compiles a
+    closed family of signatures — page-pool leaves are rank-5 with the
+    page length at axis 3, and the token block is one of (1, chunk) /
+    (slots, 1) / (slots, K+1) — so a row whose recorded shapes disagree
+    with its own page_size/prefill_chunk/spec_k extras means the store
+    was written by a mismatched build and would miss at load."""
+    who = "manifest entry %s (%s)" % (e.get("key", "?")[:12],
+                                      e.get("label"))
+    page = e.get("page_size")
+    chunk = e.get("prefill_chunk")
+    spec_k = e.get("spec_k")
+    if page is None or chunk is None or spec_k is None:
+        return ["%s: paged row missing page_size/prefill_chunk/spec_k "
+                "extras" % who]
+    sig = e.get("signature") or []
+    leaves = [(tuple(s[0]), s[1]) for s in sig
+              if isinstance(s, (list, tuple)) and len(s) >= 2
+              and isinstance(s[0], (list, tuple))]
+    msgs = []
+    pools = [s for s, _d in leaves if len(s) == 5]
+    if len(pools) < 2:
+        msgs.append("%s: no page-pool leaves (rank-5) in the recorded "
+                    "signature" % who)
+    else:
+        for s in pools[:2]:
+            if s[3] != page:
+                msgs.append("%s: pool page axis %d != page_size %d"
+                            % (who, s[3], page))
+    # the model params are float leaves; the engine's only rank-2
+    # int32 leaves are, in flatten order, page_table (slots, P) then
+    # the token block (B, C)
+    rank2 = [s for s, d in leaves if len(s) == 2 and d == "int32"]
+    if len(rank2) < 2:
+        msgs.append("%s: no token-block leaf in the recorded signature"
+                    % who)
+    else:
+        width = rank2[1][1]
+        allowed = {1, chunk} | ({spec_k + 1} if spec_k else set())
+        if width not in allowed:
+            msgs.append("%s: token block width %d is none of the "
+                        "compiled family %s (chunk=%d spec_k=%d)"
+                        % (who, width, sorted(allowed), chunk, spec_k))
+    return msgs
+
+
 def run_check(args):
     from mxnet_tpu import dtype_policy as _dtp
 
@@ -353,6 +412,9 @@ def run_check(args):
     problems, stale = store.check(max_age_days=args.max_age_days)
     entries = store.entries()
     manifest, _ = store.manifest_entries()
+    for e in manifest:
+        if e.get("label") == "generate:paged_chunk":
+            problems.extend(_check_paged_row(e))
     # every manifest signature must carry a recognized dtype-policy tag
     # (a registered policy name, or "int8" for quantized artifacts): a
     # wrong tag would prewarm the wrong executable.  Rows recorded
