@@ -29,6 +29,17 @@ persistence crash-safe and training loss-spike-safe:
   building block for loss-scale backoff.
 * :func:`retry` — bounded-retry-with-backoff helper shared by the
   model-zoo download path and the serving host->device upload path.
+* Sharded (pod-scale) mode — ``CheckpointManager(sharded=True)`` makes
+  the same manager a distributed commit protocol: each process writes
+  only its *addressable* shards (one ``shard-<host>.npz`` + digest
+  sidecar per host under ``{base}.shards/``, never a full-array host
+  gather), and process 0 writes the global manifest LAST, only after a
+  cross-host barrier has confirmed every shard durable.  The manifest
+  stays the single commit mark, so interrupted sharded saves are
+  invisible and ``load()`` falls back exactly like the dense path.
+  Restore is topology-elastic: the manifest records global shapes +
+  the saving mesh/layout, hosts load only the chunks overlapping a
+  ``restrict`` map, and the trainer's reshard-on-load path resplits.
 
 Only stdlib + numpy (+ the import-light telemetry registry) at import
 time: every persistence front-end (ndarray.save, Module, gluon.Trainer,
@@ -42,11 +53,14 @@ import json
 import logging
 import os
 import random as _pyrandom
+import re
+import shutil
 import signal
 import tempfile
 import threading
 import time
 import warnings
+import weakref
 
 import numpy as np
 
@@ -57,9 +71,10 @@ from .base import MXNetError
 __all__ = ["AtomicWriteError", "CheckpointCorruptError", "NonfiniteError",
            "atomic_write", "atomic_writer", "retry", "CheckpointManager",
            "Checkpoint", "nonfinite_policy", "check_finite",
-           "NONFINITE_POLICIES"]
+           "NONFINITE_POLICIES", "validate_sharded_checkpoint"]
 
 MANIFEST_FORMAT = 1
+SHARD_FORMAT = 1
 
 _ARRAY_KEY = "array:"
 _BLOB_KEY = "blob:"
@@ -290,9 +305,73 @@ def _to_host(v):
     return np.array(v, copy=True)
 
 
+# ---------------------------------------------------------------------------
+# sharded-checkpoint chunk geometry
+# ---------------------------------------------------------------------------
+
+def _process_info():
+    """(process_index, process_count) from a live jax backend, else
+    (0, 1).  Never initializes a backend that is not already up."""
+    try:
+        import jax
+
+        return int(jax.process_index()), int(jax.process_count())
+    except Exception:
+        return 0, 1
+
+
+def _index_bounds(index, shape):
+    """Normalize a jax shard index (tuple of slices) against the global
+    ``shape`` into ``[[start, stop], ...]`` (json-friendly)."""
+    out = []
+    for sl, dim in zip(index, shape):
+        start = 0 if sl.start is None else int(sl.start)
+        stop = int(dim) if sl.stop is None else int(sl.stop)
+        out.append([start, stop])
+    return out
+
+
+def _bounds_key(bounds):
+    return tuple((int(a), int(b)) for a, b in bounds)
+
+
+def _bounds_slices(bounds):
+    return tuple(slice(a, b) for a, b in bounds)
+
+
+def _bounds_volume(bounds):
+    vol = 1
+    for a, b in bounds:
+        vol *= max(0, b - a)
+    return vol
+
+
+def _full_bounds(shape):
+    return [[0, int(d)] for d in shape]
+
+
+def _bounds_overlap(a, b):
+    """Do two bounds lists (same rank) intersect?  Rank-0 ([] vs [])
+    always overlaps."""
+    return all(lo1 < hi2 and lo2 < hi1
+               for (lo1, hi1), (lo2, hi2) in zip(a, b))
+
+
+def _is_device_sharded(v):
+    """Duck-typed jax global array: has addressable shards + a sharding
+    that can map devices to index blocks."""
+    return hasattr(v, "addressable_shards") and hasattr(v, "sharding")
+
+
 class Checkpoint:
     """One loaded checkpoint: ``step``, ``arrays`` (name -> numpy),
-    ``blobs`` (name -> bytes), ``meta`` (the user dict), ``path``."""
+    ``blobs`` (name -> bytes), ``meta`` (the user dict), ``path``.
+
+    Sharded loads additionally set ``sharded``/``n_shards``/``n_hosts``
+    (the *saving* topology), ``resharded`` (saved topology differs from
+    the loader's, when the loader passed its own via ``context=``) and
+    ``shards_read`` (shard files actually opened — under ``restrict=``
+    non-overlapping shard files are skipped entirely)."""
 
     def __init__(self, step, arrays, blobs, meta, path):
         self.step = step
@@ -300,6 +379,11 @@ class Checkpoint:
         self.blobs = blobs
         self.meta = meta
         self.path = path
+        self.sharded = False
+        self.n_shards = 1
+        self.n_hosts = 1
+        self.resharded = None
+        self.shards_read = 0
 
     def __repr__(self):
         return ("Checkpoint(step=%d, arrays=%d, blobs=%d, path=%r)"
@@ -318,10 +402,30 @@ class CheckpointManager:
     digests, wall-clock time, and arbitrary user ``meta``.  ``load()``
     verifies every digest and, when the newest checkpoint fails, warns
     loudly and falls back to the newest intact one.
+
+    ``sharded=True`` (env default ``MXNET_CKPT_SHARDED``) switches to
+    the pod-scale layout — every participating process constructs a
+    manager over the SAME (shared-filesystem) directory::
+
+        {prefix}-{step:08d}.shards/shard-{host:05d}.npz   # host h's chunks
+        {prefix}-{step:08d}.shards/shard-{host:05d}.json  # digest sidecar
+        {prefix}-{step:08d}.json                          # global manifest
+                                                          # (process 0, LAST)
+
+    Each process writes only chunks it *owns* (its addressable shards,
+    deduped so a replicated block is written by the lowest process
+    holding it — no full-array host gather ever happens).  The sidecar
+    is written after the shard data, so sidecar-present == shard
+    durable; the barrier waits for all ``n_processes`` sidecars before
+    process 0 assembles + commits the global manifest.  A crash at any
+    point before the manifest leaves only invisible debris (swept by
+    :meth:`sweep_orphans` / retention).
     """
 
     def __init__(self, directory, prefix="ckpt", keep_last=None,
-                 async_save=None, logger=None):
+                 async_save=None, logger=None, sharded=None,
+                 process_index=None, process_count=None,
+                 barrier_timeout=None):
         from . import config as _config
 
         self.directory = os.fspath(directory)
@@ -335,13 +439,33 @@ class CheckpointManager:
                              % (self.keep_last,))
         self.async_save = (_config.get("MXNET_CHECKPOINT_ASYNC")
                            if async_save is None else bool(async_save))
+        self.sharded = (_config.get("MXNET_CKPT_SHARDED")
+                        if sharded is None else bool(sharded))
+        self._process_index = process_index
+        self._process_count = process_count
+        self.barrier_timeout = (
+            _config.get("MXNET_DIST_BARRIER_TIMEOUT")
+            if barrier_timeout is None else float(barrier_timeout))
         self.logger = logger or logging.getLogger("mxnet_tpu.checkpoint")
         self.preempted = False
+        self.preempt_requested = False
         os.makedirs(self.directory, exist_ok=True)
         self._thread = None
         self._pending_error = None
         self._lock = threading.Lock()
         self._prev_handlers = {}
+        global _STATUS_MANAGER
+        _STATUS_MANAGER = weakref.ref(self)
+
+    def _procinfo(self):
+        """(process_index, process_count): explicit ctor args win, else
+        the live jax backend, else single-process."""
+        pidx, pcnt = _process_info()
+        if self._process_index is not None:
+            pidx = int(self._process_index)
+        if self._process_count is not None:
+            pcnt = int(self._process_count)
+        return pidx, pcnt
 
     # -- paths -----------------------------------------------------------
     def _base(self, step):
@@ -353,6 +477,21 @@ class CheckpointManager:
 
     def manifest_path(self, step):
         return self._base(step) + ".json"
+
+    def shard_dir(self, step):
+        return self._base(step) + ".shards"
+
+    def shard_data_path(self, step, process_index):
+        return os.path.join(self.shard_dir(step),
+                            "shard-%05d.npz" % int(process_index))
+
+    def shard_sidecar_path(self, step, process_index):
+        return os.path.join(self.shard_dir(step),
+                            "shard-%05d.json" % int(process_index))
+
+    def preempt_flag_path(self):
+        return os.path.join(self.directory,
+                            "%s-preempt.flag" % self.prefix)
 
     def steps(self):
         """Steps with a committed manifest, ascending (no verification)."""
@@ -389,18 +528,26 @@ class CheckpointManager:
         step = int(step)
         if block is None:
             block = not self.async_save
-        host = {}
-        for name, v in arrays.items():
+        for name in arrays:
             if name.startswith(_BLOB_KEY) or name.startswith(_ARRAY_KEY):
                 raise MXNetError("array name %r collides with the "
                                  "checkpoint key namespace" % (name,))
-            host[name] = _to_host(v)
         blobs = dict(blobs or {})
         for name, b in blobs.items():
             if not isinstance(b, (bytes, bytearray)):
                 raise MXNetError("blob %r must be bytes, got %s"
                                  % (name, type(b).__name__))
         meta = dict(meta or {})
+        pidx, pcnt = self._procinfo()
+        if self.sharded:
+            # per-chunk snapshot of the ADDRESSABLE shards only — the
+            # sharded path must never host-gather a full global array
+            chunks, specs = self._snapshot_shards(arrays, pidx, pcnt)
+            writer = lambda: self._write_sharded(  # noqa: E731
+                step, chunks, specs, blobs, meta, pidx, pcnt)
+        else:
+            host = {name: _to_host(v) for name, v in arrays.items()}
+            writer = lambda: self._write(step, host, blobs, meta)  # noqa: E731
         # one in-flight async save at a time: overlapping saves serialize
         # (the async-overlap contract — order preserved, none dropped)
         self.wait()
@@ -410,14 +557,14 @@ class CheckpointManager:
                 with _telemetry.span("CheckpointManager.save",
                                      _telemetry.CHECKPOINT_SAVE_SECONDS,
                                      mode="sync"):
-                    self._write(step, host, blobs, meta)
+                    writer()
             except BaseException as e:
-                self._note_save_event(step, "sync", t0, e)
+                self._note_save_event(step, "sync", t0, e, pcnt)
                 raise
-            self._note_save_event(step, "sync", t0, None)
+            self._note_save_event(step, "sync", t0, None, pcnt)
             return
         t = threading.Thread(target=self._write_guarded,
-                             args=(step, host, blobs, meta),
+                             args=(step, writer, pcnt),
                              name="ckpt-save-%d" % step, daemon=True)
         with self._lock:
             self._thread = t
@@ -430,23 +577,22 @@ class CheckpointManager:
                 self._thread = None
             raise
 
-    def _write_guarded(self, step, host, blobs, meta):
+    def _write_guarded(self, step, writer, pcnt):
         t0 = time.perf_counter()
         try:
             with _telemetry.span("CheckpointManager.save",
                                  _telemetry.CHECKPOINT_SAVE_SECONDS,
                                  mode="async"):
-                self._write(step, host, blobs, meta)
-            self._note_save_event(step, "async", t0, None)
+                writer()
+            self._note_save_event(step, "async", t0, None, pcnt)
         except BaseException as e:  # surfaced on wait()/next save
-            self._note_save_event(step, "async", t0, e)
+            self._note_save_event(step, "async", t0, e, pcnt)
             with self._lock:
                 self._pending_error = e
         finally:
             _telemetry.CHECKPOINT_QUEUE_DEPTH.dec()
 
-    @staticmethod
-    def _note_save_event(step, mode, t0, exc):
+    def _note_save_event(self, step, mode, t0, exc, pcnt=1):
         """One wide event per checkpoint save (events.py; no-op when
         emission is off)."""
         if not _events.enabled():
@@ -455,7 +601,10 @@ class CheckpointManager:
             "checkpoint_save",
             outcome="ok" if exc is None else "error",
             error_kind=type(exc).__name__ if exc is not None else None,
-            dur_s=time.perf_counter() - t0, step=step, mode=mode)
+            dur_s=time.perf_counter() - t0, step=step, mode=mode,
+            sharded=bool(self.sharded),
+            n_shards=int(pcnt) if self.sharded else 1,
+            n_hosts=int(pcnt))
 
     def _write(self, step, host, blobs, meta):
         payload = {_ARRAY_KEY + k: v for k, v in host.items()}
@@ -486,7 +635,154 @@ class CheckpointManager:
                      json.dumps(manifest, indent=1, sort_keys=True,
                                 default=str))
         self.logger.info("saved checkpoint step %d -> %s", step, data_path)
+        _telemetry.CHECKPOINT_LAST_STEP.set(step)
+        _telemetry.CHECKPOINT_LAST_UNIXTIME.set(time.time())
+        _telemetry.CHECKPOINT_SHARDS.set(1)
         self._retain()
+
+    # -- sharded save ----------------------------------------------------
+    @staticmethod
+    def _snapshot_shards(arrays, pidx, pcnt):
+        """Host-snapshot only the chunks THIS process owns.
+
+        A chunk is one addressable shard block of a device-sharded
+        array; replicated blocks (held by several processes) are owned
+        by the lowest process index holding them so every block is
+        written exactly once pod-wide.  Host-resident values (numpy,
+        NDArray, PRNG key data — fully replicated by construction) are
+        owned by process 0.  Returns ``(chunks, specs)`` where chunks
+        maps name -> [(bounds, host ndarray)] and specs carries the
+        GLOBAL shape/dtype of every array (known on every process).
+        """
+        chunks, specs = {}, {}
+        for name, v in arrays.items():
+            if _is_device_sharded(v):
+                shape = tuple(int(d) for d in v.shape)
+                specs[name] = {"shape": list(shape),
+                               "dtype": str(np.dtype(v.dtype))}
+                owners = {}
+                try:
+                    dmap = v.sharding.devices_indices_map(shape)
+                except Exception:
+                    dmap = {}
+                for dev, idx in dmap.items():
+                    key = _bounds_key(_index_bounds(idx, shape))
+                    p = int(getattr(dev, "process_index", 0))
+                    owners[key] = min(owners.get(key, p), p)
+                owned, seen = [], set()
+                for sh in v.addressable_shards:
+                    bounds = _index_bounds(sh.index, shape)
+                    key = _bounds_key(bounds)
+                    if key in seen:
+                        continue
+                    seen.add(key)
+                    if owners.get(key, 0) != pidx:
+                        continue
+                    owned.append((bounds, np.array(sh.data, copy=True)))
+                chunks[name] = owned
+            else:
+                h = _to_host(v)
+                specs[name] = {"shape": list(h.shape),
+                               "dtype": str(h.dtype)}
+                chunks[name] = ([(_full_bounds(h.shape), h)]
+                                if pidx == 0 else [])
+        return chunks, specs
+
+    def _write_sharded(self, step, chunks, specs, blobs, meta, pidx, pcnt):
+        """The distributed commit: shard npz -> digest sidecar ->
+        barrier on all sidecars -> (process 0 only) global manifest."""
+        sdir = self.shard_dir(step)
+        os.makedirs(sdir, exist_ok=True)
+        payload, table, n = {}, [], 0
+        for name in sorted(chunks):
+            for bounds, data in chunks[name]:
+                key = "chunk:%05d" % n
+                n += 1
+                payload[key] = data
+                table.append({"key": key, "array": name,
+                              "bounds": [list(b) for b in bounds],
+                              "shape": list(data.shape),
+                              "dtype": str(data.dtype),
+                              "sha256": _digest(data)})
+        if pidx == 0:
+            for bname in sorted(blobs):
+                b = bytes(blobs[bname])
+                key = "chunk:%05d" % n
+                n += 1
+                payload[key] = np.frombuffer(b, np.uint8)
+                table.append({"key": key, "blob": bname, "size": len(b),
+                              "sha256": hashlib.sha256(b).hexdigest()})
+        spath = self.shard_data_path(step, pidx)
+        with atomic_writer(spath) as f:
+            np.savez(f, **payload)
+        sidecar = {
+            "shard_format": SHARD_FORMAT,
+            "step": step,
+            "process_index": pidx,
+            "n_processes": pcnt,
+            "data_file": os.path.basename(spath),
+            "data_size": os.path.getsize(spath),
+            "chunks": table,
+        }
+        # sidecar AFTER its npz: sidecar-present == this shard durable
+        atomic_write(self.shard_sidecar_path(step, pidx),
+                     json.dumps(sidecar, indent=1, sort_keys=True))
+        sidecars = self._shard_barrier(step, sdir, pcnt)
+        if pidx != 0:
+            return
+        manifest = {
+            "format_version": MANIFEST_FORMAT,
+            "sharded": True,
+            "prefix": self.prefix,
+            "step": step,
+            "time": time.time(),
+            "n_processes": pcnt,
+            "shard_dir": os.path.basename(sdir),
+            "shards": sidecars,
+            "arrays": dict(specs),
+            "meta": meta,
+        }
+        # global manifest LAST = the pod-wide commit mark
+        atomic_write(self.manifest_path(step),
+                     json.dumps(manifest, indent=1, sort_keys=True,
+                                default=str))
+        self.logger.info("committed sharded checkpoint step %d "
+                         "(%d shard(s)) -> %s", step, pcnt, sdir)
+        _telemetry.CHECKPOINT_LAST_STEP.set(step)
+        _telemetry.CHECKPOINT_LAST_UNIXTIME.set(time.time())
+        _telemetry.CHECKPOINT_SHARDS.set(pcnt)
+        self._retain()
+
+    def _shard_barrier(self, step, sdir, pcnt):
+        """Wait until every process's digest sidecar for ``step`` is
+        durable; returns {sidecar filename -> parsed sidecar}.  The
+        sidecar is written after its shard data, so this doubles as the
+        durability barrier the manifest commit requires."""
+        deadline = time.monotonic() + max(0.1, float(self.barrier_timeout))
+        want = {os.path.basename(self.shard_sidecar_path(step, i)): i
+                for i in range(pcnt)}
+        while True:
+            got, missing = {}, []
+            for name in want:
+                try:
+                    with open(os.path.join(sdir, name)) as f:
+                        sc = json.load(f)
+                except (OSError, ValueError):
+                    missing.append(name)
+                    continue
+                if sc.get("step") != step:
+                    missing.append(name)
+                    continue
+                got[name] = sc
+            if not missing:
+                return got
+            if time.monotonic() >= deadline:
+                raise AtomicWriteError(
+                    "sharded save step %d: shard barrier timed out after "
+                    "%.1fs waiting for %s (uncommitted debris left in %s "
+                    "is invisible to readers)"
+                    % (step, self.barrier_timeout, missing, sdir))
+            time.sleep(0.02)
 
     def _retain(self):
         steps = self.steps()
@@ -499,6 +795,93 @@ class CheckpointManager:
                     os.unlink(p)
                 except OSError:
                     pass
+            shutil.rmtree(self.shard_dir(s), ignore_errors=True)
+        # aborted-save debris: shard dirs / atomic-writer temp files for
+        # steps with no manifest.  Only steps strictly below the newest
+        # COMMITTED step are swept here — every peer finished writing
+        # that step's shards before its manifest committed, so nothing
+        # below it can still be in flight (sweep_orphans at attach time
+        # handles debris above it).
+        if steps:
+            self._sweep_debris(below=steps[-1], committed=set(steps))
+
+    def orphan_shard_dirs(self):
+        """Shard directories whose step has no committed manifest —
+        leftovers of an interrupted sharded save."""
+        committed = set(self.steps())
+        out = []
+        pre = self.prefix + "-"
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return out
+        for n in sorted(names):
+            if n.startswith(pre) and n.endswith(".shards"):
+                stem = n[len(pre):-len(".shards")]
+                if stem.isdigit() and int(stem) not in committed:
+                    out.append(os.path.join(self.directory, n))
+        return out
+
+    def _sweep_debris(self, below, committed):
+        """Remove uncommitted shard dirs and stray ``.tmp`` files whose
+        step is < ``below``."""
+        pre = self.prefix + "-"
+        step_re = re.compile(re.escape(pre) + r"(\d{8})\.")
+        try:
+            names = os.listdir(self.directory)
+        except OSError:
+            return 0
+        removed = 0
+        for n in names:
+            m = step_re.match(n)
+            if not m or int(m.group(1)) >= below:
+                continue
+            s = int(m.group(1))
+            path = os.path.join(self.directory, n)
+            if n.endswith(".shards") and s not in committed:
+                shutil.rmtree(path, ignore_errors=True)
+                removed += 1
+            elif n.endswith(".tmp"):
+                try:
+                    os.unlink(path)
+                    removed += 1
+                except OSError:
+                    pass
+        return removed
+
+    def sweep_orphans(self):
+        """Remove ALL aborted-save debris: orphan shard dirs, ``.tmp``
+        files from killed atomic writes (top level and inside shard
+        dirs), and any stale preemption flag.  Call at attach/startup
+        only — never while a peer's save may be in flight."""
+        removed = 0
+        for p in self.orphan_shard_dirs():
+            shutil.rmtree(p, ignore_errors=True)
+            removed += 1
+        roots = [self.directory]
+        roots += [self.shard_dir(s) for s in self.steps()]
+        for root in roots:
+            try:
+                names = os.listdir(root)
+            except OSError:
+                continue
+            for n in names:
+                if n.endswith(".tmp"):
+                    try:
+                        os.unlink(os.path.join(root, n))
+                        removed += 1
+                    except OSError:
+                        pass
+        try:
+            os.unlink(self.preempt_flag_path())
+            removed += 1
+        except OSError:
+            pass
+        self.preempt_requested = False
+        if removed:
+            self.logger.info("swept %d aborted-save leftover(s) from %s",
+                             removed, self.directory)
+        return removed
 
     def wait(self):
         """Barrier: block until the in-flight async save (if any) has
@@ -516,7 +899,9 @@ class CheckpointManager:
             raise err
 
     # -- load ------------------------------------------------------------
-    def _load_one(self, step, verify=True):
+    def read_manifest(self, step):
+        """Parse + structurally validate the manifest for ``step``
+        (no shard/data reads).  Raises CheckpointCorruptError."""
         mpath = self.manifest_path(step)
         try:
             with open(mpath, "r") as f:
@@ -529,6 +914,27 @@ class CheckpointManager:
             raise CheckpointCorruptError(
                 "checkpoint step %d: unsupported manifest format %r"
                 % (step, manifest.get("format_version")))
+        return manifest
+
+    @staticmethod
+    def _resharded_vs(manifest, context):
+        """Did the saving topology differ from the loader's?  ``context``
+        is the loader's {"mesh_axes": ..., "layout": ...} (or None)."""
+        if not context:
+            return None
+        meta = manifest.get("meta") or {}
+        saved_axes = meta.get("mesh_axes")
+        if saved_axes is None:
+            return None
+        want_axes = dict(context.get("mesh_axes") or {})
+        return (dict(saved_axes) != want_axes
+                or meta.get("layout") != context.get("layout"))
+
+    def _load_one(self, step, verify=True, restrict=None, context=None):
+        manifest = self.read_manifest(step)
+        if manifest.get("sharded"):
+            return self._load_sharded(step, manifest, verify=verify,
+                                      restrict=restrict, context=context)
         dpath = self.data_path(step)
         try:
             with np.load(dpath, allow_pickle=False) as f:
@@ -566,10 +972,110 @@ class CheckpointManager:
                     raise CheckpointCorruptError(
                         "checkpoint step %d: blob %r digest mismatch"
                         % (step, k))
-        return Checkpoint(step, arrays, blobs, manifest.get("meta", {}),
+        ckpt = Checkpoint(step, arrays, blobs, manifest.get("meta", {}),
                           dpath)
+        ckpt.resharded = self._resharded_vs(manifest, context)
+        return ckpt
 
-    def _load_timed(self, step, verify=True):
+    def _load_sharded(self, step, manifest, verify=True, restrict=None,
+                      context=None):
+        """Assemble host arrays from per-host shard files.
+
+        ``restrict`` maps array name -> list of bounds this host
+        actually needs (its addressable blocks under the NEW topology):
+        shard files with no overlapping chunk are skipped entirely and
+        non-overlapping regions of the returned arrays stay zero —
+        elastic restore only ever reads what it will place.  Arrays
+        absent from ``restrict`` (or ``restrict=None``) load fully.
+        """
+        sdir = self.shard_dir(step)
+        specs = manifest.get("arrays", {})
+        shards = manifest.get("shards", {})
+        pcnt = int(manifest.get("n_processes", len(shards)) or 1)
+        if len(shards) != pcnt:
+            raise CheckpointCorruptError(
+                "checkpoint step %d: manifest lists %d shard(s) for %d "
+                "process(es)" % (step, len(shards), pcnt))
+
+        def wanted(chunk):
+            if restrict is None or "blob" in chunk:
+                return True
+            need = restrict.get(chunk["array"])
+            if need is None:
+                return True
+            return any(_bounds_overlap(chunk["bounds"], b) for b in need)
+
+        arrays, blobs, seen_volume = {}, {}, {}
+        shards_read = 0
+        for sname in sorted(shards):
+            sc = shards[sname]
+            want_chunks = [c for c in sc.get("chunks", []) if wanted(c)]
+            if restrict is not None and not want_chunks:
+                continue
+            spath = os.path.join(sdir, sc["data_file"])
+            try:
+                with np.load(spath, allow_pickle=False) as f:
+                    data = {c["key"]: f[c["key"]] for c in want_chunks}
+            except Exception as e:
+                raise CheckpointCorruptError(
+                    "checkpoint step %d: unreadable shard %s (%s)"
+                    % (step, spath, e))
+            shards_read += 1
+            for c in want_chunks:
+                v = data[c["key"]]
+                if verify:
+                    got = (_digest(v) if "array" in c
+                           else hashlib.sha256(v.tobytes()).hexdigest())
+                    if got != c["sha256"]:
+                        _telemetry.CHECKPOINT_SHARD_DIGEST_FAILURES.inc()
+                        raise CheckpointCorruptError(
+                            "checkpoint step %d: shard %s chunk %s (%s) "
+                            "digest mismatch (manifest %s..., file %s...)"
+                            % (step, sname, c["key"],
+                               c.get("array", c.get("blob")),
+                               c["sha256"][:12], got[:12]))
+                if "blob" in c:
+                    blobs[c["blob"]] = v.tobytes()
+                    continue
+                name = c["array"]
+                spec = specs.get(name)
+                if spec is None:
+                    raise CheckpointCorruptError(
+                        "checkpoint step %d: shard %s carries unknown "
+                        "array %r" % (step, sname, name))
+                if name not in arrays:
+                    arrays[name] = np.zeros(tuple(spec["shape"]),
+                                            dtype=np.dtype(spec["dtype"]))
+                buf = arrays[name]
+                idx = _bounds_slices(c["bounds"])
+                if buf[idx].shape != v.shape:
+                    raise CheckpointCorruptError(
+                        "checkpoint step %d: chunk %s bounds %r do not "
+                        "fit array %r %r" % (step, c["key"], c["bounds"],
+                                             name, buf.shape))
+                buf[idx] = v
+                seen_volume[name] = (seen_volume.get(name, 0)
+                                     + _bounds_volume(c["bounds"]))
+        if restrict is None and verify:
+            # full-load coverage: chunks are disjoint by the ownership
+            # rule, so summed chunk volume must equal the global volume
+            for name, spec in specs.items():
+                total = int(np.prod(spec["shape"], dtype=np.int64))
+                if seen_volume.get(name, 0) != total:
+                    raise CheckpointCorruptError(
+                        "checkpoint step %d: array %r covered %d/%d "
+                        "elements — missing or torn shard(s)"
+                        % (step, name, seen_volume.get(name, 0), total))
+        ckpt = Checkpoint(step, arrays, blobs, manifest.get("meta", {}),
+                          sdir)
+        ckpt.sharded = True
+        ckpt.n_shards = len(shards)
+        ckpt.n_hosts = pcnt
+        ckpt.shards_read = shards_read
+        ckpt.resharded = self._resharded_vs(manifest, context)
+        return ckpt
+
+    def _load_timed(self, step, verify=True, restrict=None, context=None):
         """_load_one + telemetry: load latency on success (the span
         skips failed scopes), a digest-failure count on any
         verification/structure rejection."""
@@ -577,7 +1083,8 @@ class CheckpointManager:
         try:
             with _telemetry.span("CheckpointManager.load",
                                  _telemetry.CHECKPOINT_LOAD_SECONDS):
-                out = self._load_one(step, verify=verify)
+                out = self._load_one(step, verify=verify,
+                                     restrict=restrict, context=context)
         except CheckpointCorruptError as e:
             _telemetry.CHECKPOINT_DIGEST_FAILURES.inc()
             self._note_load_event(step, t0, "digest")
@@ -593,34 +1100,48 @@ class CheckpointManager:
             # the same one-record-per-unit-of-work contract
             self._note_load_event(step, t0, type(e).__name__)
             raise
-        self._note_load_event(step, t0, None)
+        self._note_load_event(step, t0, None, ckpt=out)
         return out
 
     @staticmethod
-    def _note_load_event(step, t0, error_kind):
+    def _note_load_event(step, t0, error_kind, ckpt=None):
         if not _events.enabled():
             return
         _events.emit(
             "checkpoint_load",
             outcome="ok" if error_kind is None else "error",
             error_kind=error_kind,
-            dur_s=time.perf_counter() - t0, step=step)
+            dur_s=time.perf_counter() - t0, step=step,
+            sharded=ckpt.sharded if ckpt is not None else None,
+            n_shards=ckpt.n_shards if ckpt is not None else None,
+            n_hosts=ckpt.n_hosts if ckpt is not None else None,
+            resharded=ckpt.resharded if ckpt is not None else None)
 
-    def load(self, step=None, verify=True, fallback=True):
+    def load(self, step=None, verify=True, fallback=True, restrict=None,
+             context=None):
         """Load (and digest-verify) a checkpoint.
 
         ``step=None`` loads the newest intact checkpoint: corrupt ones
         are skipped with a LOUD warning (``fallback=False`` raises on
         the first corrupt candidate instead).  Returns a
         :class:`Checkpoint`, or None when nothing intact exists.
+
+        ``restrict`` (sharded checkpoints) maps array name -> bounds
+        list; only overlapping chunks are read (see
+        :meth:`_load_sharded`).  ``context`` is the loader's
+        {"mesh_axes", "layout"} — when given, the returned checkpoint's
+        ``resharded`` says whether the saved topology differs, and the
+        load event carries it.
         """
         self.wait()
         if step is not None:
-            return self._load_timed(int(step), verify=verify)
+            return self._load_timed(int(step), verify=verify,
+                                    restrict=restrict, context=context)
         candidates = self.steps()
         for s in reversed(candidates):
             try:
-                return self._load_timed(s, verify=verify)
+                return self._load_timed(s, verify=verify,
+                                        restrict=restrict, context=context)
             except CheckpointCorruptError as e:
                 if not fallback:
                     raise
@@ -632,9 +1153,49 @@ class CheckpointManager:
         return None
 
     # -- preemption ------------------------------------------------------
+    def request_coordinated_commit(self, step, gate=1, signum=None):
+        """Publish a pod-wide final-commit request (the coordinated
+        SIGTERM protocol): an atomic flag file in the shared checkpoint
+        directory naming a *target* step a little ahead of the
+        signalled host's committed step.  Every host polls the flag at
+        its step boundaries and commits a final sharded checkpoint at
+        the first boundary >= target — since all hosts advance their
+        committed counter by the same per-call stride from the same
+        resume point, that boundary is the SAME step on every host, so
+        the shard barrier converges."""
+        pidx, _ = self._procinfo()
+        payload = {"target_step": int(step) + max(1, int(gate)),
+                   "from_step": int(step), "host": pidx,
+                   "signal": int(signum) if signum is not None else None,
+                   "time": time.time()}
+        atomic_write(self.preempt_flag_path(),
+                     json.dumps(payload, sort_keys=True))
+        self.preempt_requested = True
+        self.logger.warning(
+            "coordinated preemption: host %d requested pod-wide final "
+            "commit at step >= %d", pidx, payload["target_step"])
+        return payload
+
+    def coordinated_commit_request(self):
+        """The pending coordinated-commit request dict, or None.  Cheap
+        enough to poll every step (one failed open when no flag)."""
+        try:
+            with open(self.preempt_flag_path()) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            return None
+
+    def clear_coordinated_commit(self):
+        self.preempt_requested = False
+        try:
+            os.unlink(self.preempt_flag_path())
+        except OSError:
+            pass
+
     def install_preemption_handler(self, state_fn,
                                    signals=(signal.SIGTERM, signal.SIGINT),
-                                   exit_code=None):
+                                   exit_code=None, coordinated=None,
+                                   gate=1):
         """Flush a final checkpoint on SIGTERM/SIGINT (preemption).
 
         ``state_fn() -> (step, arrays, blobs, meta)`` must return a
@@ -644,7 +1205,42 @@ class CheckpointManager:
         cooperative training loops can exit, then chains to the previous
         handler; ``exit_code`` forces an immediate ``os._exit`` instead
         (for plain scripts with no loop check).  Main thread only.
+
+        ``coordinated`` (default: on iff sharded with >1 process): the
+        handler does NOT save locally — a sharded save needs every
+        host's shards, and only one host got the signal.  Instead it
+        publishes a :meth:`request_coordinated_commit` flag; every
+        host's training loop observes it at a step boundary and commits
+        one pod-wide final checkpoint (``ShardedTrainer`` polls via
+        ``check_preemption``).  ``gate`` is the number of boundaries of
+        headroom the target is placed ahead, bounding dispatch drift
+        between hosts.
         """
+        if coordinated is None:
+            coordinated = self.sharded and self._procinfo()[1] > 1
+
+        def _coordinated_handler(signum, frame):
+            self.logger.warning(
+                "signal %d: requesting coordinated pod-wide final "
+                "checkpoint", signum)
+            try:
+                state = state_fn()
+                step = int(state[0]) if state is not None else 0
+                self.request_coordinated_commit(step, gate=gate,
+                                                signum=signum)
+            except Exception:
+                self.logger.exception("coordinated preemption request "
+                                      "failed")
+            finally:
+                from . import tracing as _tracing
+
+                _tracing.record_crash("preemption",
+                                      extra={"signal": int(signum),
+                                             "coordinated": True})
+            prev = self._prev_handlers.get(signum)
+            if callable(prev):
+                prev(signum, frame)
+
         def _handler(signum, frame):
             self.logger.warning(
                 "signal %d: flushing final checkpoint before preemption",
@@ -682,10 +1278,11 @@ class CheckpointManager:
             if callable(prev):
                 prev(signum, frame)
 
+        installed = _coordinated_handler if coordinated else _handler
         for sig in signals:
             self._prev_handlers[sig] = signal.getsignal(sig)
-            signal.signal(sig, _handler)
-        return _handler
+            signal.signal(sig, installed)
+        return installed
 
     def uninstall_preemption_handler(self):
         """Restore the signal handlers replaced by
@@ -693,6 +1290,141 @@ class CheckpointManager:
         for sig, prev in self._prev_handlers.items():
             signal.signal(sig, prev)
         self._prev_handlers.clear()
+
+
+# ---------------------------------------------------------------------------
+# offline sharded-checkpoint validation (tools/dryrun_multihost.py
+# --check-manifest): no live mesh, no trainer — pure file inspection
+# ---------------------------------------------------------------------------
+
+def validate_sharded_checkpoint(directory, step=None, prefix="ckpt"):
+    """Validate a committed sharded checkpoint offline.
+
+    Checks manifest schema, every shard file's presence/size, every
+    chunk digest, and that the union of chunk bounds covers each
+    array's spec'd global shape exactly (no gaps, no overlaps).
+    Returns ``(step, problems)`` — an empty ``problems`` list means the
+    checkpoint is restorable on any topology.
+    """
+    mgr = CheckpointManager(directory, prefix=prefix, keep_last=10 ** 9,
+                            async_save=False, sharded=True,
+                            process_index=0, process_count=1)
+    problems = []
+    if step is None:
+        step = mgr.latest_step()
+        if step is None:
+            return None, ["no committed checkpoint under %s" % directory]
+    step = int(step)
+    try:
+        manifest = mgr.read_manifest(step)
+    except CheckpointCorruptError as e:
+        return step, [str(e)]
+    if not manifest.get("sharded"):
+        return step, ["checkpoint step %d is not sharded (dense manifest)"
+                      % step]
+    shards = manifest.get("shards", {})
+    pcnt = int(manifest.get("n_processes", 0) or 0)
+    if len(shards) != pcnt:
+        problems.append("manifest lists %d shard(s) for %d process(es)"
+                        % (len(shards), pcnt))
+    specs = manifest.get("arrays", {})
+    covered = {name: np.zeros(tuple(spec["shape"]), dtype=bool)
+               for name, spec in specs.items()}
+    sdir = mgr.shard_dir(step)
+    for sname in sorted(shards):
+        sc = shards[sname]
+        spath = os.path.join(sdir, sc.get("data_file", sname + ".npz"))
+        if not os.path.exists(spath):
+            problems.append("missing shard file %s" % spath)
+            continue
+        size = os.path.getsize(spath)
+        if size != sc.get("data_size"):
+            problems.append("shard %s size %d != manifest %s (torn?)"
+                            % (sname, size, sc.get("data_size")))
+        try:
+            with np.load(spath, allow_pickle=False) as f:
+                data = {k: f[k] for k in f.keys()}
+        except Exception as e:
+            problems.append("unreadable shard %s (%s)" % (spath, e))
+            continue
+        for c in sc.get("chunks", []):
+            v = data.get(c["key"])
+            if v is None:
+                problems.append("shard %s: missing chunk %s"
+                                % (sname, c["key"]))
+                continue
+            got = (_digest(v) if "array" in c
+                   else hashlib.sha256(v.tobytes()).hexdigest())
+            if got != c.get("sha256"):
+                problems.append("shard %s chunk %s (%s): digest mismatch"
+                                % (sname, c["key"],
+                                   c.get("array", c.get("blob"))))
+            if "array" not in c:
+                continue
+            name = c["array"]
+            mask = covered.get(name)
+            if mask is None:
+                problems.append("shard %s chunk %s names unknown array %r"
+                                % (sname, c["key"], name))
+                continue
+            idx = _bounds_slices(c["bounds"])
+            try:
+                region = mask[idx]
+            except IndexError:
+                problems.append("chunk %s bounds %r out of range for %r"
+                                % (c["key"], c["bounds"], name))
+                continue
+            if region.shape != tuple(v.shape):
+                problems.append("chunk %s bounds %r do not match its "
+                                "data shape %r" % (c["key"], c["bounds"],
+                                                   tuple(v.shape)))
+                continue
+            if bool(np.any(region)):
+                problems.append("array %r: overlapping chunks at %r"
+                                % (name, c["bounds"]))
+            mask[idx] = True
+    for name, mask in covered.items():
+        missing = int(mask.size - np.count_nonzero(mask))
+        if missing:
+            problems.append("array %r: %d/%d elements uncovered by any "
+                            "shard (gap)" % (name, missing, mask.size))
+    return step, problems
+
+
+# ---------------------------------------------------------------------------
+# /statusz checkpoint-subsystem enrichment: the most recent manager in
+# the process reports its on-disk view (merged over telemetry's
+# counter-derived "checkpoint" subsystem dict)
+# ---------------------------------------------------------------------------
+
+_STATUS_MANAGER = None
+
+
+def _checkpoint_statusz():
+    m = _STATUS_MANAGER() if _STATUS_MANAGER is not None else None
+    if m is None:
+        return {}
+    out = {"directory": m.directory, "sharded": bool(m.sharded)}
+    try:
+        last = m.latest_step()
+        out["last_committed_step"] = last
+        if last is not None:
+            out["manifest_age_s"] = round(
+                time.time() - os.path.getmtime(m.manifest_path(last)), 3)
+            try:
+                out["shard_count"] = len(
+                    [n for n in os.listdir(m.shard_dir(last))
+                     if n.endswith(".npz")])
+            except OSError:
+                out["shard_count"] = 0
+        out["orphan_shard_dirs"] = len(m.orphan_shard_dirs())
+        out["preempt_requested"] = m.coordinated_commit_request() is not None
+    except Exception:
+        pass
+    return out
+
+
+_telemetry.register_status_provider("checkpoint", _checkpoint_statusz)
 
 
 # ---------------------------------------------------------------------------

@@ -269,6 +269,46 @@ FLAGS = {
         "1", _pbool, "honored",
         "CheckpointManager default save mode: snapshot to host, then "
         "serialize/fsync in a background thread (wait() is the barrier)"),
+    "MXNET_CKPT_SHARDED": (
+        "0", _pbool, "honored",
+        "CheckpointManager default for sharded=: every process writes "
+        "only its addressable shards (shard-<host>.npz + digest "
+        "sidecar), process 0 commits the global manifest last after "
+        "the cross-host durability barrier (pod-scale elastic "
+        "checkpoints; see docs/fault_tolerance.md)"),
+    "MXNET_DIST_COORDINATOR": (
+        "", str, "honored",
+        "jax.distributed coordinator address host:port for "
+        "parallel.bootstrap_distributed (wins over the legacy "
+        "DMLC_PS_ROOT_URI/MXTPU_COORDINATOR spellings); '' means not "
+        "configured -> single-process"),
+    "MXNET_DIST_NUM_PROCS": (
+        "0", _pint, "honored",
+        "process count for the jax.distributed bootstrap (<=1 means "
+        "single-process; falls back to DMLC_NUM_WORKER/MXTPU_NUM_PROCS)"),
+    "MXNET_DIST_PROC_ID": (
+        "-1", _pint, "honored",
+        "this process's id for the jax.distributed bootstrap (-1 = "
+        "unset -> falls back to DMLC_RANK/MXTPU_PROC_ID, then 0)"),
+    "MXNET_DIST_CONNECT_RETRIES": (
+        "3", _pint, "honored",
+        "bootstrap_distributed re-attempts after the first coordinator "
+        "connect failure (exponential backoff between attempts)"),
+    "MXNET_DIST_CONNECT_BACKOFF": (
+        "0.5", _pfloat, "honored",
+        "initial backoff seconds between coordinator connect retries "
+        "(doubles per attempt, jittered)"),
+    "MXNET_DIST_BARRIER_TIMEOUT": (
+        "120", _pfloat, "honored",
+        "sharded-save durability barrier: seconds process 0 (and every "
+        "peer) waits for all shard digest sidecars before the manifest "
+        "commit / before giving up on a dead peer"),
+    "MXNET_DIST_PREEMPT_GATE": (
+        "1", _pint, "honored",
+        "coordinated preemption commit: step-boundaries of headroom "
+        "between the signalled host's committed step and the pod-wide "
+        "final-checkpoint step (bounds host dispatch drift; raise for "
+        "deep async pipelines)"),
     "MXNET_GLUON_REPO": (
         "", str, "honored",
         "base URL for gluon model_zoo weight downloads (file:// works "

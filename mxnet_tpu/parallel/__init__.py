@@ -7,6 +7,8 @@ reference lacked (tensor parallelism, ring-attention sequence parallelism,
 microbatched pipeline parallelism).
 """
 from .mesh import (make_mesh, local_mesh, init_distributed, MeshConfig,  # noqa: F401
+                   bootstrap_distributed, distributed_env,
+                   DistributedUnavailable, UNAVAILABLE_SIGNATURES,
                    shard_map, parse_mesh, resolve_mesh, require_axes,
                    mesh_shape, MESH_AXES, DATA_AXES)
 from .layout import (SpecRule, Layout, register_layout, get_layout,  # noqa: F401

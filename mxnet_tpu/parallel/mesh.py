@@ -13,7 +13,11 @@ import os
 
 import numpy as np
 
-__all__ = ["make_mesh", "init_distributed", "local_mesh", "MeshConfig",
+from ..base import MXNetError as _MXNetError
+
+__all__ = ["make_mesh", "init_distributed", "bootstrap_distributed",
+           "distributed_env", "DistributedUnavailable",
+           "UNAVAILABLE_SIGNATURES", "local_mesh", "MeshConfig",
            "shard_map", "parse_mesh", "resolve_mesh", "require_axes",
            "mesh_shape", "MESH_AXES", "DATA_AXES"]
 
@@ -171,27 +175,117 @@ def require_axes(mesh, axes, who="this module"):
     return mesh
 
 
-def init_distributed(coordinator=None, num_processes=None, process_id=None):
-    """Multi-host bootstrap (ps-lite scheduler parity). Reads the same
-    env contract tools/launch.py sets (DMLC_PS_ROOT_URI/DMLC_RANK/...)."""
+class DistributedUnavailable(_MXNetError):
+    """jax.distributed bootstrap failed for an *environmental* reason —
+    coordinator unreachable after retries, or the backend lacks
+    multi-process collectives (CPU builds without a coordination
+    service).  Tests and tools catch this for a typed skip instead of
+    pattern-matching tracebacks.  The message embeds the underlying
+    error so log-grep classifiers (test_multihost-style signatures)
+    keep working."""
+
+
+# error-text signatures that mark a backend/environment as incapable of
+# multi-process collectives (shared with tests/test_multihost.py-style
+# typed skips)
+UNAVAILABLE_SIGNATURES = (
+    "TIMEOUT", "bootstrap failed", "DEADLINE_EXCEEDED", "UNAVAILABLE",
+    "failed to connect", "Barrier timed out", "coordination service",
+    "aren't implemented on the CPU backend", "Unable to initialize backend",
+)
+
+_DIST_INITIALIZED = False
+
+
+def distributed_env():
+    """Resolve (coordinator, num_processes, process_id) from env.
+
+    ``MXNET_DIST_COORDINATOR`` / ``MXNET_DIST_NUM_PROCS`` /
+    ``MXNET_DIST_PROC_ID`` win; the legacy ps-lite contract
+    (``DMLC_PS_ROOT_URI``+``MXTPU_COORD_PORT``, ``DMLC_NUM_WORKER``,
+    ``DMLC_RANK``) and the ``MXTPU_*`` spellings remain as fallbacks so
+    tools/launch.py keeps working.  Returns (None, 1, 0)-ish values
+    when nothing is configured."""
+    from .. import config as _config
+
+    coordinator = (_config.get("MXNET_DIST_COORDINATOR")
+                   or os.environ.get("MXTPU_COORDINATOR") or None)
+    if coordinator is None and os.environ.get("DMLC_PS_ROOT_URI"):
+        coordinator = "%s:%s" % (
+            os.environ["DMLC_PS_ROOT_URI"],
+            os.environ.get("MXTPU_COORD_PORT", "9191"))
+    num_processes = (_config.get("MXNET_DIST_NUM_PROCS")
+                     or int(os.environ.get(
+                         "DMLC_NUM_WORKER",
+                         os.environ.get("MXTPU_NUM_PROCS", "0")) or 0))
+    process_id = _config.get("MXNET_DIST_PROC_ID")
+    if process_id < 0:
+        process_id = int(os.environ.get(
+            "DMLC_RANK", os.environ.get("MXTPU_PROC_ID", "0")) or 0)
+    return coordinator, int(num_processes), int(process_id)
+
+
+def bootstrap_distributed(coordinator=None, num_processes=None,
+                          process_id=None, retries=None, backoff=None,
+                          logger=None):
+    """``jax.distributed`` bootstrap with retry-with-backoff.
+
+    Explicit args win over :func:`distributed_env`.  Returns ``False``
+    when multi-process is simply not configured (no coordinator, or
+    num_processes <= 1) and ``True`` once the distributed runtime is up
+    (idempotent: a second call on an initialized runtime is a no-op).
+    When configured but the coordinator stays unreachable after the
+    retry budget — or the jax build cannot do multi-process — raises
+    :class:`DistributedUnavailable` so callers get a *typed* skip
+    instead of an arbitrary backend traceback.  Retry knobs default to
+    ``MXNET_DIST_CONNECT_RETRIES`` / ``MXNET_DIST_CONNECT_BACKOFF``.
+    """
+    from .. import config as _config
+    from ..checkpoint import retry as _retry
+
+    env = distributed_env()
+    coordinator = coordinator if coordinator is not None else env[0]
+    num_processes = int(num_processes if num_processes is not None
+                        else env[1])
+    process_id = int(process_id if process_id is not None else env[2])
+    if not coordinator or num_processes <= 1:
+        return False
+    global _DIST_INITIALIZED
+    if _DIST_INITIALIZED:
+        return True
+    retries = (_config.get("MXNET_DIST_CONNECT_RETRIES")
+               if retries is None else int(retries))
+    backoff = (_config.get("MXNET_DIST_CONNECT_BACKOFF")
+               if backoff is None else float(backoff))
     import jax
 
-    coordinator = coordinator or os.environ.get("MXTPU_COORDINATOR") or (
-        "%s:%s" % (os.environ.get("DMLC_PS_ROOT_URI", "127.0.0.1"),
-                   os.environ.get("MXTPU_COORD_PORT", "9191"))
-        if os.environ.get("DMLC_PS_ROOT_URI") else None)
-    if coordinator is None:
-        return False
-    num_processes = num_processes or int(os.environ.get(
-        "DMLC_NUM_WORKER", os.environ.get("MXTPU_NUM_PROCS", "1")))
-    process_id = process_id if process_id is not None else int(
-        os.environ.get("DMLC_RANK", os.environ.get("MXTPU_PROC_ID", "0")))
-    if num_processes <= 1:
-        return False
-    jax.distributed.initialize(coordinator_address=coordinator,
-                               num_processes=num_processes,
-                               process_id=process_id)
+    def _connect():
+        jax.distributed.initialize(coordinator_address=coordinator,
+                                   num_processes=num_processes,
+                                   process_id=process_id)
+
+    try:
+        _retry(_connect, retries=retries, backoff=backoff,
+               exceptions=(Exception,), logger=logger)()
+    except Exception as e:
+        raise DistributedUnavailable(
+            "jax.distributed bootstrap failed (coordinator=%s "
+            "num_processes=%d process_id=%d, %d retries): %s"
+            % (coordinator, num_processes, process_id, retries,
+               e)) from e
+    _DIST_INITIALIZED = True
     return True
+
+
+def init_distributed(coordinator=None, num_processes=None, process_id=None):
+    """Multi-host bootstrap (ps-lite scheduler parity). Reads the same
+    env contract tools/launch.py sets (DMLC_PS_ROOT_URI/DMLC_RANK/...)
+    plus the ``MXNET_DIST_COORDINATOR`` knob family; retry-with-backoff and the typed
+    :class:`DistributedUnavailable` failure come from
+    :func:`bootstrap_distributed`, which this wraps."""
+    return bootstrap_distributed(coordinator=coordinator,
+                                 num_processes=num_processes,
+                                 process_id=process_id)
 
 
 def make_mesh(axes=None, devices=None):

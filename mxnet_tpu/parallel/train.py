@@ -214,7 +214,8 @@ class ShardedTrainer:
                  remat_policy=None, fusion=None, on_nonfinite=None,
                  aot=None, aot_spec=None, layout=None,
                  async_metrics=None, steps_per_call=None,
-                 metrics_every=None, fetch_depth=2, dtype_policy=None):
+                 metrics_every=None, fetch_depth=2, dtype_policy=None,
+                 distributed="auto"):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -224,8 +225,18 @@ class ShardedTrainer:
         from .. import fusion_cost as _fc
         from .. import aot as _aot
         from .. import dtype_policy as _dtp
-        from .mesh import resolve_mesh
+        from .mesh import resolve_mesh, bootstrap_distributed
         from . import layout as _layout
+
+        # pod-scale bootstrap BEFORE the first device query: when the
+        # launcher's env names a coordinator (MXNET_DIST_COORDINATOR or
+        # the DMLC_ legacy spellings), join
+        # the jax.distributed runtime; quietly single-process when not
+        # configured.  Configured-but-unreachable raises the typed
+        # DistributedUnavailable — silently training a disjoint model
+        # per host would be far worse.  distributed=False opts out.
+        if distributed:
+            bootstrap_distributed()
 
         self.net = net
         self.loss_fn = loss_fn
@@ -1178,6 +1189,10 @@ class ShardedTrainer:
                     {_signal.SIGTERM, _signal.SIGINT})
         self._flush_metrics(next_step)
         self._account(t_step0, n, raw_in, raw_label)
+        # coordinated commit BEFORE the periodic check: when it fires it
+        # sets manager.preempted, which the periodic save honors — the
+        # final checkpoint is written exactly once
+        self._maybe_coordinated_commit(next_step, n)
         self._maybe_periodic_checkpoint(next_step, n)
         return loss_out
 
@@ -1427,6 +1442,61 @@ class ShardedTrainer:
                 self.drain()
             self.save_checkpoint(m, step=next_step)
 
+    def _maybe_coordinated_commit(self, step, n, force=False):
+        """Poll the coordinated-preemption flag at a step boundary.
+
+        Under sharded multi-process checkpointing a SIGTERM on ANY host
+        does not save locally — it publishes a target step through an
+        atomic flag file in the shared checkpoint directory.  The final
+        commit then rides the first PERIODIC boundary at or past the
+        target: periodic saves are the pod's existing synchronization
+        points (every host passes each one, in order, through the shard
+        barrier), so aligning to them guarantees every host picks the
+        SAME final step without any new cross-host agreement — the flag
+        is durable before the preemptor's next shard write, hence
+        visible to every peer no later than the barrier of the commit
+        boundary.  With no periodic cadence (``period=0``) every
+        boundary qualifies; then ``MXNET_DIST_PREEMPT_GATE`` must
+        exceed the pod's worst-case step drift.
+
+        Returns True while a request is pending or was just committed
+        (training loops should exit when ``manager.preempted``).
+        """
+        m = self._ckpt_manager
+        if m is None or m.preempted or not getattr(m, "sharded", False):
+            return False
+        req = m.coordinated_commit_request()
+        if req is None:
+            return False
+        if not force:
+            if step < int(req.get("target_step", step)):
+                return True  # flag seen; commit at the gated boundary
+            P = self._ckpt_period
+            if P and (step // P) <= ((step - n) // P):
+                return True  # wait for the next pod-wide sync point
+        if self._async and self._on_nonfinite == "raise":
+            self.drain()  # same poisoned-save hazard as periodic saves
+        payload = self._checkpoint_payload()
+        if payload is None:
+            return True
+        s, arrays, blobs, meta = payload
+        meta = dict(meta)
+        meta["preempted"] = True
+        meta["coordinated"] = True
+        m.save(s, arrays, blobs=blobs, meta=meta, block=True)
+        m.preempted = True
+        m.clear_coordinated_commit()
+        return True
+
+    def check_preemption(self, force=False):
+        """Public poll for loops that pace themselves (e.g. between
+        epochs).  ``force=True`` commits at the CURRENT step even off
+        the periodic cadence or below the gated target — the
+        end-of-data backstop, where every host sits at the same final
+        step by construction."""
+        return self._maybe_coordinated_commit(self.global_step, 0,
+                                              force=force)
+
     def _record_step_cost(self, raw_in, raw_label):
         """One-time XLA cost attribution for the compiled step.
 
@@ -1480,14 +1550,73 @@ class ShardedTrainer:
         """
         self._ckpt_manager = manager
         self._ckpt_period = int(period)
+        if getattr(manager, "sharded", False) and \
+                manager._procinfo()[0] == 0:
+            # attach is the one moment no peer can be mid-save (workers
+            # attach before their first step, and the first dispatch
+            # costs a compile — far longer than this sweep): process 0
+            # alone clears aborted-save debris and any stale preemption
+            # flag a previous incarnation left behind
+            manager.sweep_orphans()
         if auto_resume:
-            ckpt = manager.load()
+            ckpt = manager.load(
+                restrict=self._elastic_restrict(manager),
+                context={"mesh_axes": self.mesh_shape,
+                         "layout": self.layout_name})
             if ckpt is not None:
                 self.restore_checkpoint(ckpt)
                 _telemetry.TRAIN_RESUMES.inc()
+                if getattr(ckpt, "resharded", False) and \
+                        getattr(ckpt, "sharded", False):
+                    _telemetry.ELASTIC_RESUMES.inc()
         if install_signal_handler:
-            manager.install_preemption_handler(self._checkpoint_payload)
+            from .. import config as _config
+
+            gate = max(1, int(_config.get("MXNET_DIST_PREEMPT_GATE"))) \
+                * max(1, self.steps_per_call)
+            manager.install_preemption_handler(self._checkpoint_payload,
+                                               gate=gate)
         return self.global_step
+
+    def _elastic_restrict(self, manager):
+        """Bounds map of THIS process's addressable blocks (params +
+        optimizer leaves) so a sharded restore reads only overlapping
+        shard files.  None (= load everything) for single-process runs,
+        deferred-shape params, or dense managers."""
+        import jax
+
+        if not getattr(manager, "sharded", False) \
+                or jax.process_count() <= 1 \
+                or self.param_arrays is None:
+            return None
+        from ..checkpoint import _index_bounds
+
+        def bounds_of(a):
+            if not hasattr(a, "addressable_shards") \
+                    or getattr(a, "sharding", None) is None:
+                return None
+            out, seen = [], set()
+            for sh in a.addressable_shards:
+                b = _index_bounds(sh.index, a.shape)
+                k = tuple(tuple(x) for x in b)
+                if k not in seen:
+                    seen.add(k)
+                    out.append(b)
+            return out
+
+        restrict = {}
+        for i, a in enumerate(self.param_arrays):
+            b = bounds_of(a)
+            if b is not None:
+                restrict["param:%04d" % i] = b
+        for i, leaf in enumerate(
+                jax.tree_util.tree_leaves(self.opt_state)):
+            b = bounds_of(leaf)
+            if b is not None:
+                restrict["opt:%04d" % i] = b
+        # "rng" and any host-resident leaves are absent from the map —
+        # _load_sharded loads unlisted names in full on every host
+        return restrict or None
 
     def _checkpoint_payload(self, step=None):
         """(step, arrays, blobs, meta) from the last committed snapshot."""
@@ -1513,12 +1642,14 @@ class ShardedTrainer:
         meta = {"kind": "sharded_trainer", "step": int(gstep),
                 "optimizer": self._opt_name,
                 "param_names": [p.name for p in self._params],
-                # the saving topology: arrays in the .npz are FULL
-                # (host-gathered) so a restore under a different mesh
-                # shape resplits them (reshard-on-load; _apply_restore
+                # the saving topology: dense saves host-gather FULL
+                # arrays; sharded saves keep global shapes in the
+                # manifest instead — either way a restore under a
+                # different mesh shape resplits on load (_apply_restore
                 # detects and counts the topology change)
                 "mesh_axes": self.mesh_shape,
                 "layout": self.layout_name,
+                "n_processes": int(jax.process_count()),
                 # the precision recipe the state was trained under (the
                 # loss-scale leaf rides the opt:* arrays when active)
                 "dtype_policy": self.dtype_policy_tag}
@@ -1568,7 +1699,13 @@ class ShardedTrainer:
         if sh is None:
             return jax.device_put(val)
         if jax.process_count() > 1:
-            return jax.make_array_from_process_local_data(sh, val)
+            # val holds the GLOBAL array with this host's addressable
+            # regions populated (restricted sharded loads zero-fill the
+            # rest); the callback is only invoked for addressable
+            # device indices, so no host ever reads a region it didn't
+            # load and no cross-host gather happens.
+            return jax.make_array_from_callback(
+                tuple(val.shape), sh, lambda idx: val[idx])
         return jax.device_put(val, sh)
 
     def _apply_restore(self, ckpt):

@@ -726,6 +726,28 @@ CHECKPOINT_QUEUE_DEPTH = gauge(
 CHECKPOINT_DIGEST_FAILURES = counter(
     "mxnet_tpu_checkpoint_digest_failures_total",
     "Checkpoints rejected by digest/structure verification.")
+CHECKPOINT_SHARD_DIGEST_FAILURES = counter(
+    "mxnet_tpu_checkpoint_shard_digest_failures_total",
+    "Sharded-checkpoint chunks rejected by per-chunk SHA-256 "
+    "verification (a torn or tampered shard-<host>.npz; the load falls "
+    "back to the newest intact step).")
+ELASTIC_RESUMES = counter(
+    "mxnet_tpu_elastic_resumes_total",
+    "Resumes from a SHARDED checkpoint whose saving topology (mesh "
+    "axes/layout/host count) differed from the restoring trainer's — "
+    "the save-on-N / resume-on-M path.")
+CHECKPOINT_LAST_STEP = gauge(
+    "mxnet_tpu_checkpoint_last_step",
+    "Step of the most recently COMMITTED checkpoint (manifest "
+    "written); 0 until the first commit in this process.")
+CHECKPOINT_LAST_UNIXTIME = gauge(
+    "mxnet_tpu_checkpoint_last_unixtime",
+    "Unix time of the most recent checkpoint commit (manifest age = "
+    "now - this; 0 until the first commit in this process).")
+CHECKPOINT_SHARDS = gauge(
+    "mxnet_tpu_checkpoint_shards",
+    "Shard files in the most recently committed checkpoint (1 for a "
+    "dense save, n_processes for a sharded one).")
 
 # serving
 SERVING_REQUESTS = counter(
@@ -1146,10 +1168,18 @@ def statusz():
         "checkpoint": {
             "async_queue_depth": CHECKPOINT_QUEUE_DEPTH.value(),
             "digest_failures": CHECKPOINT_DIGEST_FAILURES.value(),
+            "shard_digest_failures":
+                CHECKPOINT_SHARD_DIGEST_FAILURES.value(),
             "saves": (CHECKPOINT_SAVE_SECONDS.count(mode="sync")
                       + CHECKPOINT_SAVE_SECONDS.count(mode="async")),
             "loads": CHECKPOINT_LOAD_SECONDS.count(),
             "reshards": CHECKPOINT_RESHARDS.value(),
+            "elastic_resumes": ELASTIC_RESUMES.value(),
+            "last_committed_step": int(CHECKPOINT_LAST_STEP.value()),
+            "manifest_age_s": (
+                round(time.time() - CHECKPOINT_LAST_UNIXTIME.value(), 3)
+                if CHECKPOINT_LAST_UNIXTIME.value() else None),
+            "shard_count": int(CHECKPOINT_SHARDS.value()),
         },
         "events": {"enabled": False},
     }

@@ -1,0 +1,435 @@
+"""Subprocess body for the multi-process elastic-checkpoint drills.
+
+Launched by :class:`mxnet_tpu.testing.faults.WorkerFleet` (tests) or by
+hand::
+
+    python -m mxnet_tpu.testing.elastic_worker --dir /tmp/ckpt \\
+        --steps 6 --save-every 2
+
+One rank of a deterministic pod, in one of two modes:
+
+* ``--mode protocol`` (default) — NO collectives, NO ``jax.distributed``:
+  rank/pod size come from the ``MXNET_DIST_PROC_ID`` /
+  ``MXNET_DIST_NUM_PROCS`` env, the "model" is a
+  per-rank-owned numpy block updated by a pure function of the step, and
+  device sharding is duck-typed through
+  :class:`~mxnet_tpu.testing.faults.FakeShardedArray`.  Everything the
+  sharded checkpoint layer does — per-host shard write, digest sidecar,
+  cross-host barrier, process-0 manifest commit, restricted elastic
+  restore, coordinated preemption — runs for REAL across OS processes,
+  and because no floating-point reduction ever crosses ranks the
+  trajectory is bit-for-bit identical on ANY topology (save on N,
+  resume on N/2 or 1).  This is what makes the kill-and-resume matrix
+  deterministic on a CPU-only host.
+* ``--mode trainer`` — the full path: ``parallel.bootstrap_distributed``
+  joins ``jax.distributed``, a real fsdp-sharded ``ShardedTrainer``
+  steps and checkpoints.  Backends without multi-process collectives
+  (jax's CPU backend) make the step fail with a signature from
+  ``parallel.UNAVAILABLE_SIGNATURES``; the worker then prints
+  ``ELASTIC_UNAVAILABLE`` and exits 42 — the typed environmental skip
+  (same contract as tools/dryrun_multihost.py / tests/test_multihost).
+
+Fault hooks (deterministic, keyed on step + rank):
+
+* ``--kill-save-step S --kill-save-rank R`` — rank R hard-dies
+  (``os._exit``) MID-shard-write during the save at step S via
+  :func:`faults.kill_on_atomic_write`; surviving ranks hit the shard
+  barrier timeout, print ``ELASTIC_SAVE_ABORTED`` and exit 3.
+* ``--preempt-step S --preempt-rank R`` — rank R SIGTERMs itself right
+  before step S: the coordinated handler publishes the commit flag and
+  ALL ranks converge on ONE final manifest (``ELASTIC_PREEMPT_COMMIT``).
+
+Markers on stdout (machine-parsed by tests/test_elastic_checkpoint.py):
+
+* ``ELASTIC_RESUMED rank=R step=S``
+* ``ELASTIC_BLOCK rank=R step=S block=B <sha256>`` — protocol mode:
+  digest of fixed row-block B of the state; block granularity is
+  topology-independent, so digests compare across pod sizes.
+* ``ELASTIC_LOSS rank=R step=S <float repr>`` — trainer mode.
+* ``ELASTIC_SAVE_ABORTED rank=R step=S kind=<exc>`` (exit 3)
+* ``ELASTIC_PREEMPT_COMMIT rank=R step=S``
+* ``ELASTIC_UNAVAILABLE <reason>`` (exit 42 — environmental skip)
+* ``ELASTIC_DONE rank=R step=S``
+"""
+from __future__ import annotations
+
+import argparse
+import hashlib
+import os
+import sys
+import time
+
+ROWS_PER_BLOCK = 4
+D = 6
+
+
+# ---------------------------------------------------------------------------
+# protocol mode: the commit protocol across real processes, no collectives
+# ---------------------------------------------------------------------------
+
+def _protocol_init(blocks):
+    import numpy as np
+
+    rng = np.random.RandomState(7)
+    W = (rng.rand(blocks * ROWS_PER_BLOCK, D) * 0.1).astype(np.float32)
+    M = np.zeros_like(W)
+    return W, M
+
+
+def _protocol_update(W, M, step, lo, hi):
+    """One training 'step' on this rank's rows — a pure function of
+    (state, step) touching ONLY [lo:hi), so the global trajectory is the
+    concatenation of independent per-block trajectories: identical bytes
+    no matter how many ranks computed it."""
+    import numpy as np
+
+    G = np.random.RandomState(1000 + int(step)) \
+        .rand(*W.shape).astype(np.float32)
+    W[lo:hi] = 0.9 * W[lo:hi] + 0.1 * G[lo:hi]
+    M[lo:hi] = 0.8 * M[lo:hi] + 0.2 * W[lo:hi]
+
+
+def _emit_blocks(W, M, blocks, lo, hi, rank, step):
+    import numpy as np
+
+    for b in range(blocks):
+        blo, bhi = b * ROWS_PER_BLOCK, (b + 1) * ROWS_PER_BLOCK
+        if blo < lo or bhi > hi:
+            continue  # not (wholly) this rank's — peer prints it
+        h = hashlib.sha256()
+        h.update(np.ascontiguousarray(W[blo:bhi]).tobytes())
+        h.update(np.ascontiguousarray(M[blo:bhi]).tobytes())
+        print("ELASTIC_BLOCK rank=%d step=%d block=%d %s"
+              % (rank, step, b, h.hexdigest()), flush=True)
+
+
+def _attach_barrier(directory, run_id, rank, nprocs, mgr, timeout=60.0):
+    """Startup rendezvous: rank 0 sweeps aborted-save debris, THEN every
+    rank writes an attach mark and waits for all N — no rank can begin
+    its first save while the sweep might still be running."""
+    if nprocs <= 1:
+        mgr.sweep_orphans()
+        return
+    from mxnet_tpu.checkpoint import atomic_write
+
+    def _wait_for(paths, deadline):
+        while not all(os.path.exists(p) for p in paths):
+            if time.monotonic() > deadline:
+                raise RuntimeError(
+                    "attach barrier timed out (run %s rank %d)"
+                    % (run_id, rank))
+            time.sleep(0.02)
+
+    deadline = time.monotonic() + timeout
+    marks = [os.path.join(directory, ".attach-%s-%d" % (run_id, r))
+             for r in range(nprocs)]
+    if rank == 0:
+        for f in os.listdir(directory):
+            if f.startswith(".attach-") and \
+                    not f.startswith(".attach-%s-" % run_id):
+                try:
+                    os.unlink(os.path.join(directory, f))
+                except OSError:
+                    pass
+        mgr.sweep_orphans()
+    else:
+        # the sweep unlinks stray *.tmp in the dir — including an
+        # in-flight atomic mark — so peers hold their marks until rank
+        # 0's post-sweep mark proves the sweep is over
+        _wait_for(marks[:1], deadline)
+    atomic_write(marks[rank], "1")
+    _wait_for(marks, deadline)
+
+
+def run_protocol(a):
+    import numpy as np
+
+    from mxnet_tpu import checkpoint as ck
+    from mxnet_tpu.testing import faults
+
+    rank = max(0, int(os.environ.get("MXNET_DIST_PROC_ID", "0")))
+    nprocs = max(1, int(os.environ.get("MXNET_DIST_NUM_PROCS", "1")))
+    blocks = int(a.blocks)
+    if blocks % nprocs:
+        raise SystemExit("--blocks %d not divisible by %d ranks"
+                         % (blocks, nprocs))
+    rows = blocks * ROWS_PER_BLOCK
+    lo, hi = rank * rows // nprocs, (rank + 1) * rows // nprocs
+
+    W, M = _protocol_init(blocks)
+    mgr = ck.CheckpointManager(a.dir, keep_last=a.keep_last,
+                               async_save=False, sharded=True,
+                               process_index=rank, process_count=nprocs)
+    _attach_barrier(a.dir, a.run_id, rank, nprocs, mgr)
+
+    if a.kill_save_step > 0 and rank == a.kill_save_rank:
+        faults.kill_on_atomic_write(os.path.join(
+            os.path.basename(mgr.shard_dir(a.kill_save_step)),
+            "shard-%05d.npz" % rank))
+
+    step = 0
+    restrict = {"w": [[[lo, hi], [0, D]]],
+                "m": [[[lo, hi], [0, D]]]} if nprocs > 1 else None
+    ckpt = mgr.load(restrict=restrict,
+                    context={"mesh_axes": {"fsdp": nprocs},
+                             "layout": "elastic_protocol"})
+    if ckpt is not None:
+        step = int(ckpt.meta["step"])
+        W[lo:hi] = ckpt.arrays["w"][lo:hi]
+        M[lo:hi] = ckpt.arrays["m"][lo:hi]
+    print("ELASTIC_RESUMED rank=%d step=%d" % (rank, step), flush=True)
+    _emit_blocks(W, M, blocks, lo, hi, rank, step)
+
+    def arrays_now():
+        return {"w": faults.FakeShardedArray(W, nprocs, rank),
+                "m": faults.FakeShardedArray(M, nprocs, rank),
+                "rng": np.array([7, step], np.int64)}
+
+    def meta_now(**extra):
+        meta = {"kind": "elastic_protocol", "step": int(step),
+                "blocks": blocks, "mesh_axes": {"fsdp": nprocs},
+                "layout": "elastic_protocol"}
+        meta.update(extra)
+        return meta
+
+    mgr.install_preemption_handler(
+        lambda: (step, arrays_now(), {}, meta_now()),
+        coordinated=nprocs > 1)
+
+    def commit_final():
+        """The coordinated final save — same pod-wide agreement rule as
+        ShardedTrainer._maybe_coordinated_commit: ride a periodic
+        boundary (the pod's existing sync points), so every rank picks
+        the same step with no new cross-host agreement."""
+        mgr.save(step, arrays_now(),
+                 meta=meta_now(preempted=True, coordinated=True))
+        mgr.preempted = True
+        mgr.clear_coordinated_commit()
+        print("ELASTIC_PREEMPT_COMMIT rank=%d step=%d"
+              % (rank, step), flush=True)
+
+    try:
+        while step < a.steps and not mgr.preempted:
+            if a.preempt_step == step + 1 and rank == a.preempt_rank:
+                faults.send_preemption()  # SIGTERM self -> commit flag
+            step += 1
+            # per-step pacing: ranks leave a save barrier within one
+            # 0.02s sidecar poll of each other, so a step longer than
+            # that bounds the skew — a commit flag published at step k
+            # is durable before ANY rank's boundary check at k+1
+            # (numpy updates alone run in ~0.1ms, far inside the skew)
+            time.sleep(0.03)
+            _protocol_update(W, M, step, lo, hi)
+            _emit_blocks(W, M, blocks, lo, hi, rank, step)
+            try:
+                req = mgr.coordinated_commit_request()
+                periodic = a.save_every and step % a.save_every == 0
+                if req is not None and periodic and \
+                        step >= int(req.get("target_step", step)):
+                    commit_final()
+                elif periodic:
+                    mgr.save(step, arrays_now(), meta=meta_now())
+            except (ck.AtomicWriteError, ck.CheckpointCorruptError) as e:
+                print("ELASTIC_SAVE_ABORTED rank=%d step=%d kind=%s"
+                      % (rank, step, type(e).__name__), flush=True)
+                sys.stdout.flush()
+                os._exit(3)
+        if not mgr.preempted and \
+                mgr.coordinated_commit_request() is not None:
+            # end-of-data backstop: every rank exits the loop at the
+            # same final step, so committing here stays coordinated
+            commit_final()
+    finally:
+        mgr.uninstall_preemption_handler()
+    print("ELASTIC_DONE rank=%d step=%d" % (rank, step), flush=True)
+
+
+# ---------------------------------------------------------------------------
+# trainer mode: the full ShardedTrainer path (needs multi-process
+# collectives — typed skip on backends without them)
+# ---------------------------------------------------------------------------
+
+def _state_digest(tr):
+    """sha256 over this rank's addressable param+opt shard bytes (sorted
+    by array position then shard index) — collective-free, comparable
+    only between runs on the same topology."""
+    import numpy as np
+    import jax
+
+    h = hashlib.sha256()
+    arrs = list(tr.param_arrays) + \
+        list(jax.tree_util.tree_leaves(tr.opt_state))
+    for arr in arrs:
+        if hasattr(arr, "addressable_shards"):
+            shards = sorted(arr.addressable_shards,
+                            key=lambda s: str(s.index))
+            for s in shards:
+                h.update(np.ascontiguousarray(
+                    np.asarray(s.data)).tobytes())
+        else:
+            h.update(np.ascontiguousarray(np.asarray(arr)).tobytes())
+    return h.hexdigest()
+
+
+def build_trainer(nprocs=1, dev_per_proc=1):
+    """The drill model: fixed seed, fsdp mesh over every device (the
+    axis spans hosts, so each host OWNS distinct parameter chunks and a
+    sharded save genuinely distributes the bytes)."""
+    import numpy as np
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, parallel
+    from mxnet_tpu.gluon import nn
+
+    mx.random.seed(7)
+    np.random.seed(7)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(8, activation="relu"), nn.Dense(1))
+    net.initialize()
+
+    n_dev = nprocs * dev_per_proc
+    mesh = parallel.make_mesh({"fsdp": n_dev}, jax.devices())
+
+    def spec_fn(name, shape):
+        if len(shape) >= 1 and shape[0] % n_dev == 0:
+            return P(*(("fsdp",) + (None,) * (len(shape) - 1)))
+        if len(shape) == 2 and shape[1] % n_dev == 0:
+            return P(None, "fsdp")
+        return None
+
+    loss_fn = gluon.loss.L2Loss()
+    return parallel.ShardedTrainer(
+        net, lambda o, l: loss_fn(o, l), mesh=mesh, optimizer="adam",
+        optimizer_params={"learning_rate": 0.05},
+        param_spec_fn=spec_fn)
+
+
+def global_batch(step, n=16, d=D):
+    """The step's GLOBAL batch — a pure function of the step number, so
+    every topology trains on identical data."""
+    import numpy as np
+
+    rng = np.random.RandomState(1000 + int(step))
+    X = rng.rand(n, d).astype(np.float32)
+    Y = (X @ np.linspace(0.1, 0.6, d, dtype=np.float32)[:, None]) \
+        .astype(np.float32)
+    return X, Y
+
+
+def _unavailable(msg):
+    print("ELASTIC_UNAVAILABLE %s" % (msg,), flush=True)
+    sys.stdout.flush()
+    os._exit(42)
+
+
+def run_trainer(a):
+    import numpy as np
+    import jax
+
+    from mxnet_tpu import nd, parallel
+    from mxnet_tpu import checkpoint as ck
+    from mxnet_tpu.testing import faults
+
+    try:
+        parallel.bootstrap_distributed()
+    except parallel.DistributedUnavailable as e:
+        _unavailable(str(e).splitlines()[0])
+    rank = jax.process_index()
+    nprocs = jax.process_count()
+    dev_per_proc = len(jax.local_devices())
+
+    tr = build_trainer(nprocs, dev_per_proc)
+    mgr = ck.CheckpointManager(a.dir, keep_last=a.keep_last,
+                               async_save=False, sharded=True)
+
+    # materialize params/opt on-mesh BEFORE attach (no training step, no
+    # PRNG use) so a resume exercises the restricted sharded load — each
+    # rank hands its addressable bounds to load() and reads only
+    # overlapping shard files
+    X, Y = global_batch(0)
+    rows = X.shape[0] // nprocs
+    xs, ys = tr.shard_batch(
+        nd.array(X[rank * rows:(rank + 1) * rows]),
+        nd.array(Y[rank * rows:(rank + 1) * rows]))
+    tr._lazy_init(example_inputs=[xs])
+
+    start = tr.attach_checkpoint_manager(mgr, period=a.save_every)
+    print("ELASTIC_RESUMED rank=%d step=%d" % (rank, start), flush=True)
+
+    if a.kill_save_step > 0 and rank == a.kill_save_rank:
+        faults.kill_on_atomic_write(os.path.join(
+            os.path.basename(mgr.shard_dir(a.kill_save_step)),
+            "shard-%05d.npz" % rank))
+
+    step = start
+    try:
+        while step < a.steps and not mgr.preempted:
+            if a.preempt_step == step + 1 and rank == a.preempt_rank:
+                faults.send_preemption()
+            X, Y = global_batch(step)
+            xs, ys = tr.shard_batch(
+                nd.array(X[rank * rows:(rank + 1) * rows]),
+                nd.array(Y[rank * rows:(rank + 1) * rows]))
+            try:
+                loss = tr.step([xs], ys)
+            except Exception as e:
+                if any(sig in str(e)
+                       for sig in parallel.UNAVAILABLE_SIGNATURES):
+                    _unavailable(str(e).splitlines()[0])
+                raise
+            step = tr.global_step
+            print("ELASTIC_LOSS rank=%d step=%d %r"
+                  % (rank, step, float(np.asarray(loss))), flush=True)
+            print("ELASTIC_STATE rank=%d step=%d %s"
+                  % (rank, step, _state_digest(tr)), flush=True)
+    except (ck.AtomicWriteError, ck.CheckpointCorruptError) as e:
+        # peer died mid-save: the shard barrier timed out.  Report and
+        # hard-exit — with a peer gone, the jax runtime's own atexit
+        # teardown can hang on dead sockets.
+        print("ELASTIC_SAVE_ABORTED rank=%d step=%d kind=%s"
+              % (rank, step, type(e).__name__), flush=True)
+        sys.stdout.flush()
+        os._exit(3)
+    finally:
+        mgr.uninstall_preemption_handler()
+
+    if mgr.preempted:
+        print("ELASTIC_PREEMPT_COMMIT rank=%d step=%d"
+              % (rank, mgr.latest_step()), flush=True)
+    print("ELASTIC_DONE rank=%d step=%d" % (rank, step), flush=True)
+    # skip jax.distributed atexit teardown: when any peer already
+    # exited (kill/preempt drills), shutdown can block on its socket
+    sys.stdout.flush()
+    os._exit(0)
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--dir", required=True)
+    p.add_argument("--mode", choices=("protocol", "trainer"),
+                   default="protocol")
+    p.add_argument("--steps", type=int, default=6)
+    p.add_argument("--save-every", type=int, default=2)
+    p.add_argument("--keep-last", type=int, default=3)
+    p.add_argument("--blocks", type=int, default=2,
+                   help="protocol mode: fixed row-block count (state "
+                        "rows = 4*blocks); must be divisible by the "
+                        "rank count of every topology in the drill")
+    p.add_argument("--run-id", default="r0",
+                   help="attach-rendezvous namespace; identical across "
+                        "the fleet, distinct between reruns on one dir")
+    p.add_argument("--kill-save-step", type=int, default=0)
+    p.add_argument("--kill-save-rank", type=int, default=-1)
+    p.add_argument("--preempt-step", type=int, default=0)
+    p.add_argument("--preempt-rank", type=int, default=-1)
+    a = p.parse_args(argv)
+    if a.mode == "protocol":
+        run_protocol(a)
+    else:
+        run_trainer(a)
+
+
+if __name__ == "__main__":
+    main()

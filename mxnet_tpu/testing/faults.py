@@ -26,6 +26,26 @@ modes the checkpoint tests drive:
 * :func:`transient_device_put_failures` — context manager making the
   first N ``jax.device_put`` calls raise, driving the serving upload
   retry path.
+
+Pod-scale sharded-checkpoint faults (PR: elastic training):
+
+* :func:`kill_on_atomic_write` — hard-kill (``os._exit``) the process
+  mid-atomic-write on a matching path: the TRUE kill-mid-save (no
+  except/finally cleanup runs, a partial ``.tmp`` stays behind).
+* :func:`corrupt_shard` / :func:`drop_shard` — damage or remove one
+  host's shard of a committed sharded checkpoint (torn shard / shrunk
+  host set / lost volume).
+* :func:`orphan_shard_dir` / :func:`stale_manifest` — fabricate the two
+  halves of an interrupted commit: a shard dir with no manifest, and a
+  manifest with no shard payload.
+* :class:`FakeShardedArray` — duck-typed multi-process ``jax.Array``
+  (``sharding.devices_indices_map`` + ``addressable_shards``) so the
+  per-host ownership/barrier protocol is testable in-process without a
+  ``jax.distributed`` cluster.
+* :class:`WorkerFleet` — spawn N real OS processes with the
+  ``MXNET_DIST_COORDINATOR``/``MXNET_DIST_NUM_PROCS``/
+  ``MXNET_DIST_PROC_ID`` env wired to a localhost coordinator; kill one
+  mid-run; collect per-rank output.
 """
 from __future__ import annotations
 
@@ -38,7 +58,10 @@ import time
 __all__ = ["FailingWriter", "failing_open", "truncate_file", "flip_bit",
            "corrupt_file", "poison_batch", "send_preemption",
            "FlakyCallable", "LatencySpike", "StallingCallable",
-           "transient_device_put_failures"]
+           "transient_device_put_failures",
+           "kill_on_atomic_write", "corrupt_shard", "drop_shard",
+           "orphan_shard_dir", "stale_manifest", "FakeShardedArray",
+           "WorkerFleet"]
 
 
 def poison_batch(arr, value=float("nan"), fraction=1.0):
@@ -254,3 +277,304 @@ class FlakyCallable:
         if self._fn is not None:
             return self._fn(*args, **kwargs)
         return self._value
+
+
+# ---------------------------------------------------------------------------
+# pod-scale sharded-checkpoint faults
+# ---------------------------------------------------------------------------
+
+def kill_on_atomic_write(match, write_bytes=64, exit_code=137):
+    """Patch ``mxnet_tpu.checkpoint.atomic_writer`` so the next write to
+    a path containing ``match`` hard-kills the process (``os._exit``)
+    after ``write_bytes`` bytes of real payload reached the temp file.
+
+    Unlike :class:`FailingWriter` (an exception the writer's cleanup
+    still catches), this is the genuine kill-mid-save: no except/finally
+    runs, no atexit, and a partial ``<target>.*.tmp`` stays behind in
+    the target directory while the final path never appears.  Returns an
+    undo callable (for the rare caller that survives).
+    """
+    import tempfile
+
+    from .. import checkpoint as _ck
+
+    real = _ck.atomic_writer
+
+    @contextlib.contextmanager
+    def patched(path, mode="wb"):
+        if match not in os.fspath(path):
+            with real(path, mode=mode) as f:
+                yield f
+            return
+        dirname = os.path.dirname(os.path.abspath(path))
+        fd, _tmp = tempfile.mkstemp(
+            dir=dirname, prefix=os.path.basename(path) + ".",
+            suffix=".tmp")
+        f = os.fdopen(fd, mode)
+
+        class _Doomed:
+            def __init__(self):
+                self._left = int(write_bytes)
+
+            def write(self, data):
+                d = data[:self._left] if len(data) > self._left else data
+                if d:
+                    f.write(d)
+                self._left -= len(d)
+                if self._left <= 0:
+                    f.flush()
+                    os.fsync(f.fileno())
+                    os._exit(exit_code)
+                return len(d)
+
+            def __getattr__(self, name):
+                return getattr(f, name)
+
+        yield _Doomed()
+        os._exit(exit_code)  # payload smaller than budget: die anyway
+
+    _ck.atomic_writer = patched
+
+    def undo():
+        _ck.atomic_writer = real
+
+    return undo
+
+
+def _ckpt_paths(directory, prefix):
+    """A path-helper manager over an existing checkpoint directory."""
+    from ..checkpoint import CheckpointManager
+
+    return CheckpointManager(directory, prefix=prefix, async_save=False,
+                             sharded=True)
+
+
+def corrupt_shard(directory, step, host=0, prefix="ckpt", mode="flip"):
+    """Damage one host's shard payload of a COMMITTED sharded step:
+    ``"flip"`` = one-bit rot in the container (the zip CRC catches it
+    as an unreadable shard), ``"tamper"`` = rewrite one chunk's bytes
+    inside a structurally VALID npz — invisible to the container, only
+    the per-chunk SHA-256 digest catches it — ``"truncate"`` = torn
+    tail, anything else = structural garbage.  Returns the shard path.
+    """
+    import numpy as np
+
+    m = _ckpt_paths(directory, prefix)
+    p = m.shard_data_path(step, host)
+    if mode == "flip":
+        flip_bit(p)
+    elif mode == "tamper":
+        with np.load(p, allow_pickle=False) as z:
+            data = {k: np.array(z[k]) for k in z.files}
+        k = sorted(data)[0]
+        raw = bytearray(data[k].tobytes())
+        raw[0] ^= 0x01
+        data[k] = np.frombuffer(bytes(raw), data[k].dtype) \
+            .reshape(data[k].shape)
+        np.savez(p, **data)
+    elif mode == "truncate":
+        truncate_file(p)
+    else:
+        corrupt_file(p)
+    return p
+
+
+def drop_shard(directory, step, host, prefix="ckpt"):
+    """Remove one host's shard data + digest sidecar from a committed
+    step — the shrunk-host-set / lost-volume scenario; a restore must
+    detect the coverage gap and fall back.  Returns removed paths."""
+    m = _ckpt_paths(directory, prefix)
+    removed = []
+    for p in (m.shard_data_path(step, host),
+              m.shard_sidecar_path(step, host)):
+        try:
+            os.unlink(p)
+            removed.append(p)
+        except OSError:
+            pass
+    return removed
+
+
+def orphan_shard_dir(directory, step, prefix="ckpt", n_shards=1):
+    """Fabricate an UNCOMMITTED shard dir (payload, no manifest) — the
+    debris a kill-mid-save leaves.  Loaders must never see it as a
+    checkpoint and the retention/attach sweeps must clear it.  Returns
+    the dir path."""
+    m = _ckpt_paths(directory, prefix)
+    d = m.shard_dir(step)
+    os.makedirs(d, exist_ok=True)
+    for r in range(int(n_shards)):
+        with open(m.shard_data_path(step, r), "wb") as f:
+            f.write(b"\x00partial-shard-debris")
+    return d
+
+
+def stale_manifest(directory, step, prefix="ckpt", n_processes=2):
+    """Write a committed-LOOKING sharded manifest whose shard payload is
+    missing — the orphaned commit mark (e.g. shard dir lost to a bad
+    volume).  A load of this step must raise corruption, not garbage.
+    Returns the manifest path."""
+    import json as _json
+
+    from ..checkpoint import MANIFEST_FORMAT
+
+    m = _ckpt_paths(directory, prefix)
+    doc = {
+        "format_version": MANIFEST_FORMAT,
+        "sharded": True,
+        "prefix": prefix,
+        "step": int(step),
+        "time": 0.0,
+        "n_processes": int(n_processes),
+        "shard_dir": os.path.basename(m.shard_dir(step)),
+        # same shape as a real commit: sidecar filename -> sidecar doc,
+        # each naming a data file that does not exist
+        "shards": {"shard-%05d.json" % r: {
+            "shard_format": 1, "step": int(step), "process_index": r,
+            "n_processes": int(n_processes),
+            "data_file": "shard-%05d.npz" % r, "data_size": 128,
+            "chunks": [{"key": "chunk:00000", "array": "param:0000",
+                        "bounds": [[0, 2], [0, 2]], "shape": [2, 2],
+                        "dtype": "float32", "sha256": "0" * 64}],
+        } for r in range(int(n_processes))},
+        "arrays": {"param:0000": {"shape": [2, 2], "dtype": "float32"}},
+        "meta": {},
+    }
+    path = m.manifest_path(step)
+    with open(path, "w") as f:
+        _json.dump(doc, f)
+    return path
+
+
+class _FakeDevice:
+    def __init__(self, process_index, did):
+        self.process_index = int(process_index)
+        self.id = int(did)
+
+    def __repr__(self):
+        return "FakeDevice(p%d,d%d)" % (self.process_index, self.id)
+
+
+class _FakeShard:
+    def __init__(self, index, data):
+        self.index = index
+        self.data = data
+
+
+class FakeShardedArray:
+    """Duck-typed stand-in for a multi-process ``jax.Array``.
+
+    Splits a global numpy array into ``n_procs`` equal blocks along
+    ``axis``; each fake process addresses exactly one block.  Exposes
+    just the surface the sharded checkpoint writer consumes —
+    ``shape``/``dtype``, ``sharding.devices_indices_map`` (with
+    ``device.process_index``) and ``addressable_shards`` (with
+    ``.index``/``.data``) — so the per-host ownership + barrier + commit
+    protocol runs for real in one OS process (e.g. two managers on two
+    threads), no ``jax.distributed`` cluster needed.
+    """
+
+    def __init__(self, global_np, n_procs, process_index, axis=0):
+        import numpy as np
+
+        self._global = np.asarray(global_np)
+        self.shape = self._global.shape
+        self.dtype = self._global.dtype
+        if self.shape[axis] % int(n_procs):
+            raise ValueError("axis %d (%d) not divisible by %d"
+                             % (axis, self.shape[axis], n_procs))
+        self._n = int(n_procs)
+        self._me = int(process_index)
+        self._axis = int(axis)
+
+    def _index_for(self, rank):
+        blk = self.shape[self._axis] // self._n
+        idx = [slice(None)] * len(self.shape)
+        idx[self._axis] = slice(rank * blk, (rank + 1) * blk)
+        return tuple(idx)
+
+    @property
+    def sharding(self):
+        outer = self
+
+        class _Sharding:
+            def devices_indices_map(self, shape):
+                return {_FakeDevice(r, r): outer._index_for(r)
+                        for r in range(outer._n)}
+
+        return _Sharding()
+
+    @property
+    def addressable_shards(self):
+        idx = self._index_for(self._me)
+        return [_FakeShard(idx, self._global[idx])]
+
+
+class WorkerFleet:
+    """N real OS processes joined to a localhost coordinator — the
+    smallest honest pod.
+
+    Each rank runs ``[sys.executable] + argv`` (list entries support
+    ``{rank}`` substitution) with ``MXNET_DIST_COORDINATOR/NUM_PROCS/
+    PROC_ID`` set, ``JAX_PLATFORMS=cpu`` and ``dev_per_proc`` virtual
+    CPU devices, so ``parallel.bootstrap_distributed()`` inside the
+    worker forms a genuine multi-process ``jax.distributed`` cluster.
+    ``kill(rank)`` delivers a mid-run fault; :meth:`wait` collects
+    ``(returncode, output)`` per rank.
+    """
+
+    def __init__(self, n_procs, argv, dev_per_proc=1, env=None,
+                 cwd=None):
+        import socket
+        import subprocess
+        import sys
+
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        self.port = s.getsockname()[1]
+        s.close()
+        self.n_procs = int(n_procs)
+        self.procs = []
+        for r in range(self.n_procs):
+            e = dict(os.environ)
+            e.update(env or {})
+            e["MXNET_DIST_COORDINATOR"] = "127.0.0.1:%d" % self.port
+            e["MXNET_DIST_NUM_PROCS"] = str(self.n_procs)
+            e["MXNET_DIST_PROC_ID"] = str(r)
+            e["JAX_PLATFORMS"] = "cpu"
+            flags = [f for f in e.get("XLA_FLAGS", "").split()
+                     if not f.startswith(
+                         "--xla_force_host_platform_device_count")]
+            flags.append("--xla_force_host_platform_device_count=%d"
+                         % int(dev_per_proc))
+            e["XLA_FLAGS"] = " ".join(flags)
+            cmd = [sys.executable] + [str(a).format(rank=r) for a in argv]
+            self.procs.append(subprocess.Popen(
+                cmd, env=e, cwd=cwd, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True))
+
+    def kill(self, rank, sig=_signal.SIGKILL):
+        """Hard-kill one rank (default SIGKILL — the host that just
+        vanished; pass SIGTERM for the polite preemption notice)."""
+        self.procs[rank].send_signal(sig)
+
+    def alive(self, rank):
+        return self.procs[rank].poll() is None
+
+    def wait(self, timeout=300):
+        """Collect every rank: list of ``(returncode, output)`` in rank
+        order (a rank that outlives ``timeout`` is killed and reported
+        with output suffix ``\\nFLEET_TIMEOUT``)."""
+        out = []
+        for p in self.procs:
+            try:
+                o, _ = p.communicate(timeout=timeout)
+            except Exception:
+                p.kill()
+                try:
+                    o, _ = p.communicate(timeout=10)
+                except Exception:
+                    o = ""
+                o = (o or "") + "\nFLEET_TIMEOUT"
+            out.append((p.returncode, o or ""))
+        return out

@@ -6,9 +6,16 @@ reports per-step wall time plus the derived per-save overhead.  The
 async path should hide (de)serialization and fsync behind the next
 step's compute; what remains visible is the synchronous host snapshot.
 
+``--sharded`` benchmarks the pod-scale checkpoint format instead:
+per-host sharded save (addressable shards only, no host gather) and
+restore vs the dense host-gathered path over the same trainer state,
+reporting ``checkpoint_sharded_save_seconds`` /
+``checkpoint_sharded_restore_seconds`` to the perf ledger (down-good).
+
 CPU numbers are committed in docs/fault_tolerance.md; rerun on TPU with:
 
     python tools/bench_checkpoint.py --params-mb 64 --steps 50
+    python tools/bench_checkpoint.py --params-mb 64 --sharded
 """
 import argparse
 import json
@@ -32,14 +39,28 @@ from mxnet_tpu.gluon import nn  # noqa: E402
 
 def ledger_records(results):
     """perf_ledger record(s) for one run: the async per-save overhead
-    is the headline (the number the async path exists to shrink); the
-    full results ride as fields.  The tier-1 schema guard calls this
-    with a canned result."""
+    is the headline (the number the async path exists to shrink); a
+    ``--sharded`` run adds the sharded save/restore wall times (both
+    down-good via the ``_seconds`` suffix); the full results ride as
+    fields.  The tier-1 schema guard calls this with a canned result."""
     from mxnet_tpu import perf_ledger
 
-    return [perf_ledger.make_record(
-        "checkpoint_async_overhead_ms_per_save",
-        results["async_overhead_ms_per_save"], "ms", **results)]
+    recs = []
+    if "async_overhead_ms_per_save" in results:
+        recs.append(perf_ledger.make_record(
+            "checkpoint_async_overhead_ms_per_save",
+            results["async_overhead_ms_per_save"], "ms", **results))
+    if "sharded_save_s" in results:
+        recs.append(perf_ledger.make_record(
+            "checkpoint_sharded_save_seconds",
+            results["sharded_save_s"], "s", **results))
+    if "sharded_restore_s" in results:
+        recs.append(perf_ledger.make_record(
+            "checkpoint_sharded_restore_seconds",
+            results["sharded_restore_s"], "s", **results))
+    if not recs:
+        raise ValueError("results carry no known headline fields")
+    return recs
 
 
 def make_trainer(hidden, n_layers, seed=7):
@@ -75,6 +96,35 @@ def run(trainer, steps, batch, label, manager=None, period=1):
     return dt / steps * 1e3  # ms/step
 
 
+def run_sharded(hidden, n_layers, X, Y, repeats=3):
+    """Sharded (per-host shards, no gather) vs dense (host-gathered)
+    save + restore wall time over the SAME materialized trainer state;
+    best-of-``repeats`` for each."""
+    tr = make_trainer(hidden, n_layers)
+    float(np.asarray(tr.step([X], Y)))   # materialize params on-mesh
+    step, arrays, blobs, meta = tr._checkpoint_payload()
+    out = {}
+    for mode, sharded in (("gather", False), ("sharded", True)):
+        d = tempfile.mkdtemp(prefix="bench_ckpt_%s_" % mode)
+        try:
+            m = ck.CheckpointManager(d, keep_last=2, async_save=False,
+                                     sharded=sharded)
+            saves, restores = [], []
+            for i in range(repeats):
+                t0 = time.perf_counter()
+                m.save(step + i, arrays, blobs=blobs, meta=meta)
+                saves.append(time.perf_counter() - t0)
+                t0 = time.perf_counter()
+                ckpt = m.load(step=step + i)
+                restores.append(time.perf_counter() - t0)
+                assert ckpt is not None and not ckpt.resharded
+            out["%s_save_s" % mode] = round(min(saves), 6)
+            out["%s_restore_s" % mode] = round(min(restores), 6)
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+    return out
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--params-mb", type=float, default=8.0,
@@ -83,6 +133,12 @@ def main():
     ap.add_argument("--batch", type=int, default=64)
     ap.add_argument("--period", type=int, default=1,
                     help="save every N steps")
+    ap.add_argument("--sharded", action="store_true",
+                    help="benchmark the sharded (pod-scale) checkpoint "
+                         "format vs the dense gather path instead of "
+                         "the async-overhead drill")
+    ap.add_argument("--repeats", type=int, default=3,
+                    help="--sharded: best-of-N save/restore timings")
     ap.add_argument("--out", default=None, help="write JSON here")
     args = ap.parse_args()
 
@@ -98,23 +154,29 @@ def main():
                "period": args.period,
                "platform": os.environ.get("JAX_PLATFORMS", "default")}
 
-    tr = make_trainer(hidden, n_layers)
-    results["baseline_ms"] = run(tr, args.steps, X, Y)
+    if args.sharded:
+        results.update(run_sharded(hidden, n_layers, X, Y,
+                                   repeats=args.repeats))
+    else:
+        tr = make_trainer(hidden, n_layers)
+        results["baseline_ms"] = run(tr, args.steps, X, Y)
 
-    for mode, async_save in (("blocking", False), ("async", True)):
-        d = tempfile.mkdtemp(prefix="bench_ckpt_")
-        try:
-            m = ck.CheckpointManager(d, keep_last=2, async_save=async_save)
-            tr = make_trainer(hidden, n_layers)
-            results["%s_ms" % mode] = run(tr, args.steps, X, Y, manager=m,
-                                          period=args.period)
-        finally:
-            shutil.rmtree(d, ignore_errors=True)
+        for mode, async_save in (("blocking", False), ("async", True)):
+            d = tempfile.mkdtemp(prefix="bench_ckpt_")
+            try:
+                m = ck.CheckpointManager(d, keep_last=2,
+                                         async_save=async_save)
+                tr = make_trainer(hidden, n_layers)
+                results["%s_ms" % mode] = run(tr, args.steps, X, Y,
+                                              manager=m,
+                                              period=args.period)
+            finally:
+                shutil.rmtree(d, ignore_errors=True)
 
-    for mode in ("blocking", "async"):
-        results["%s_overhead_ms_per_save" % mode] = (
-            (results["%s_ms" % mode] - results["baseline_ms"])
-            * args.period)
+        for mode in ("blocking", "async"):
+            results["%s_overhead_ms_per_save" % mode] = (
+                (results["%s_ms" % mode] - results["baseline_ms"])
+                * args.period)
 
     print(json.dumps(results, indent=2))
     from mxnet_tpu import perf_ledger

@@ -17,6 +17,10 @@ without real multi-host hardware:
 
 Writes a MULTICHIP-style artifact:
     python tools/dryrun_multihost.py --json MULTIHOST_r04.json
+
+Also hosts the offline sharded-checkpoint validator (no mesh, no jax —
+pure file inspection; nonzero exit on coverage gaps / torn shards):
+    python tools/dryrun_multihost.py --check-manifest /ckpt/dir [--step N]
 """
 import argparse
 import json
@@ -48,9 +52,9 @@ def collective_worker(rank, n_procs, dev_per_proc, port):
     import jax
 
     jax.config.update("jax_platforms", "cpu")
-    os.environ["MXTPU_COORDINATOR"] = "127.0.0.1:%d" % port
-    os.environ["MXTPU_NUM_PROCS"] = str(n_procs)
-    os.environ["MXTPU_PROC_ID"] = str(rank)
+    os.environ["MXNET_DIST_COORDINATOR"] = "127.0.0.1:%d" % port
+    os.environ["MXNET_DIST_NUM_PROCS"] = str(n_procs)
+    os.environ["MXNET_DIST_PROC_ID"] = str(rank)
 
     import numpy as np
 
@@ -59,7 +63,13 @@ def collective_worker(rank, n_procs, dev_per_proc, port):
     from mxnet_tpu.gluon import nn
     from jax.sharding import PartitionSpec as P
 
-    assert parallel.init_distributed(), "jax.distributed bootstrap failed"
+    try:
+        # env-driven bootstrap (retry-with-backoff inside); raises the
+        # typed DistributedUnavailable on an unreachable coordinator
+        up = parallel.bootstrap_distributed()
+    except parallel.DistributedUnavailable as e:
+        raise AssertionError("jax.distributed bootstrap failed: %s" % e)
+    assert up, "jax.distributed bootstrap failed: not configured"
     assert jax.process_count() == n_procs
     devs = jax.devices()
     assert len(devs) == n_procs * dev_per_proc, \
@@ -211,6 +221,28 @@ def _print_host_layout(axes, n_procs, dev_per_proc):
               flush=True)
 
 
+def check_manifest(directory, step=None, prefix="ckpt"):
+    """Offline sharded-checkpoint validation (manifest schema, shard
+    presence/size, per-chunk digests, exact global coverage).  Returns
+    a process exit code: 0 = restorable on any topology."""
+    from mxnet_tpu.checkpoint import validate_sharded_checkpoint
+
+    step, problems = validate_sharded_checkpoint(directory, step=step,
+                                                 prefix=prefix)
+    if step is None:
+        print("check-manifest: %s" % problems[0], flush=True)
+        return 2
+    if problems:
+        print("check-manifest: step %d has %d problem(s):"
+              % (step, len(problems)), flush=True)
+        for pr in problems:
+            print("  - %s" % pr, flush=True)
+        return 1
+    print("check-manifest: step %d OK (restorable on any topology)"
+          % step, flush=True)
+    return 0
+
+
 def run(n_procs=2, dev_per_proc=4, json_path=None, mesh=None):
     result = {"n_procs": n_procs, "dev_per_proc": dev_per_proc,
               "topology": "dp(%d hosts over DCN) x tp(%d local devices)"
@@ -314,6 +346,18 @@ if __name__ == "__main__":
                         "'dp=2,tp=4' (product must equal n_procs x "
                         "dev_per_proc); prints the resolved per-host "
                         "layout before launching")
+    p.add_argument("--check-manifest", metavar="DIR", default=None,
+                   help="validate a committed sharded checkpoint "
+                        "offline and exit (no mesh, no processes); "
+                        "nonzero exit on gaps/torn shards")
+    p.add_argument("--step", type=int, default=None,
+                   help="with --check-manifest: validate this step "
+                        "(default: newest committed)")
+    p.add_argument("--prefix", default="ckpt",
+                   help="with --check-manifest: checkpoint file prefix")
     a = p.parse_args()
+    if a.check_manifest:
+        sys.exit(check_manifest(a.check_manifest, step=a.step,
+                                prefix=a.prefix))
     r = run(a.n_procs, a.dev_per_proc, a.json, mesh=a.mesh)
     sys.exit(0 if r["ok"] else 1)
