@@ -349,6 +349,46 @@ FLAGS = {
         "seconds between auto-heal canary probes of ejected "
         "AsyncPredictor replicas (0 = no probing): a probe dispatches "
         "one known-good batch and re-admits the replica on success"),
+    "MXNET_GATEWAY_PORT": (
+        "0", _pint, "honored",
+        "HTTP serving gateway listen port (gateway.py; 0 = ephemeral, "
+        "the bound port is on Gateway.port).  The gateway also serves "
+        "the scrape routes (/metrics /healthz /statusz /varz "
+        "/requestz) on the same listener"),
+    "MXNET_GATEWAY_MAX_BODY": (
+        "1048576", _pint, "honored",
+        "gateway request-body byte cap: a Content-Length above it is "
+        "refused 413 before reading a byte, so oversized bodies can "
+        "never hold a handler thread or its memory"),
+    "MXNET_GATEWAY_READ_TIMEOUT_S": (
+        "5", _pfloat, "honored",
+        "gateway socket read timeout while receiving a request body: "
+        "a slow-loris client trickling bytes slower than this is cut "
+        "with 408 instead of pinning a handler thread"),
+    "MXNET_GATEWAY_QUOTA_QPS": (
+        "0", _pfloat, "honored",
+        "per-tenant token-bucket refill rate in requests/second "
+        "(0 = quotas off): a tenant over its bucket gets 429 with "
+        "Retry-After sized to the refill wait"),
+    "MXNET_GATEWAY_QUOTA_BURST": (
+        "8", _pint, "honored",
+        "per-tenant token-bucket capacity: how many requests a tenant "
+        "may burst above its steady MXNET_GATEWAY_QUOTA_QPS rate"),
+    "MXNET_GATEWAY_QUEUE": (
+        "16", _pint, "honored",
+        "gateway per-tenant fair-queue depth: a tenant with this many "
+        "requests already waiting for a dispatch permit sheds the "
+        "next one typed (Overloaded('queue') -> 429)"),
+    "MXNET_GATEWAY_CONCURRENCY": (
+        "8", _pint, "honored",
+        "gateway dispatch permits shared across tenants: concurrent "
+        "backend requests; freed permits go to the queued tenant with "
+        "the smallest weighted-fair virtual finish time"),
+    "MXNET_GATEWAY_DRAIN_S": (
+        "10", _pfloat, "honored",
+        "gateway close()/SIGTERM drain budget in seconds: /healthz "
+        "flips 503 first, new requests shed 503, open streams get "
+        "this long to finish before the listener stops"),
     "MXNET_DECODE_SLOTS": (
         "8", _pint, "honored",
         "generate.GenerationEngine default decode batch slots: the "

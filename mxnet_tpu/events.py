@@ -70,8 +70,9 @@ OUTCOMES = ("ok", "shed", "deadline", "evicted", "error")
 
 # known unit-of-work kinds (documentation + events_query default order;
 # emit() accepts others so downstream layers can add units of work)
-KINDS = ("serving_request", "token_request", "train_step",
-         "checkpoint_save", "checkpoint_load", "aot_load", "aot_compile")
+KINDS = ("gateway_request", "serving_request", "token_request",
+         "train_step", "checkpoint_save", "checkpoint_load", "aot_load",
+         "aot_compile")
 
 RING_SIZE = 512          # /requestz + flight-recorder window
 QUEUE_MAX = 4096         # bounded writer queue (past it: drop + count)

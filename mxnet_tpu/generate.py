@@ -1400,9 +1400,11 @@ class GenerationResult(dict):
 
 class _GenRequest:
     __slots__ = ("tokens", "future", "deadline", "t_submit", "max_new",
-                 "out", "slot", "ttft", "span", "t_pickup", "prefix_hit")
+                 "out", "slot", "ttft", "span", "t_pickup", "prefix_hit",
+                 "on_token")
 
-    def __init__(self, tokens, deadline, max_new, span=None):
+    def __init__(self, tokens, deadline, max_new, span=None,
+                 on_token=None):
         self.tokens = tokens
         self.future = None
         self.deadline = deadline
@@ -1414,6 +1416,7 @@ class _GenRequest:
         self.span = span           # detached root span (tracing on)
         self.t_pickup = None       # queue -> prefill pickup time
         self.prefix_hit = None     # prompt tokens served by prefix pages
+        self.on_token = on_token   # streaming observer (gateway SSE)
 
 
 class TokenServer:
@@ -1499,7 +1502,7 @@ class TokenServer:
         return None
 
     def submit(self, token_ids, deadline_ms=_UNSET, max_new_tokens=None,
-               block=False, timeout=None):
+               block=False, timeout=None, on_token=None):
         """Admit one prompt; returns its :class:`ServingFuture`.
 
         Non-blocking by default (typed :class:`Overloaded` on a full
@@ -1507,7 +1510,10 @@ class TokenServer:
         queue space (``slo``/``shutdown`` still raise immediately).
         ``deadline_ms`` overrides the server default; None/0 = no
         deadline.  ``max_new_tokens`` caps generation for this request
-        (finish_reason ``length``)."""
+        (finish_reason ``length``).  ``on_token`` is called from the
+        decode loop with each generated token id as it is sampled
+        (streaming consumers, e.g. the gateway's SSE path); a raising
+        observer is detached, never the decode loop's problem."""
         token_ids = np.asarray(token_ids).astype(np.int32).reshape(-1)
         if token_ids.size < 1:
             raise MXNetError("submit needs at least one prompt token")
@@ -1560,7 +1566,8 @@ class TokenServer:
                         raise err
                 self._cond.wait(remaining if remaining is not None
                                 else 0.1)
-            req = _GenRequest(token_ids, deadline, max_new, span=span)
+            req = _GenRequest(token_ids, deadline, max_new, span=span,
+                              on_token=on_token)
             req.future = ServingFuture(owner=self, req=req)
             self._queue.append(req)
             _telemetry.DECODE_QUEUE_DEPTH.set(len(self._queue))
@@ -1763,6 +1770,12 @@ class TokenServer:
             eng.evict(slot, "deadline")
             return False
         req.out.append(tok)
+        if req.on_token is not None:
+            try:
+                req.on_token(tok)
+            except Exception:
+                _logger.exception("on_token observer failed; detaching")
+                req.on_token = None
         eos = self._engine.sampling.eos_id
         if eos is not None and tok == eos:
             self._finish(req, "eos")

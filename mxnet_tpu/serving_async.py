@@ -948,6 +948,15 @@ class AsyncPredictor:
         except Exception:
             return False
 
+    def canary(self):
+        """One known-good contract batch through a healthy replica:
+        True when the predictor answers end to end.  The deploy-probe
+        entry point (the gateway calls this before flipping a route to
+        a new model version); False when no replica is healthy."""
+        with self._cond:
+            reps = [r for r in self._replicas if r.healthy]
+        return any(self._canary_pred(r.pred) for r in reps)
+
     def _start_worker(self, rep):
         rep.thread = threading.Thread(
             target=self._worker, args=(rep,),

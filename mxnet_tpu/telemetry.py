@@ -933,6 +933,49 @@ EVENTS_WRITTEN = counter(
     "mxnet_tpu_events_written_total",
     "Wide events committed to the MXNET_EVENTS_PATH JSONL stream.")
 
+# HTTP serving gateway (gateway.py; see docs/serving_gateway.md)
+GATEWAY_REQUESTS = counter(
+    "mxnet_tpu_gateway_requests_total",
+    "HTTP inference requests received by the gateway, per tenant "
+    "(counted at arrival, before any admission decision).", ("tenant",))
+GATEWAY_RESPONSES = counter(
+    "mxnet_tpu_gateway_responses_total",
+    "Gateway responses by final wire status code (the lm_serving.md "
+    "contract: 429 shed, 503 shutdown, 504 deadline, 499 client "
+    "disconnect).", ("code",))
+GATEWAY_REQUEST_SECONDS = histogram(
+    "mxnet_tpu_gateway_request_seconds",
+    "Wall seconds per gateway request, arrival to final byte (or "
+    "error), whatever the outcome.")
+GATEWAY_OPEN_STREAMS = gauge(
+    "mxnet_tpu_gateway_open_streams",
+    "Requests currently dispatched to a backend (SSE streams plus "
+    "in-flight predicts); drain waits on this reaching zero.")
+GATEWAY_QUEUE_WAIT_SECONDS = histogram(
+    "mxnet_tpu_gateway_queue_wait_seconds",
+    "Seconds a request waited in the weighted-fair queue for a "
+    "dispatch permit (admitted requests only).")
+GATEWAY_QUOTA_SHED = counter(
+    "mxnet_tpu_gateway_quota_shed_total",
+    "Requests 429d by the per-tenant token-bucket quota "
+    "(MXNET_GATEWAY_QUOTA_QPS), per tenant.", ("tenant",))
+GATEWAY_CLIENT_DISCONNECTS = counter(
+    "mxnet_tpu_gateway_client_disconnects_total",
+    "Clients that vanished mid-response; each one cancels its backend "
+    "request (decode-slot eviction, never a leaked lane).")
+GATEWAY_BAD_REQUESTS = counter(
+    "mxnet_tpu_gateway_bad_requests_total",
+    "Requests refused at the wire before reaching admission, by kind "
+    "(malformed, oversized, truncated, slow_body, bad_deadline).",
+    ("kind",))
+GATEWAY_ROUTE_FLIPS = counter(
+    "mxnet_tpu_gateway_route_flips_total",
+    "Routing-table changes by operation (deploy, rollback, canary).",
+    ("op",))
+GATEWAY_STREAM_TOKENS = counter(
+    "mxnet_tpu_gateway_stream_tokens_total",
+    "Tokens written to clients as SSE frames across all streams.")
+
 
 # ---------------------------------------------------------------------------
 # jax.monitoring bridge: compile + compilation-cache events
@@ -1112,7 +1155,8 @@ def statusz():
     ``/statusz`` payload.
 
     Schema-stable: the core subsystem keys (``aot``, ``fusion``,
-    ``serving``, ``decode``, ``checkpoint``, ``events``, ``process``)
+    ``serving``, ``decode``, ``gateway``, ``checkpoint``, ``events``,
+    ``process``)
     are always present, built from the always-registered metric
     catalog; live objects (AOT store, fusion table, AsyncPredictors,
     TokenServers, event writer) enrich their subsystem through
@@ -1180,6 +1224,16 @@ def statusz():
                 round(time.time() - CHECKPOINT_LAST_UNIXTIME.value(), 3)
                 if CHECKPOINT_LAST_UNIXTIME.value() else None),
             "shard_count": int(CHECKPOINT_SHARDS.value()),
+        },
+        "gateway": {
+            "requests": _label_values(GATEWAY_REQUESTS, "tenant"),
+            "responses": _label_values(GATEWAY_RESPONSES, "code"),
+            "open_streams": GATEWAY_OPEN_STREAMS.value(),
+            "quota_shed": _label_values(GATEWAY_QUOTA_SHED, "tenant"),
+            "client_disconnects": GATEWAY_CLIENT_DISCONNECTS.value(),
+            "bad_requests": _label_values(GATEWAY_BAD_REQUESTS, "kind"),
+            "route_flips": _label_values(GATEWAY_ROUTE_FLIPS, "op"),
+            "stream_tokens": GATEWAY_STREAM_TOKENS.value(),
         },
         "events": {"enabled": False},
     }

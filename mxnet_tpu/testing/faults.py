@@ -46,6 +46,19 @@ Pod-scale sharded-checkpoint faults (PR: elastic training):
   ``MXNET_DIST_COORDINATOR``/``MXNET_DIST_NUM_PROCS``/
   ``MXNET_DIST_PROC_ID`` env wired to a localhost coordinator; kill one
   mid-run; collect per-rank output.
+
+Wire-level injectors (PR: serving gateway) — hostile raw-socket HTTP
+clients the gateway chaos matrix drives:
+
+* :func:`slow_loris_post` — declare a full Content-Length, trickle the
+  body a byte at a time (the classic handler-thread-pinning attack; a
+  correct gateway cuts it 408).
+* :func:`disconnecting_stream_post` — start an SSE stream, read a few
+  bytes, vanish with a TCP RST (SO_LINGER=0) so the server's next
+  write fails immediately (cancel -> slot eviction path).
+* :func:`malformed_post` / :func:`oversized_post` — broken JSON,
+  lying Content-Length (truncated body), and the memory-bomb header a
+  server must refuse (413) without reading.
 """
 from __future__ import annotations
 
@@ -61,7 +74,9 @@ __all__ = ["FailingWriter", "failing_open", "truncate_file", "flip_bit",
            "transient_device_put_failures",
            "kill_on_atomic_write", "corrupt_shard", "drop_shard",
            "orphan_shard_dir", "stale_manifest", "FakeShardedArray",
-           "WorkerFleet"]
+           "WorkerFleet",
+           "slow_loris_post", "disconnecting_stream_post",
+           "malformed_post", "oversized_post"]
 
 
 def poison_batch(arr, value=float("nan"), fraction=1.0):
@@ -578,3 +593,179 @@ class WorkerFleet:
                 o = (o or "") + "\nFLEET_TIMEOUT"
             out.append((p.returncode, o or ""))
         return out
+
+
+# ---------------------------------------------------------------------------
+# wire-level injectors (PR: serving gateway) — hostile HTTP clients the
+# chaos matrix drives against a live Gateway, raw sockets only so every
+# malformation is byte-exact and deterministic
+# ---------------------------------------------------------------------------
+
+def _connect(host, port, timeout=10.0):
+    import socket
+
+    s = socket.create_connection((host, int(port)), timeout=timeout)
+    s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    return s
+
+
+def _recv_response(sock, timeout=10.0):
+    """Read until the peer closes (the gateway sends
+    ``Connection: close``); returns ``(status_code, raw_bytes)``."""
+    import socket
+
+    sock.settimeout(timeout)
+    data = b""
+    try:
+        while True:
+            chunk = sock.recv(65536)
+            if not chunk:
+                break
+            data += chunk
+    except socket.timeout:
+        pass
+    status = 0
+    head = data.split(b"\r\n", 1)[0].split()
+    if len(head) >= 2 and head[0].startswith(b"HTTP/"):
+        try:
+            status = int(head[1])
+        except ValueError:
+            pass
+    return status, data
+
+
+def slow_loris_post(host, port, path, body, headers=None,
+                    trickle_delay_s=0.2, bytes_per_trickle=1,
+                    give_up_s=30.0):
+    """The slow-loris body: declare the full Content-Length, then
+    trickle ``bytes_per_trickle`` of the body every ``trickle_delay_s``
+    — slower than any sane read timeout.  Returns ``(status, raw)``
+    once the server (correctly) cuts the request (408 from the
+    gateway)."""
+    import socket
+
+    if isinstance(body, str):
+        body = body.encode("utf-8")
+    s = _connect(host, port, timeout=give_up_s)
+    try:
+        head = ["POST %s HTTP/1.1" % path,
+                "Host: %s:%d" % (host, int(port)),
+                "Content-Type: application/json",
+                "Content-Length: %d" % len(body)]
+        for k, v in (headers or {}).items():
+            head.append("%s: %s" % (k, v))
+        s.sendall(("\r\n".join(head) + "\r\n\r\n").encode("latin-1"))
+        sent = 0
+        t_end = time.monotonic() + give_up_s
+        while sent < len(body) and time.monotonic() < t_end:
+            # the server may answer (and close) mid-trickle: that IS
+            # the pass condition, surface it instead of ECONNRESET
+            s.settimeout(trickle_delay_s)
+            try:
+                peek = s.recv(1, socket.MSG_PEEK)
+                if peek:
+                    return _recv_response(s, timeout=give_up_s)
+                break                      # orderly close, no bytes
+            except socket.timeout:
+                pass                       # no answer yet: keep dripping
+            try:
+                s.sendall(body[sent:sent + bytes_per_trickle])
+            except OSError:
+                break                      # server cut us mid-send
+            sent += bytes_per_trickle
+        return _recv_response(s, timeout=give_up_s)
+    finally:
+        s.close()
+
+
+def disconnecting_stream_post(host, port, path, body, headers=None,
+                              read_bytes=1, rst=True, timeout=30.0):
+    """Open a streaming request, read ``read_bytes`` of the response
+    body (so the stream is live), then vanish — with ``rst`` the close
+    carries SO_LINGER=0 (TCP RST), so the server's next write fails
+    immediately instead of buffering into a dead socket.  Returns
+    ``(status, bytes_read_before_disconnect)``."""
+    import socket
+
+    if isinstance(body, str):
+        body = body.encode("utf-8")
+    s = _connect(host, port, timeout=timeout)
+    try:
+        head = ["POST %s HTTP/1.1" % path,
+                "Host: %s:%d" % (host, int(port)),
+                "Content-Type: application/json",
+                "Content-Length: %d" % len(body)]
+        for k, v in (headers or {}).items():
+            head.append("%s: %s" % (k, v))
+        s.sendall(("\r\n".join(head) + "\r\n\r\n").encode("latin-1")
+                  + body)
+        s.settimeout(timeout)
+        data = b""
+        # read past the header block, then ``read_bytes`` of body
+        while b"\r\n\r\n" not in data:
+            chunk = s.recv(4096)
+            if not chunk:
+                break
+            data += chunk
+        header, _, bodypart = data.partition(b"\r\n\r\n")
+        while len(bodypart) < read_bytes:
+            chunk = s.recv(4096)
+            if not chunk:
+                break
+            bodypart += chunk
+        status = 0
+        first = header.split(b"\r\n", 1)[0].split()
+        if len(first) >= 2:
+            try:
+                status = int(first[1])
+            except ValueError:
+                pass
+        if rst:
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_LINGER,
+                         struct_pack_linger())
+        return status, len(bodypart)
+    finally:
+        s.close()
+
+
+def struct_pack_linger():
+    """SO_LINGER {on, 0s}: close() sends RST instead of FIN, so the
+    peer's next write hits ECONNRESET/EPIPE at once — the
+    deterministic mid-stream disconnect."""
+    import struct
+
+    return struct.pack("ii", 1, 0)
+
+
+def malformed_post(host, port, path, raw_body=b"{not json",
+                   headers=None, content_length=None, timeout=10.0):
+    """A syntactically-valid HTTP request carrying a broken payload
+    (bad JSON by default; pass ``content_length`` to lie about the
+    size — larger than sent = truncated body).  Returns
+    ``(status, raw)``."""
+    s = _connect(host, port, timeout=timeout)
+    try:
+        if isinstance(raw_body, str):
+            raw_body = raw_body.encode("utf-8")
+        n = len(raw_body) if content_length is None else content_length
+        head = ["POST %s HTTP/1.1" % path,
+                "Host: %s:%d" % (host, int(port)),
+                "Content-Type: application/json",
+                "Content-Length: %d" % n]
+        for k, v in (headers or {}).items():
+            head.append("%s: %s" % (k, v))
+        s.sendall(("\r\n".join(head) + "\r\n\r\n").encode("latin-1")
+                  + raw_body)
+        return _recv_response(s, timeout=timeout)
+    finally:
+        s.close()
+
+
+def oversized_post(host, port, path, claim_bytes, headers=None,
+                   timeout=10.0):
+    """Claim a ``claim_bytes`` Content-Length (send nothing): a
+    correct server refuses by the header alone (413) without reading —
+    the memory-bomb probe.  Returns ``(status, raw)``."""
+    return malformed_post(host, port, path, raw_body=b"",
+                          headers=headers, content_length=claim_bytes,
+                          timeout=timeout)

@@ -1,0 +1,735 @@
+"""HTTP serving gateway: wire contract, routing, tenancy, lifecycle.
+
+Covers (stdlib HTTP client only, fake in-process backends — the real
+TokenServer/chaos coverage is tests/test_gateway_chaos.py):
+
+* the taxonomy->wire-code map, including the row-for-row parity guard
+  against the docs/lm_serving.md table (docs and wire cannot drift);
+* predict + SSE generate round-trips over real HTTP, deadline and
+  trace-id header threading, wire hygiene (404/400/413);
+* per-tenant token-bucket quotas (429 + Retry-After) and weighted fair
+  queueing (unit-level grant order + HTTP queue-full shed);
+* deploy/rollback/canary over a real AOT-store manifest with no
+  dropped in-flight requests;
+* drain-first close (healthz flips 503 before the listener stops) and
+  the readiness-deregistration regression (a gateway closed
+  mid-request must not leave a stale 503);
+* /statusz gateway subsystem, heartbeat line, bench --gateway sweep,
+  and events_query --by tenant over gateway_request wide events.
+"""
+import http.client
+import json
+import os
+import re
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from mxnet_tpu import events
+from mxnet_tpu import gateway as gwmod
+from mxnet_tpu import telemetry as tel
+from mxnet_tpu.gateway import (CONTRACT, FairQueue, Gateway, TokenBucket,
+                               wire_code)
+from mxnet_tpu.serving_async import (Cancelled, DeadlineExceeded,
+                                     Overloaded)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+
+
+@pytest.fixture
+def registry():
+    tel.enable()
+    tel.reset()
+    events.enable(path="", sample=1.0)
+    events.reset()
+    yield tel
+    events.reset()
+    events.disable()
+    tel.reset()
+    tel.disable()
+
+
+# ---------------------------------------------------------------------------
+# fake backends (serving submit protocol, no device work)
+# ---------------------------------------------------------------------------
+
+class _Fut:
+    """Minimal ServingFuture stand-in: threadsafe, first-writer-wins."""
+
+    def __init__(self):
+        self._ev = threading.Event()
+        self._res = None
+        self._exc = None
+        self.cancelled_flag = False
+
+    def _set(self, res=None, exc=None):
+        if self._ev.is_set():
+            return False
+        self._res, self._exc = res, exc
+        self._ev.set()
+        return True
+
+    def done(self):
+        return self._ev.is_set()
+
+    def cancel(self):
+        self.cancelled_flag = True
+        return self._set(exc=Cancelled("cancelled"))
+
+    def result(self, timeout=None):
+        if not self._ev.wait(timeout):
+            raise TimeoutError("unresolved")
+        if self._exc is not None:
+            raise self._exc
+        return self._res
+
+
+class FakePredict:
+    """AsyncPredictor stand-in: doubles the batch.  ``hold`` (an Event)
+    delays resolution until set; ``admit_exc`` raises at submit."""
+
+    def __init__(self, scale=2.0, hold=None, admit_exc=None,
+                 canary_ok=True, tag=None):
+        self.scale = scale
+        self.hold = hold
+        self.admit_exc = admit_exc
+        self.canary_ok = canary_ok
+        self.tag = tag
+        self.submits = 0
+
+    def submit(self, batch, deadline_ms=None):
+        self.submits += 1
+        if self.admit_exc is not None:
+            raise self.admit_exc
+        fut = _Fut()
+        out = (np.asarray(batch) * self.scale)
+
+        def run():
+            if self.hold is not None:
+                self.hold.wait(10)
+            fut._set(res=out)
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+    def canary(self):
+        return self.canary_ok
+
+
+class FakeTokenServer:
+    """TokenServer stand-in: streams ``tokens`` through on_token then
+    resolves.  ``admit_exc`` fails submit typed; ``final_exc`` resolves
+    the future with a typed failure after streaming; ``hold`` stalls
+    resolution (the stuck-backend scenario)."""
+
+    def __init__(self, tokens=(7, 8, 9), delay=0.0, admit_exc=None,
+                 final_exc=None, hold=None):
+        self.tokens = list(tokens)
+        self.delay = delay
+        self.admit_exc = admit_exc
+        self.final_exc = final_exc
+        self.hold = hold
+        self.cancelled = threading.Event()
+
+    def submit(self, token_ids, deadline_ms=None, max_new_tokens=None,
+               on_token=None):
+        if self.admit_exc is not None:
+            raise self.admit_exc
+        fut = _Fut()
+
+        def run():
+            for t in self.tokens:
+                if self.delay:
+                    time.sleep(self.delay)
+                if fut.done():          # cancelled mid-stream
+                    self.cancelled.set()
+                    return
+                if on_token is not None:
+                    on_token(t)
+            if self.hold is not None:
+                if not self.hold.wait(10):
+                    return
+            if self.final_exc is not None:
+                fut._set(exc=self.final_exc)
+            else:
+                fut._set(res={"tokens": list(self.tokens),
+                              "finish_reason": "length",
+                              "ttft_s": 0.001})
+
+        threading.Thread(target=run, daemon=True).start()
+        return fut
+
+
+# ---------------------------------------------------------------------------
+# HTTP helpers (stdlib only)
+# ---------------------------------------------------------------------------
+
+def _post(port, path, body, headers=None, timeout=10):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    payload = json.dumps(body) if isinstance(body, dict) else body
+    hdrs = {"Content-Type": "application/json",
+            "Content-Length": str(len(payload))}
+    hdrs.update(headers or {})
+    conn.request("POST", path, body=payload, headers=hdrs)
+    resp = conn.getresponse()
+    data = resp.read()
+    out = (resp.status, dict(resp.getheaders()), data)
+    conn.close()
+    return out
+
+
+def _get(port, path, timeout=10):
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    conn.request("GET", path)
+    resp = conn.getresponse()
+    data = resp.read()
+    out = (resp.status, data)
+    conn.close()
+    return out
+
+
+def _sse_frames(raw):
+    """data: payloads of an SSE byte stream, parsed."""
+    return [json.loads(part[len(b"data: "):])
+            for part in raw.split(b"\n\n")
+            if part.startswith(b"data: ")]
+
+
+def _gw_events():
+    return [e for e in events.recent() if e["kind"] == "gateway_request"]
+
+
+# ---------------------------------------------------------------------------
+# the wire contract
+# ---------------------------------------------------------------------------
+
+def test_contract_parity_with_docs():
+    """The docs/lm_serving.md HTTP table IS the gateway map — parsed
+    row-for-row, asserted both directions (the drift guard the issue
+    names)."""
+    path = os.path.join(REPO, "docs", "lm_serving.md")
+    with open(path) as f:
+        text = f.read()
+    rows = re.findall(
+        r"^\|[^|]+\| `(Overloaded|DeadlineExceeded|Cancelled)"
+        r"(?:\((reason|stage)=([^)]*)\))?` \| (\d{3}) \|",
+        text, re.M)
+    assert rows, "HTTP contract table not found in docs/lm_serving.md"
+    doc_map = {}
+    for typ, _, qual, code in rows:
+        quals = [q.strip().strip('"') for q in qual.split("/")] \
+            if qual else [None]
+        for q in quals:
+            doc_map[(typ, q)] = int(code)
+    assert doc_map == CONTRACT
+
+
+def test_wire_code_covers_the_whole_taxonomy():
+    assert wire_code(Overloaded("queue", "x")) == 429
+    assert wire_code(Overloaded("slots", "x")) == 429
+    assert wire_code(Overloaded("slo", "x")) == 429
+    assert wire_code(Overloaded("shutdown", "x")) == 503
+    # degraded fallbacks for taxonomy members off the table
+    assert wire_code(Overloaded("inflight", "x")) == 429
+    assert wire_code(DeadlineExceeded("prefill", "x")) == 504
+    assert wire_code(DeadlineExceeded("decode", "x")) == 504
+    assert wire_code(DeadlineExceeded("pickup", "x")) == 504
+    assert wire_code(Cancelled("x")) == 499
+    assert wire_code(ValueError("x")) == 500
+
+
+# ---------------------------------------------------------------------------
+# round trips
+# ---------------------------------------------------------------------------
+
+def test_predict_roundtrip(registry):
+    with Gateway(port=0) as gw:
+        gw.add_route("m", FakePredict(scale=3.0), version=None,
+                     kind="predict")
+        status, headers, body = _post(gw.port, "/v1/predict/m",
+                                      {"rows": [[1.0, 2.0]]})
+        assert status == 200
+        out = json.loads(body)
+        assert out["outputs"] == [[3.0, 6.0]]
+    # exactly one wide event, outcome ok, wire code carried
+    evs = _gw_events()
+    assert len(evs) == 1
+    assert evs[0]["outcome"] == "ok" and evs[0]["http_status"] == 200
+    assert evs[0]["model"] == "m" and evs[0]["op"] == "predict"
+
+
+def test_generate_sse_stream(registry):
+    with Gateway(port=0) as gw:
+        gw.add_route("lm", FakeTokenServer(tokens=(4, 5, 6)))
+        status, headers, body = _post(gw.port, "/v1/generate/lm",
+                                      {"tokens": [1, 2]})
+        assert status == 200
+        assert headers.get("Content-Type") == "text/event-stream"
+        frames = _sse_frames(body)
+        assert [f["token"] for f in frames[:-1]] == [4, 5, 6]
+        assert frames[-1]["done"] is True
+        assert frames[-1]["finish_reason"] == "length"
+    evs = _gw_events()
+    assert len(evs) == 1 and evs[0]["tokens"] == 3
+    assert tel.GATEWAY_STREAM_TOKENS.value() == 3
+
+
+def test_trace_id_and_tenant_ride_the_event(registry):
+    with Gateway(port=0) as gw:
+        gw.add_route("m", FakePredict(), kind="predict")
+        _post(gw.port, "/v1/predict/m", {"rows": [[1.0]]},
+              headers={"X-Trace-Id": "trace-abc", "X-Tenant": "acme"})
+    (ev,) = _gw_events()
+    assert ev["trace_id"] == "trace-abc"
+    assert ev["tenant"] == "acme"
+
+
+def test_typed_backend_errors_map_to_wire(registry):
+    with Gateway(port=0) as gw:
+        gw.add_route("full", FakeTokenServer(
+            admit_exc=Overloaded("queue", "full")))
+        gw.add_route("closed", FakeTokenServer(
+            admit_exc=Overloaded("shutdown", "closing")))
+        gw.add_route("late", FakeTokenServer(
+            tokens=(), final_exc=DeadlineExceeded("prefill", "late")))
+        status, headers, _ = _post(gw.port, "/v1/generate/full",
+                                   {"tokens": [1]})
+        assert status == 429 and "Retry-After" in headers
+        status, headers, _ = _post(gw.port, "/v1/generate/closed",
+                                   {"tokens": [1]})
+        assert status == 503
+        status, _, _ = _post(gw.port, "/v1/generate/late",
+                             {"tokens": [1]})
+        assert status == 504
+    codes = {e["http_status"] for e in _gw_events()}
+    assert codes == {429, 503, 504}
+
+
+def test_midstream_failure_carries_code_in_sse_frame(registry):
+    """After the 200 is on the wire, a typed failure arrives as a final
+    SSE error frame with the contracted code (and the event carries
+    it)."""
+    with Gateway(port=0) as gw:
+        gw.add_route("lm", FakeTokenServer(
+            tokens=(1, 2), final_exc=DeadlineExceeded("decode", "mid")))
+        status, _, body = _post(gw.port, "/v1/generate/lm",
+                                {"tokens": [1]})
+        assert status == 200               # already streaming
+        frames = _sse_frames(body)
+        assert frames[-1]["error"]["code"] == 504
+    (ev,) = _gw_events()
+    assert ev["http_status"] == 504 and ev["outcome"] == "deadline"
+
+
+def test_deadline_header_threads_into_admission(registry):
+    """X-Deadline-Ms reaches the backend's own clock: a backend holding
+    past the deadline is cancelled and answered 504."""
+    with Gateway(port=0) as gw:
+        hold = threading.Event()           # never set: stalled backend
+        gw.add_route("slow", FakeTokenServer(tokens=(), hold=hold))
+        t0 = time.monotonic()
+        status, _, _ = _post(gw.port, "/v1/generate/slow",
+                             {"tokens": [1]},
+                             headers={"X-Deadline-Ms": "150"})
+        assert status == 504
+        assert time.monotonic() - t0 < 5.0
+        hold.set()
+    (ev,) = _gw_events()
+    assert ev["outcome"] == "deadline" and ev["http_status"] == 504
+
+
+def test_wire_hygiene_404_400_413(registry):
+    with Gateway(port=0, max_body=256) as gw:
+        gw.add_route("m", FakePredict(), kind="predict")
+        assert _post(gw.port, "/v1/predict/ghost",
+                     {"rows": [[1.0]]})[0] == 404
+        assert _post(gw.port, "/nope", {"x": 1})[0] == 404
+        assert _post(gw.port, "/v1/predict/m", "{not json")[0] == 400
+        assert _post(gw.port, "/v1/predict/m", {"rows": [[1.0]]},
+                     headers={"X-Deadline-Ms": "soon"})[0] == 400
+        big = json.dumps({"rows": [[0.0] * 500]})
+        assert _post(gw.port, "/v1/predict/m", big)[0] == 413
+        assert tel.GATEWAY_BAD_REQUESTS.value(kind="oversized") == 1
+    # one event per request, even the refused ones
+    assert len(_gw_events()) == 5
+
+
+# ---------------------------------------------------------------------------
+# tenancy: quotas + weighted fair queueing
+# ---------------------------------------------------------------------------
+
+def test_token_bucket_refill_math():
+    b = TokenBucket(rate=10.0, burst=2)
+    assert b.take() == (True, 0.0)
+    assert b.take()[0] is True
+    ok, retry = b.take()
+    assert ok is False and 0.0 < retry <= 0.11
+    time.sleep(0.12)
+    assert b.take()[0] is True             # refilled ~1 token
+
+
+def test_quota_429_with_retry_after(registry):
+    with Gateway(port=0, quota_qps=0.5, quota_burst=1) as gw:
+        gw.add_route("m", FakePredict(), kind="predict")
+        assert _post(gw.port, "/v1/predict/m",
+                     {"rows": [[1.0]]})[0] == 200
+        status, headers, _ = _post(gw.port, "/v1/predict/m",
+                                   {"rows": [[1.0]]})
+        assert status == 429
+        assert int(headers["Retry-After"]) >= 1
+        # another tenant has its own bucket
+        assert _post(gw.port, "/v1/predict/m", {"rows": [[1.0]]},
+                     headers={"X-Tenant": "other"})[0] == 200
+    assert tel.GATEWAY_QUOTA_SHED.value(tenant="default") == 1
+
+
+def test_fair_queue_weighted_grant_order():
+    """With the single permit held, tenant A (weight 4) and tenant B
+    (weight 1) each queue 3 waiters: virtual finish times are A
+    .25/.5/.75 vs B 1/2/3, so every release grants all of A first —
+    weighted max-min, deterministic."""
+    fq = FairQueue(permits=1, depth=8, weights={"a": 4.0, "b": 1.0})
+    fq.acquire("holder")                   # pin the permit
+    order = []
+
+    def waiter(tenant):
+        fq.acquire(tenant)
+        order.append(tenant)
+        fq.release()
+
+    threads = []
+    for tenant in ["a", "a", "a"]:
+        t = threading.Thread(target=waiter, args=(tenant,), daemon=True)
+        t.start()
+        threads.append(t)
+        time.sleep(0.05)                   # deterministic enqueue order
+    for tenant in ["b", "b", "b"]:
+        t = threading.Thread(target=waiter, args=(tenant,), daemon=True)
+        t.start()
+        threads.append(t)
+        time.sleep(0.05)
+    assert fq.depths() == {"a": 3, "b": 3}
+    fq.release()                           # the chain self-propagates
+    for t in threads:
+        t.join(5)
+    assert order == ["a", "a", "a", "b", "b", "b"]
+
+
+def test_fair_queue_typed_rejections():
+    fq = FairQueue(permits=1, depth=1)
+    fq.acquire("t")
+
+    def quiet_acquire():
+        try:
+            fq.acquire("t")
+        except Overloaded:
+            pass                           # the close() below frees it
+
+    threading.Thread(target=quiet_acquire, daemon=True).start()
+    time.sleep(0.1)                        # one waiter queued = depth
+    with pytest.raises(Overloaded) as ei:
+        fq.acquire("t")
+    assert ei.value.reason == "queue"
+    with pytest.raises(DeadlineExceeded) as ei:
+        fq.acquire("u", deadline=time.monotonic() + 0.05)
+    assert ei.value.stage == "queue"
+    fq.close()
+    with pytest.raises(Overloaded) as ei:
+        fq.acquire("v")
+    assert ei.value.reason == "shutdown"
+
+
+def test_hot_tenant_sheds_429_over_http(registry):
+    """concurrency 1 + tenant depth 1: the third concurrent request
+    from one tenant sheds Overloaded('queue') -> 429 while the first
+    two complete."""
+    hold = threading.Event()
+    with Gateway(port=0, concurrency=1, queue_depth=1) as gw:
+        gw.add_route("m", FakePredict(hold=hold), kind="predict")
+        results = []
+
+        def fire():
+            results.append(_post(gw.port, "/v1/predict/m",
+                                 {"rows": [[1.0]]})[0])
+
+        threads = [threading.Thread(target=fire, daemon=True)
+                   for _ in range(3)]
+        for t in threads:
+            t.start()
+            time.sleep(0.15)               # occupy permit, then queue
+        hold.set()
+        for t in threads:
+            t.join(10)
+        assert sorted(results) == [200, 200, 429]
+    assert len(_gw_events()) == 3
+
+
+# ---------------------------------------------------------------------------
+# deploy / rollback / canary over the AOT manifest
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def store(tmp_path):
+    from mxnet_tpu.aot import AOTStore
+
+    s = AOTStore(tmp_path / "aot")
+    s.manifest_append({"key": "v1", "spec": "tiny@1"})
+    s.manifest_append({"key": "v2", "spec": "tiny@2"})
+    return s
+
+
+def test_deploy_rollback_canary_end_to_end(registry, store):
+    """The full deploy story: two manifest versions, canary-probed
+    flip, deterministic canary split, rollback — and an in-flight
+    request survives the flip on its original backend."""
+    a = FakePredict(scale=1.0, tag="a")
+    b = FakePredict(scale=10.0, tag="b")
+    hold = threading.Event()
+    slow_a = FakePredict(scale=1.0, hold=hold)
+    with Gateway(port=0, store=store) as gw:
+        # a route version must exist in the manifest
+        with pytest.raises(ValueError):
+            gw.add_route("m", a, version="ghost", kind="predict")
+        gw.add_route("m", slow_a, version="v1", kind="predict")
+
+        # launch an in-flight request against v1, then flip mid-flight
+        inflight = {}
+
+        def fire():
+            inflight["resp"] = _post(gw.port, "/v1/predict/m",
+                                     {"rows": [[2.0]]})
+
+        t = threading.Thread(target=fire, daemon=True)
+        t.start()
+        time.sleep(0.2)
+
+        # deploy validates the version and canary-probes the backend
+        with pytest.raises(ValueError):
+            gw.deploy("m", b, version="v3")
+        with pytest.raises(RuntimeError):
+            gw.deploy("m", FakePredict(canary_ok=False), version="v2")
+        assert gw.routes()["m"]["version"] == "v1"   # untouched
+        gw.deploy("m", b, version="v2")
+        assert gw.routes()["m"]["version"] == "v2"
+
+        # the in-flight request finishes on the old backend: no drop
+        hold.set()
+        t.join(10)
+        status, _, body = inflight["resp"]
+        assert status == 200
+        assert json.loads(body) == {"outputs": [[2.0]], "version": "v1"}
+
+        # new traffic rides v2
+        _, _, body = _post(gw.port, "/v1/predict/m", {"rows": [[1.0]]})
+        assert json.loads(body) == {"outputs": [[10.0]], "version": "v2"}
+
+        # canary: deterministic 50% split alternates versions
+        gw.set_canary("m", a, version="v1", weight=0.5)
+        seen = []
+        for _ in range(4):
+            _, _, body = _post(gw.port, "/v1/predict/m",
+                               {"rows": [[1.0]]})
+            seen.append(json.loads(body)["version"])
+        assert sorted(seen) == ["v1", "v1", "v2", "v2"]
+        gw.clear_canary("m")
+
+        # rollback flips back atomically
+        gw.rollback("m")
+        assert gw.routes()["m"]["version"] == "v1"
+        _, _, body = _post(gw.port, "/v1/predict/m", {"rows": [[4.0]]})
+        assert json.loads(body)["version"] == "v1"
+
+        assert tel.GATEWAY_ROUTE_FLIPS.value(op="deploy") == 1
+        assert tel.GATEWAY_ROUTE_FLIPS.value(op="rollback") == 1
+        assert tel.GATEWAY_ROUTE_FLIPS.value(op="canary") == 1
+
+
+# ---------------------------------------------------------------------------
+# lifecycle: drain-first close, readiness deregistration, SIGTERM
+# ---------------------------------------------------------------------------
+
+def test_healthz_flips_503_before_listener_stops(registry):
+    hold = threading.Event()
+    gw = Gateway(port=0, concurrency=2)
+    gw.add_route("m", FakePredict(hold=hold), kind="predict")
+    inflight = {}
+
+    def fire():
+        inflight["resp"] = _post(gw.port, "/v1/predict/m",
+                                 {"rows": [[1.0]]})
+
+    t = threading.Thread(target=fire, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    closer = threading.Thread(target=gw.close,
+                              kwargs={"drain": True, "timeout": 10},
+                              daemon=True)
+    closer.start()
+    time.sleep(0.2)
+    # draining: probes see 503 and new work sheds typed, but the
+    # listener still answers (connection-refused-free)
+    status, body = _get(gw.port, "/healthz")
+    assert status == 503
+    assert "gateway" in json.loads(body)["failing"]
+    assert _post(gw.port, "/v1/predict/m", {"rows": [[1.0]]})[0] == 503
+    # the open stream finishes; close completes
+    hold.set()
+    t.join(10)
+    closer.join(10)
+    assert inflight["resp"][0] == 200
+    # deregistered: readiness is clean again for a successor
+    ready, _ = tel.readiness()
+    assert ready
+
+
+def test_closed_mid_request_deregisters_readiness(registry):
+    """The regression the issue names: a gateway torn down with a
+    request still open must deregister its readiness check like a
+    closed AsyncPredictor — no stale 503 for the next process."""
+    hold = threading.Event()
+    gw = Gateway(port=0)
+    gw.add_route("m", FakePredict(hold=hold), kind="predict")
+    threading.Thread(
+        target=lambda: _post(gw.port, "/v1/predict/m",
+                             {"rows": [[1.0]]}),
+        daemon=True).start()
+    time.sleep(0.2)
+    with gw._open_cond:
+        assert gw._open_streams == 1
+    # close with a drain budget too small for the stuck stream
+    gw.close(drain=True, timeout=0.2)
+    assert gw._closed
+    ready, checks = tel.readiness()
+    assert ready, "stale gateway readiness check survived close(): %r" \
+        % (checks,)
+    # a successor gateway starts clean and serves
+    with Gateway(port=0) as gw2:
+        gw2.add_route("m", FakePredict(), kind="predict")
+        assert _post(gw2.port, "/v1/predict/m",
+                     {"rows": [[1.0]]})[0] == 200
+        assert _get(gw2.port, "/healthz")[0] == 200
+    hold.set()
+
+
+def test_sigterm_drains(registry):
+    import signal
+
+    gw = Gateway(port=0)
+    gw.add_route("m", FakePredict(), kind="predict")
+    prev = gw.install_signal_handler()
+    try:
+        assert _get(gw.port, "/healthz")[0] == 200
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 10
+        ready = False
+        while time.monotonic() < deadline:
+            ready = gw._closed and tel.readiness()[0]
+            if ready:
+                break
+            time.sleep(0.02)
+        assert gw._closed
+        assert ready, "gateway still holding readiness after SIGTERM"
+    finally:
+        signal.signal(signal.SIGTERM, prev)
+
+
+# ---------------------------------------------------------------------------
+# observability: statusz subsystem, heartbeat, scrape routes
+# ---------------------------------------------------------------------------
+
+def test_statusz_gateway_subsystem_and_scrape_routes(registry):
+    with Gateway(port=0) as gw:
+        gw.add_route("m", FakePredict(), version=None, kind="predict")
+        _post(gw.port, "/v1/predict/m", {"rows": [[1.0]]},
+              headers={"X-Tenant": "acme"})
+        status, body = _get(gw.port, "/statusz")
+        assert status == 200
+        sub = json.loads(body)["subsystems"]["gateway"]
+        assert sub["responses"].get("200") == 1
+        assert sub["requests"].get("acme") == 1
+        assert sub["open_streams"] == 0
+        (gview,) = sub["gateways"]
+        assert gview["routes"]["m"]["kind"] == "predict"
+        # the same listener serves the scrape surface
+        status, body = _get(gw.port, "/metrics")
+        assert status == 200
+        assert b"mxnet_tpu_gateway_responses_total" in body
+        assert _get(gw.port, "/varz")[0] == 200
+        status, body = _get(gw.port, "/requestz")
+        assert status == 200
+        assert json.loads(body)["stats"]["emitted"] >= 1
+
+
+def test_heartbeat_line_gains_gateway_section(registry):
+    from mxnet_tpu.monitor import TelemetryHeartbeat
+
+    line = TelemetryHeartbeat().line()
+    assert "gw_streams" not in line        # silent before traffic
+    with Gateway(port=0) as gw:
+        gw.add_route("m", FakePredict(), kind="predict")
+        _post(gw.port, "/v1/predict/m", {"rows": [[1.0]]})
+        _post(gw.port, "/v1/predict/ghost", {"rows": [[1.0]]})
+    tel.GATEWAY_RESPONSES.inc(code="429")  # one shed for the rate
+    line = TelemetryHeartbeat().line()
+    assert "gw_streams 0" in line
+    assert "gw_shed 33%" in line
+
+
+# ---------------------------------------------------------------------------
+# satellites: bench --gateway, events_query --by tenant
+# ---------------------------------------------------------------------------
+
+def test_bench_serving_gateway_sweep(registry):
+    """--load --gateway: the Poisson sweep rides real HTTP and emits
+    the same schema-valid ledger records (transport marked)."""
+    sys.path.insert(0, TOOLS)
+    try:
+        import importlib
+
+        import bench_serving
+
+        importlib.reload(bench_serving)
+        out = bench_serving.run_load([40.0], duration=0.4,
+                                     deadline_ms=2000.0, gateway=True)
+    finally:
+        sys.path.remove(TOOLS)
+    assert out["transport"] == "http"
+    (row,) = out["sweep"]
+    assert row["offered"] > 0
+    assert row["completed"] + row["shed"] + row["timeouts"] \
+        + row["errors"] == row["offered"]
+    assert row["errors"] == 0
+    from mxnet_tpu import perf_ledger
+
+    (rec,) = bench_serving.ledger_records(out)
+    perf_ledger.validate_record(rec)
+    assert rec["transport"] == "http"
+
+
+def test_events_query_by_tenant(registry, tmp_path, capsys):
+    path = str(tmp_path / "events.jsonl")
+    events.enable(path=path, sample=1.0)
+    with Gateway(port=0) as gw:
+        gw.add_route("m", FakePredict(), kind="predict")
+        for tenant in ("acme", "acme", "globex"):
+            _post(gw.port, "/v1/predict/m", {"rows": [[1.0]]},
+                  headers={"X-Tenant": tenant})
+    events.flush()
+    sys.path.insert(0, TOOLS)
+    try:
+        import importlib
+
+        import events_query
+
+        importlib.reload(events_query)
+        rc = events_query.main([path, "--kind", "gateway_request",
+                                "--by", "tenant"])
+    finally:
+        sys.path.remove(TOOLS)
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "acme" in out and "globex" in out

@@ -30,7 +30,7 @@ for.
 Usage: python tools/bench_serving.py [--json docs/serving_bench.json]
        python tools/bench_serving.py --load --qps 20,50,100 \
            [--duration 5] [--deadline-ms 200] [--replicas 1] \
-           [--json docs/serving_load.json]
+           [--gateway] [--json docs/serving_load.json]
 """
 import argparse
 import json
@@ -47,7 +47,8 @@ import mxnet_tpu as mx  # noqa: E402
 from mxnet_tpu.gluon.model_zoo import vision  # noqa: E402
 from mxnet_tpu.serving import Predictor, uint8_normalizer  # noqa: E402
 from mxnet_tpu.serving_async import (AsyncPredictor,  # noqa: E402
-                                     DeadlineExceeded, ServingError)
+                                     DeadlineExceeded, Overloaded,
+                                     ServingError)
 
 
 def ledger_records(results):
@@ -209,9 +210,72 @@ def _load_predictor(batch_rows, feat, replicas, chain):
         batch_window_ms=1.0), len(jax.devices())
 
 
+class _HttpFuture:
+    """stdlib-HTTP stand-in for a ServingFuture: one daemon thread per
+    request (open loop — submit never blocks on the server), resolving
+    to the parsed body or the wire code mapped back onto the typed
+    taxonomy (429/503 -> Overloaded, 504/408 -> DeadlineExceeded), so
+    the sweep's accounting is transport-agnostic."""
+
+    def __init__(self, host, port, model, payload, deadline_ms):
+        import threading
+
+        self.resolved_at = None
+        self._out = None
+        self._exc = None
+        self._done = threading.Event()
+        t = threading.Thread(
+            target=self._run,
+            args=(host, port, model, payload, deadline_ms), daemon=True)
+        t.start()
+
+    def _run(self, host, port, model, payload, deadline_ms):
+        import http.client
+
+        try:
+            conn = http.client.HTTPConnection(host, port, timeout=30)
+            headers = {"Content-Type": "application/json",
+                       "Content-Length": str(len(payload))}
+            if deadline_ms:
+                headers["X-Deadline-Ms"] = str(deadline_ms)
+            conn.request("POST", "/v1/predict/%s" % model, body=payload,
+                         headers=headers)
+            resp = conn.getresponse()
+            body = resp.read()
+            self.resolved_at = time.monotonic()
+            if resp.status == 200:
+                self._out = json.loads(body)["outputs"]
+            elif resp.status == 429:
+                self._exc = Overloaded("queue", "HTTP 429")
+            elif resp.status == 503:
+                self._exc = Overloaded("shutdown", "HTTP 503")
+            elif resp.status in (504, 408):
+                self._exc = DeadlineExceeded("dispatch",
+                                             "HTTP %d" % resp.status)
+            else:
+                self._exc = ServingError("HTTP %d: %s"
+                                         % (resp.status, body[:200]))
+            conn.close()
+        except Exception as e:
+            self.resolved_at = time.monotonic()
+            self._exc = ServingError("transport: %s" % e)
+        finally:
+            self._done.set()
+
+    def result(self, timeout=None):
+        if not self._done.wait(timeout):
+            raise TimeoutError("HTTP request unresolved")
+        if self._exc is not None:
+            raise self._exc
+        return self._out
+
+    def cancel(self):
+        return False
+
+
 def run_load(qps_list, duration=5.0, batch_rows=8, feat=16, rows=1,
              chain=8, replicas=1, deadline_ms=200.0, seed=0,
-             json_path=None):
+             gateway=False, json_path=None):
     """Open-loop Poisson load sweep against the async tier.
 
     Per target QPS: submit ``rows``-row requests at exponential
@@ -219,6 +283,12 @@ def run_load(qps_list, duration=5.0, batch_rows=8, feat=16, rows=1,
     server — open loop), then join every future and report latency
     percentiles over completions plus shed/timeout/error rates over
     offered load.  One BENCH JSON line per rate.
+
+    ``gateway=True`` drives the same sweep over real HTTP: an
+    in-process :class:`mxnet_tpu.gateway.Gateway` routes ``load`` to
+    the AsyncPredictor and every request rides a stdlib HTTP client
+    (shed/timeout/p99 measured at the wire, same perf_ledger records —
+    ``transport: "http"`` marks the rows).
     """
     from mxnet_tpu import telemetry as tel
 
@@ -230,6 +300,23 @@ def run_load(qps_list, duration=5.0, batch_rows=8, feat=16, rows=1,
            "rows_per_request": rows, "batch_rows": batch_rows,
            "chain": chain, "replicas": replicas, "devices": n_devs,
            "deadline_ms": deadline_ms, "sweep": []}
+    gw = None
+    if gateway:
+        from mxnet_tpu.gateway import Gateway
+
+        # WFQ sized to the predictor's own pipeline capacity so the
+        # gateway measures the backend's admission, not its own
+        gw = Gateway(port=0, concurrency=max(16, 2 * chain),
+                     queue_depth=256)
+        gw.add_route("load", ap, kind="predict")
+        payload = json.dumps({"rows": req.tolist()})
+        out["transport"] = "http"
+
+        def _submit(batch, deadline_ms=None):
+            return _HttpFuture(gw.host, gw.port, "load", payload,
+                               deadline_ms)
+    else:
+        _submit = ap.submit
     try:
         for qps in qps_list:
             rng = np.random.RandomState(seed)
@@ -246,7 +333,7 @@ def run_load(qps_list, duration=5.0, batch_rows=8, feat=16, rows=1,
                 t0 = time.monotonic()
                 try:
                     inflight.append(
-                        (ap.submit(req, deadline_ms=deadline_ms), t0))
+                        (_submit(req, deadline_ms=deadline_ms), t0))
                 except ServingError:
                     shed += 1
                 next_t += rng.exponential(1.0 / qps)
@@ -255,6 +342,10 @@ def run_load(qps_list, duration=5.0, batch_rows=8, feat=16, rows=1,
                 try:
                     fut.result(timeout=30)
                     lats.append(fut.resolved_at - t0)
+                except Overloaded:
+                    # HTTP transport learns a shed at response time
+                    # (429/503), not at submit like in-process
+                    shed += 1
                 except DeadlineExceeded:
                     timeouts += 1
                 except TimeoutError:
@@ -296,6 +387,8 @@ def run_load(qps_list, duration=5.0, batch_rows=8, feat=16, rows=1,
                 {**{k: v for k, v in out.items() if k != "sweep"},
                  "sweep": [row]})[0])
     finally:
+        if gw is not None:
+            gw.close(timeout=5)
         ap.close(timeout=30)
     if json_path:
         with open(json_path, "w") as f:
@@ -320,12 +413,15 @@ if __name__ == "__main__":
     p.add_argument("--replicas", type=int, default=1)
     p.add_argument("--rows", type=int, default=1,
                    help="rows per request (--load)")
+    p.add_argument("--gateway", action="store_true",
+                   help="drive the --load sweep over real HTTP "
+                   "through an in-process serving gateway")
     a = p.parse_args()
     if a.load:
         run_load([float(q) for q in a.qps.split(",")],
                  duration=a.duration, chain=a.chain,
                  replicas=a.replicas, deadline_ms=a.deadline_ms,
-                 rows=a.rows, json_path=a.json)
+                 rows=a.rows, gateway=a.gateway, json_path=a.json)
     else:
         run(a.batch, a.n_batches, chain=a.chain, dtype=a.dtype,
             json_path=a.json)

@@ -6,7 +6,7 @@ and answers the questions aggregate histograms cannot:
 
 * **slices** — p50/p99/p999 (+count, mean) of ``dur_s`` grouped by any
   event fields (``--by kind,outcome`` default; ``stage``/``reason``/
-  ``error_kind``/``label`` work the same way);
+  ``error_kind``/``label``/``tenant`` work the same way);
 * **top-K slowest** — the actual requests behind the tail, each with
   its ``trace_id``/``span_id`` so the row links to the span tree and
   the ``/metrics`` exemplars;
@@ -172,7 +172,9 @@ def main(argv=None):
     p.add_argument("--by", default="kind,outcome",
                    help="comma list of fields to slice the latency "
                         "table by (default kind,outcome; stage/reason/"
-                        "error_kind/label/model work too)")
+                        "error_kind/label/model/tenant work too — "
+                        "tenant slices gateway_request events per "
+                        "caller)")
     p.add_argument("--top", type=int, default=10,
                    help="slowest events to list with trace ids")
     p.add_argument("--join", metavar="TRACE_JSON",
