@@ -389,6 +389,12 @@ FLAGS = {
         "gateway close()/SIGTERM drain budget in seconds: /healthz "
         "flips 503 first, new requests shed 503, open streams get "
         "this long to finish before the listener stops"),
+    "MXNET_GATEWAY_MAX_TENANTS": (
+        "256", _pint, "honored",
+        "cap on distinct X-Tenant values tracked by the gateway: "
+        "tenants past the cap collapse onto one shared overflow "
+        "key (bucket/queue/metric label), so minting unique tenant "
+        "headers cannot grow per-tenant state without bound"),
     "MXNET_DECODE_SLOTS": (
         "8", _pint, "honored",
         "generate.GenerationEngine default decode batch slots: the "

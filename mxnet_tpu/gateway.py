@@ -36,7 +36,10 @@ turns the typed error taxonomy into the wire contract written in
   ``_BURST``) and a WFQ dispatch queue
   (:class:`FairQueue`) in front of backend admission, so one hot
   tenant cannot starve the rest — it gets 429s while others keep their
-  weighted share of the ``MXNET_GATEWAY_CONCURRENCY`` permits.
+  weighted share of the ``MXNET_GATEWAY_CONCURRENCY`` permits.  At
+  most ``MXNET_GATEWAY_MAX_TENANTS`` distinct tenants are tracked;
+  the rest collapse onto one shared :data:`OVERFLOW_TENANT` key, so
+  minting unique headers cannot grow per-tenant state without bound.
 * **Drain-first shutdown**: :meth:`Gateway.close` (and the SIGTERM
   handler from :meth:`Gateway.install_signal_handler`) flips
   ``/healthz`` to 503 *first*, sheds new work typed
@@ -78,7 +81,8 @@ from .serving_async import (Cancelled, DeadlineExceeded, Overloaded,
                             ServingError)
 
 __all__ = ["Gateway", "FairQueue", "TokenBucket", "CONTRACT",
-           "wire_code", "serve_gateway", "stop_gateway", "gateway"]
+           "OVERFLOW_TENANT", "wire_code", "serve_gateway",
+           "stop_gateway", "gateway"]
 
 _logger = logging.getLogger("mxnet_tpu.gateway")
 
@@ -159,6 +163,14 @@ _telemetry.register_readiness("gateway", _gateway_ready)
 # per-tenant admission: token-bucket quota + weighted fair queueing
 # ---------------------------------------------------------------------------
 
+#: shared key that all tenants past ``MXNET_GATEWAY_MAX_TENANTS``
+#: collapse onto — per-tenant state is keyed by the attacker-controlled
+#: ``X-Tenant`` header, so without a cap a client minting a unique
+#: tenant per request would grow queues/buckets/metric labels without
+#: bound in an "overload-safe by construction" gateway
+OVERFLOW_TENANT = "~overflow"
+
+
 class TokenBucket:
     """Per-tenant request quota: ``burst`` capacity refilled at ``rate``
     per second.  ``take()`` returns ``(admitted, retry_after_s)`` — the
@@ -210,18 +222,32 @@ class FairQueue:
         self._vfinish = {}           # tenant -> last assigned vf
         self._closed = False
 
+    def _prune_locked(self, tenant):
+        """Drop a tenant's empty queue (and its virtual-finish clock
+        once the global clock has passed it — at that point
+        ``max(vtime, vf)`` is ``vtime`` anyway, so the prune cannot
+        change any future grant order).  Keyed per attacker-controlled
+        header, un-pruned entries would grow without bound."""
+        q = self._queues.get(tenant)
+        if q is not None and not q:
+            del self._queues[tenant]
+        if tenant not in self._queues and \
+                self._vfinish.get(tenant, 0.0) <= self._vtime:
+            self._vfinish.pop(tenant, None)
+
     def _grant_locked(self):
         while self._free > 0:
-            best = None
-            for q in self._queues.values():
-                if q and (best is None or q[0]["vf"] < best[0]["vf"]):
-                    best = q
-            if best is None:
+            best_t, best_q = None, None
+            for t, q in self._queues.items():
+                if q and (best_q is None or q[0]["vf"] < best_q[0]["vf"]):
+                    best_t, best_q = t, q
+            if best_q is None:
                 return
-            tok = best.popleft()
+            tok = best_q.popleft()
             tok["granted"] = True
             self._free -= 1
             self._vtime = max(self._vtime, tok["vf"])
+            self._prune_locked(best_t)
             self._cond.notify_all()
 
     def acquire(self, tenant, deadline=None):
@@ -244,11 +270,13 @@ class FairQueue:
                 if self._closed:
                     if tok in q:
                         q.remove(tok)
+                    self._prune_locked(tenant)
                     raise Overloaded("shutdown", "gateway draining")
                 if deadline is not None and \
                         time.monotonic() >= deadline:
                     if tok in q:
                         q.remove(tok)
+                    self._prune_locked(tenant)
                     raise DeadlineExceeded(
                         "queue", "expired waiting for a dispatch permit")
                 self._cond.wait(0.02)
@@ -323,7 +351,7 @@ class _RequestCtx:
 
     __slots__ = ("t0", "tenant", "model", "version", "op", "trace_id",
                  "status", "outcome", "fields", "stages", "tokens",
-                 "emitted")
+                 "emitted", "permit")
 
     def __init__(self, tenant, trace_id):
         self.t0 = time.monotonic()
@@ -338,6 +366,7 @@ class _RequestCtx:
         self.stages = {}
         self.tokens = 0
         self.emitted = False
+        self.permit = False            # WFQ permit held (do_POST releases)
 
 
 class Gateway:
@@ -368,7 +397,8 @@ class Gateway:
     def __init__(self, port=None, host="127.0.0.1", store=None,
                  quota_qps=None, quota_burst=None, queue_depth=None,
                  concurrency=None, tenant_weights=None,
-                 read_timeout_s=None, max_body=None, drain_s=None):
+                 read_timeout_s=None, max_body=None, drain_s=None,
+                 max_tenants=None):
         if port is None:
             port = _config.get("MXNET_GATEWAY_PORT")
         if quota_qps is None:
@@ -385,6 +415,8 @@ class Gateway:
             max_body = _config.get("MXNET_GATEWAY_MAX_BODY")
         if drain_s is None:
             drain_s = _config.get("MXNET_GATEWAY_DRAIN_S")
+        if max_tenants is None:
+            max_tenants = _config.get("MXNET_GATEWAY_MAX_TENANTS")
         self._store = store
         self._quota_qps = float(quota_qps)
         self._quota_burst = float(quota_burst)
@@ -395,6 +427,9 @@ class Gateway:
         self._routes_lock = threading.Lock()
         self._buckets = {}
         self._buckets_lock = threading.Lock()
+        self._max_tenants = max(1, int(max_tenants))
+        self._tenants = set(tenant_weights or ())
+        self._tenants_lock = threading.Lock()
         self._wfq = FairQueue(concurrency, queue_depth,
                               weights=tenant_weights)
         self._open_streams = 0
@@ -402,6 +437,7 @@ class Gateway:
         self._draining = False
         self._closed = False
         self._tenant_shed = collections.Counter()
+        self._shed_lock = threading.Lock()
         self._prev_sigterm = None
 
         from http.server import ThreadingHTTPServer
@@ -558,12 +594,17 @@ class Gateway:
     def stats(self):
         with self._open_cond:
             open_streams = self._open_streams
+        with self._shed_lock:
+            shed = dict(self._tenant_shed)
+        with self._tenants_lock:
+            known = len(self._tenants)
         return {"port": self.port, "draining": self._draining,
                 "closed": self._closed, "open_streams": open_streams,
                 "routes": self.routes(),
                 "tenants": {
+                    "known": known,
                     "queued": self._wfq.depths(),
-                    "shed": dict(self._tenant_shed),
+                    "shed": shed,
                 }}
 
     def install_signal_handler(self, sig=None):
@@ -632,6 +673,22 @@ class Gateway:
 
     # -- request plumbing (called from the handler) ----------------------
 
+    def _tenant_key(self, tenant):
+        """Canonical key for per-tenant state: the raw ``X-Tenant``
+        value for the first ``MXNET_GATEWAY_MAX_TENANTS`` distinct
+        tenants (weighted tenants are pre-seeded), the shared
+        :data:`OVERFLOW_TENANT` after — so minting unique headers
+        cannot grow queues/buckets/shed counters/metric labels without
+        bound.  Overflow tenants share one bucket and one WFQ lane."""
+        tenant = str(tenant)
+        with self._tenants_lock:
+            if tenant in self._tenants:
+                return tenant
+            if len(self._tenants) < self._max_tenants:
+                self._tenants.add(tenant)
+                return tenant
+        return OVERFLOW_TENANT
+
     def _bucket(self, tenant):
         if self._quota_qps <= 0:
             return None
@@ -653,7 +710,8 @@ class Gateway:
         _telemetry.GATEWAY_RESPONSES.inc(code=str(ctx.status))
         _telemetry.GATEWAY_REQUEST_SECONDS.observe(dur)
         if ctx.status in (429, 503):
-            self._tenant_shed[ctx.tenant] += 1
+            with self._shed_lock:
+                self._tenant_shed[ctx.tenant] += 1
         if _events.enabled():
             _events.emit("gateway_request", outcome=ctx.outcome,
                          dur_s=dur, stages_s=ctx.stages or None,
@@ -737,14 +795,14 @@ def _make_handler(gw):
         # -- inference -------------------------------------------------
 
         def do_POST(self):  # noqa: N802
-            tenant = self.headers.get("X-Tenant") or "default"
+            tenant = gw._tenant_key(
+                self.headers.get("X-Tenant") or "default")
             ctx = _RequestCtx(tenant,
                               self.headers.get("X-Trace-Id") or None)
             _telemetry.GATEWAY_REQUESTS.inc(tenant=tenant)
             self.close_connection = True
-            permit = False
             try:
-                permit = self._serve_inference(ctx)
+                self._serve_inference(ctx)
             except (BrokenPipeError, ConnectionError, socket.timeout,
                     OSError):
                 # client vanished while we answered: record what we
@@ -758,7 +816,10 @@ def _make_handler(gw):
                 self._reply_error(ctx, 500, "error",
                                   message=str(e))
             finally:
-                if permit:
+                # ctx.permit (not a local) so an exception escaping
+                # _serve_inference after the WFQ acquire can never
+                # leak a dispatch permit and deadlock all tenants
+                if ctx.permit:
                     gw._wfq.release()
                 gw._finish_request(ctx)
 
@@ -865,15 +926,17 @@ def _make_handler(gw):
             return body
 
         def _serve_inference(self, ctx):
-            """The whole request path; returns whether a WFQ permit is
-            held (the caller releases it)."""
+            """The whole request path.  A WFQ acquire sets
+            ``ctx.permit``; do_POST's ``finally`` releases it on EVERY
+            exit — including exceptions escaping this method — so no
+            path can leak a dispatch permit."""
             parts = self.path.split("?")[0].strip("/").split("/")
             if len(parts) != 3 or parts[0] != "v1" or \
                     parts[1] not in ("generate", "predict"):
                 self._reply_error(ctx, 404, "error",
                                   message="unknown path %r" % self.path,
                                   error_kind="no_route")
-                return False
+                return
             ctx.op, ctx.model = parts[1], parts[2]
 
             # deadline from the wire, threaded through every clock below
@@ -891,14 +954,14 @@ def _make_handler(gw):
                                       message="bad X-Deadline-Ms %r"
                                       % hdr,
                                       error_kind="bad_deadline")
-                    return False
+                    return
                 if dl_ms:
                     deadline = ctx.t0 + dl_ms / 1e3
 
             if not gw.is_ready():
                 self._reply_typed(ctx, Overloaded("shutdown",
                                                   "gateway draining"))
-                return False
+                return
             with gw._routes_lock:
                 route = gw._routes.get(ctx.model)
             if route is None:
@@ -906,11 +969,11 @@ def _make_handler(gw):
                                   message="no route for model %r"
                                   % ctx.model,
                                   error_kind="no_route")
-                return False
+                return
 
             body = self._read_body(ctx)
             if body is None:
-                return False
+                return
 
             # per-tenant token-bucket quota, before any queue or
             # backend touch — a hot tenant burns its own budget only
@@ -926,7 +989,7 @@ def _make_handler(gw):
                     self._reply_error(ctx, 429, outcome,
                                       message=str(err),
                                       retry_after=retry, **fields)
-                    return False
+                    return
 
             # weighted-fair queueing for a dispatch permit
             t_q = time.monotonic()
@@ -934,7 +997,8 @@ def _make_handler(gw):
                 gw._wfq.acquire(ctx.tenant, deadline=deadline)
             except ServingError as e:
                 self._reply_typed(ctx, e)
-                return False
+                return
+            ctx.permit = True
             ctx.stages["queue"] = time.monotonic() - t_q
             _telemetry.GATEWAY_QUEUE_WAIT_SECONDS.observe(
                 ctx.stages["queue"])
@@ -945,7 +1009,8 @@ def _make_handler(gw):
                 ctx.fields["canary"] = True
             with gw._open_cond:
                 gw._open_streams += 1
-            _telemetry.GATEWAY_OPEN_STREAMS.set(gw._open_streams)
+                n_open = gw._open_streams
+            _telemetry.GATEWAY_OPEN_STREAMS.set(n_open)
             try:
                 remaining_ms = None
                 if deadline is not None:
@@ -960,9 +1025,9 @@ def _make_handler(gw):
             finally:
                 with gw._open_cond:
                     gw._open_streams -= 1
+                    n_open = gw._open_streams
                     gw._open_cond.notify_all()
-                _telemetry.GATEWAY_OPEN_STREAMS.set(gw._open_streams)
-            return True
+                _telemetry.GATEWAY_OPEN_STREAMS.set(n_open)
 
         # -- predict: JSON in, JSON out --------------------------------
 
@@ -1008,13 +1073,20 @@ def _make_handler(gw):
                 else result
             payload = _json_bytes({"outputs": out, "version": version})
             ctx.status, ctx.outcome = 200, "ok"
-            self.send_response(200)
-            self.send_header("Content-Type",
-                             "application/json; charset=utf-8")
-            self.send_header("Content-Length", str(len(payload)))
-            self.send_header("Connection", "close")
-            self.end_headers()
-            self.wfile.write(payload)
+            try:
+                self.send_response(200)
+                self.send_header("Content-Type",
+                                 "application/json; charset=utf-8")
+                self.send_header("Content-Length", str(len(payload)))
+                self.send_header("Connection", "close")
+                self.end_headers()
+                self.wfile.write(payload)
+            except OSError:
+                # client vanished while we answered — account typed,
+                # never let the raise skip do_POST's permit release
+                _telemetry.GATEWAY_CLIENT_DISCONNECTS.inc()
+                ctx.status, ctx.outcome = 499, "evicted"
+                ctx.fields["reason"] = "disconnect"
 
         # -- generate: SSE token stream --------------------------------
 
@@ -1022,6 +1094,23 @@ def _make_handler(gw):
             self.wfile.write(b"data: " + json.dumps(
                 obj, sort_keys=True).encode("utf-8") + b"\n\n")
             self.wfile.flush()
+
+        def _fail_generate(self, ctx, exc, streaming):
+            """Answer a typed generate failure with the contract code:
+            a real status line while headers are unsent, else a final
+            SSE ``error`` frame — writing a second status line into an
+            open event stream would corrupt the wire."""
+            if not streaming:
+                self._reply_typed(ctx, exc)
+                return
+            outcome, fields = _outcome_of(exc)
+            ctx.status, ctx.outcome = wire_code(exc), outcome
+            ctx.fields.update(fields)
+            try:
+                self._sse({"error": {"code": ctx.status,
+                                     "message": str(exc), **fields}})
+            except OSError:
+                pass               # client already gone; event has it
 
         def _serve_generate(self, ctx, backend, version, body, deadline,
                             remaining_ms):
@@ -1037,7 +1126,19 @@ def _make_handler(gw):
             toks = _queue.Queue()
             kwargs = {}
             if body.get("max_new_tokens"):
-                kwargs["max_new_tokens"] = int(body["max_new_tokens"])
+                try:
+                    kwargs["max_new_tokens"] = int(
+                        body["max_new_tokens"])
+                except (TypeError, ValueError):
+                    # validated while no resource is held and before
+                    # the backend: a junk value is the client's 400,
+                    # not an uncaught 500
+                    self._reply_error(
+                        ctx, 400, "error",
+                        message="bad max_new_tokens %r"
+                        % (body["max_new_tokens"],),
+                        error_kind="malformed")
+                    return
             t_d = time.monotonic()
             try:
                 fut = backend.submit(tokens, deadline_ms=remaining_ms,
@@ -1070,10 +1171,11 @@ def _make_handler(gw):
                             # stalled handler guard: the backend is a
                             # grace past the deadline with no typed
                             # resolution — retract and answer 504
+                            # (as an SSE error frame once streaming)
                             fut.cancel()
-                            self._reply_typed(ctx, DeadlineExceeded(
+                            self._fail_generate(ctx, DeadlineExceeded(
                                 "decode", "backend stalled past the "
-                                "deadline"))
+                                "deadline"), streaming)
                             return
                         continue
                     if not streaming:
@@ -1101,25 +1203,13 @@ def _make_handler(gw):
             try:
                 result = fut.result(0.0)
             except ServingError as e:
-                if not streaming:
-                    self._reply_typed(ctx, e)
-                else:
-                    # status line already on the wire: the contract
-                    # code rides in a final SSE error frame
-                    outcome, fields = _outcome_of(e)
-                    ctx.status, ctx.outcome = wire_code(e), outcome
-                    ctx.fields.update(fields)
-                    try:
-                        self._sse({"error": {"code": ctx.status,
-                                             "message": str(e),
-                                             **fields}})
-                    except OSError:
-                        pass
+                self._fail_generate(ctx, e, streaming)
                 return
             except TimeoutError:
                 fut.cancel()
-                self._reply_typed(ctx, DeadlineExceeded(
-                    "decode", "backend unresolved after final token"))
+                self._fail_generate(ctx, DeadlineExceeded(
+                    "decode", "backend unresolved after final token"),
+                    streaming)
                 return
             done = {"done": True, "version": version,
                     "finish_reason": result.get("finish_reason")

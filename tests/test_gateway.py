@@ -733,3 +733,111 @@ def test_events_query_by_tenant(registry, tmp_path, capsys):
     assert rc == 0
     out = capsys.readouterr().out
     assert "acme" in out and "globex" in out
+
+# ---------------------------------------------------------------------------
+# review regressions: permit hygiene, SSE wire integrity, tenant bounds
+# ---------------------------------------------------------------------------
+
+class _BuggyBackend:
+    """Backend whose submit raises an UNTYPED error — the handler-bug
+    path (500) that historically leaked the WFQ dispatch permit."""
+
+    def submit(self, batch, deadline_ms=None, **kwargs):
+        raise RuntimeError("backend bug")
+
+
+def test_wfq_permit_survives_handler_exceptions(registry):
+    """A permit acquired before an exception escaping the handler must
+    be released on every exit — with concurrency 2, more-than-2 buggy
+    requests would otherwise deadlock dispatch for all tenants."""
+    with Gateway(port=0, concurrency=2, queue_depth=4) as gw:
+        gw.add_route("bad", _BuggyBackend(), kind="predict")
+        hdrs = {"X-Deadline-Ms": "2000"}   # bound a regression's hang
+        for _ in range(5):                 # > 2x the permit pool
+            status, _, _ = _post(gw.port, "/v1/predict/bad",
+                                 {"rows": [[1.0]]}, headers=hdrs)
+            assert status == 500
+        gw.add_route("ok", FakePredict(scale=2.0), kind="predict")
+        status, _, body = _post(gw.port, "/v1/predict/ok",
+                                {"rows": [[2.0]]}, headers=hdrs)
+        assert status == 200               # permits all came back
+        assert json.loads(body)["outputs"] == [[4.0]]
+        # the last release lands in the handler's finally, just after
+        # the response hits the wire — poll briefly
+        deadline = time.monotonic() + 2.0
+        while gw._wfq._free != gw._wfq.permits and \
+                time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert gw._wfq._free == gw._wfq.permits
+
+
+def test_bad_max_new_tokens_is_400_and_leaks_nothing(registry):
+    """A junk max_new_tokens is the client's 400 (not an uncaught 500)
+    and the dispatch permit it held is returned."""
+    with Gateway(port=0, concurrency=1) as gw:
+        gw.add_route("m", FakeTokenServer())
+        status, _, body = _post(gw.port, "/v1/generate/m",
+                                {"tokens": [1],
+                                 "max_new_tokens": "abc"})
+        assert status == 400
+        assert json.loads(body)["error"]["code"] == 400
+        status, _, raw = _post(gw.port, "/v1/generate/m",
+                               {"tokens": [1], "max_new_tokens": 3})
+        assert status == 200               # the single permit came back
+        assert _sse_frames(raw)[-1]["done"] is True
+    evs = _gw_events()
+    assert [e["http_status"] for e in evs] == [400, 200]
+
+
+def test_stalled_backend_midstream_504_is_an_sse_frame(registry):
+    """The stalled-backend 504 after tokens have streamed must ride a
+    final SSE error frame — a second status line written into the open
+    event stream would corrupt the wire."""
+    hold = threading.Event()               # never set: backend stalls
+    with Gateway(port=0) as gw:
+        gw.add_route("m", FakeTokenServer(tokens=(7,), hold=hold))
+        status, _, raw = _post(gw.port, "/v1/generate/m",
+                               {"tokens": [1]},
+                               headers={"X-Deadline-Ms": "200"})
+    hold.set()
+    assert status == 200                   # headers went out with tok 7
+    assert raw.count(b"HTTP/1.1") == 0     # no status line mid-stream
+    frames = _sse_frames(raw)
+    assert frames[0] == {"token": 7}
+    assert frames[-1]["error"]["code"] == 504
+    (ev,) = _gw_events()
+    assert ev["http_status"] == 504 and ev["outcome"] == "deadline"
+
+
+def test_tenant_state_is_bounded_by_max_tenants(registry):
+    """Unique attacker-minted X-Tenant values past the cap collapse
+    onto the shared overflow key and idle fair-queue entries are
+    pruned — per-tenant state cannot grow without bound."""
+    with Gateway(port=0, quota_qps=1000, quota_burst=1000,
+                 max_tenants=4) as gw:
+        gw.add_route("m", FakePredict(), kind="predict")
+        for i in range(12):
+            status, _, _ = _post(gw.port, "/v1/predict/m",
+                                 {"rows": [[1.0]]},
+                                 headers={"X-Tenant": "mint-%d" % i})
+            assert status == 200
+        stats = gw.stats()
+        assert stats["tenants"]["known"] == 4
+        assert len(gw._buckets) <= 5       # 4 tracked + "~overflow"
+        assert gwmod.OVERFLOW_TENANT in gw._buckets
+        assert gw._wfq._queues == {}       # idle queues pruned
+        assert gw._wfq._vfinish == {}      # idle clocks pruned
+    # overflow tenants share ONE metric label, not one per header
+    evs = _gw_events()
+    tenants = {e["tenant"] for e in evs}
+    assert len(tenants) == 5
+    assert gwmod.OVERFLOW_TENANT in tenants
+
+
+def test_fair_queue_prunes_idle_tenants():
+    fq = FairQueue(permits=2, depth=4)
+    fq.acquire("a")
+    fq.acquire("b")
+    fq.release()
+    fq.release()
+    assert fq._queues == {} and fq._vfinish == {}
