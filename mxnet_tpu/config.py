@@ -309,6 +309,29 @@ FLAGS = {
         "between the signalled host's committed step and the pod-wide "
         "final-checkpoint step (bounds host dispatch drift; raise for "
         "deep async pipelines)"),
+    "MXNET_FLEET_SPOOL": (
+        "", str, "honored",
+        "fleet-observatory spool directory (fleet.py): each rank "
+        "publishes atomic metric/breakdown/trace snapshots here and "
+        "the collector (tools/fleetz.py, /fleetz) merges them into a "
+        "pod view with straggler attribution; '' = observatory off"),
+    "MXNET_FLEET_INTERVAL": (
+        "5", _pfloat, "honored",
+        "seconds between background fleet snapshot publishes "
+        "(FleetPublisher.start); each publish is one registry collect "
+        "+ two atomic file writes into the spool"),
+    "MXNET_FLEET_STALE": (
+        "30", _pfloat, "honored",
+        "fleet collector staleness cut in seconds: a rank whose last "
+        "snapshot is older (clock-offset corrected) is marked stale "
+        "and excluded from straggler scoring — a dead rank degrades "
+        "to a stale row, it never blocks the merge"),
+    "MXNET_FLEET_CLOCK_OFFSET": (
+        "0", _pfloat, "honored",
+        "wall-clock offset in seconds added to every timestamp this "
+        "rank's FleetPublisher records — deterministic skew injection "
+        "for clock-offset-estimation drills (tests); keep 0 in "
+        "production"),
     "MXNET_GLUON_REPO": (
         "", str, "honored",
         "base URL for gluon model_zoo weight downloads (file:// works "

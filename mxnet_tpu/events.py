@@ -17,7 +17,11 @@ single JSONL record carrying
 * the per-stage latency split (``stages_s``: queue / prefill / decode /
   dispatch ...) and kind-specific payload fields (rows, tokens, step),
 * the ``perf_ledger`` provenance fields (git sha, jax version, backend,
-  device kind/count, mesh, dtype policy ...), resolved once per process.
+  device kind/count, mesh, dtype policy ...), resolved once per process,
+* the rank provenance (``proc_id``/``n_procs`` from the
+  ``MXNET_DIST_PROC_ID``/``MXNET_DIST_NUM_PROCS`` identity, ``0/1``
+  single-process) so merged
+  per-rank streams slice by rank (``events_query.py --by rank``).
 
 **Sampling** is head+tail: non-``ok`` outcomes (sheds, deadline
 exceeded, evictions, errors) are ALWAYS kept — degradation evidence
@@ -89,6 +93,7 @@ _writer_wake = threading.Event()
 _stats = {"emitted": 0, "sampled_out": 0, "dropped": 0, "written": 0}
 _tails = {}              # kind -> _Tail
 _prov_cache = None
+_proc_cache = None
 
 
 def enabled():
@@ -124,12 +129,14 @@ def writer_path():
 def reset():
     """Clear the ring, queue, tail state, and counters — test hook.
     The configured path/sample and the writer thread survive."""
+    global _proc_cache
     with _lock:
         _ring.clear()
         _queue.clear()
         _tails.clear()
         for k in _stats:
             _stats[k] = 0
+        _proc_cache = None
 
 
 class _Tail:
@@ -173,6 +180,25 @@ def _provenance():
     return _prov_cache
 
 
+def _proc_identity():
+    """(proc_id, n_procs) from the distributed env, resolved once per
+    process (``0/1`` single-process) — the rank provenance every wide
+    event carries so ``events_query.py --by rank`` can split a pod's
+    merged JSONL streams.  ``reset()`` clears the cache (test hook)."""
+    global _proc_cache
+    if _proc_cache is None:
+        try:
+            pid = int(os.environ.get("MXNET_DIST_PROC_ID", "-1"))
+        except ValueError:
+            pid = -1
+        try:
+            n = int(os.environ.get("MXNET_DIST_NUM_PROCS", "0"))
+        except ValueError:
+            n = 0
+        _proc_cache = ((pid if pid >= 0 else 0), (n if n > 1 else 1))
+    return _proc_cache
+
+
 def emit(kind, outcome="ok", dur_s=None, stages_s=None, trace_id=None,
          span_id=None, **fields):
     """Record one wide event (the sampling decision happens here).
@@ -212,8 +238,10 @@ def emit(kind, outcome="ok", dur_s=None, stages_s=None, trace_id=None,
         sp = _tracing.current_span()
         span_id = sp.span_id if sp is not None \
             else _tracing.new_request_id()
+    proc_id, n_procs = _proc_identity()
     ev = {"kind": str(kind), "time": round(time.time(), 6),
-          "trace_id": trace_id, "span_id": span_id, "outcome": outcome}
+          "trace_id": trace_id, "span_id": span_id, "outcome": outcome,
+          "proc_id": proc_id, "n_procs": n_procs}
     if dur is not None:
         ev["dur_s"] = round(dur, 6)
     if stages_s:

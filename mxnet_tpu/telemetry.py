@@ -42,6 +42,7 @@ __all__ = ["Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
            "set_exemplar_source", "register_status_provider",
            "unregister_status_provider", "statusz", "varz",
            "register_readiness", "unregister_readiness", "readiness",
+           "merge_collected",
            "DEFAULT_TIME_BUCKETS", "BATCH_SIZE_BUCKETS"]
 
 _enabled = False
@@ -537,6 +538,18 @@ def reset():
     REGISTRY.reset()
 
 
+def merge_collected(snapshots):
+    """Merge N :func:`collect`-shaped snapshots into one: counters sum
+    exactly, histograms add bucket-additively (``sum``/``count``
+    included), gauges take the max.  The implementation lives in
+    :mod:`mxnet_tpu.fleet` because the fleet collector must stay
+    stdlib-only at import — this is the package-facing alias the
+    in-process callers use."""
+    from . import fleet as _fleet
+
+    return _fleet.merge_metrics(snapshots)
+
+
 # ---------------------------------------------------------------------------
 # span events
 # ---------------------------------------------------------------------------
@@ -976,6 +989,26 @@ GATEWAY_STREAM_TOKENS = counter(
     "mxnet_tpu_gateway_stream_tokens_total",
     "Tokens written to clients as SSE frames across all streams.")
 
+# Fleet observatory (fleet.py; see docs/observability.md)
+FLEET_SNAPSHOTS = counter(
+    "mxnet_tpu_fleet_snapshots_total",
+    "Fleet snapshots this rank committed to the spool dir (payload "
+    "plus digest sidecar, the durability mark).")
+FLEET_PUBLISH_SECONDS = histogram(
+    "mxnet_tpu_fleet_publish_seconds",
+    "Wall seconds per fleet snapshot publish (collect + breakdown + "
+    "atomic write + sidecar); the observatory's own overhead.")
+FLEET_PUBLISH_ERRORS = counter(
+    "mxnet_tpu_fleet_publish_errors_total",
+    "Fleet snapshot publishes that failed (spool unwritable, "
+    "serialization error); counted and logged, never raised into the "
+    "step loop.")
+FLEET_TORN_SNAPSHOTS = counter(
+    "mxnet_tpu_fleet_torn_snapshots_total",
+    "Torn or partial spool snapshots the collector skipped (missing "
+    "sidecar, digest mismatch, unparsable payload) — the read_ledger "
+    "torn-line discipline applied to the fleet spool.")
+
 
 # ---------------------------------------------------------------------------
 # jax.monitoring bridge: compile + compilation-cache events
@@ -1236,11 +1269,14 @@ def statusz():
             "stream_tokens": GATEWAY_STREAM_TOKENS.value(),
         },
         "events": {"enabled": False},
+        "fleet": {"active": False},
     }
     try:
-        # events registers its provider on import; importing here makes
-        # the subsystem live even when nothing else pulled events in
+        # events and fleet register their providers on import;
+        # importing here makes the subsystems live even when nothing
+        # else pulled them in
         from . import events as _events  # noqa: F401
+        from . import fleet as _fleet  # noqa: F401
     except Exception:
         pass
     for name, fn in sorted(_status_providers.items()):
@@ -1354,6 +1390,23 @@ class _ScrapeServer:
                     ctype = "application/json; charset=utf-8"
                 elif path == "/varz":
                     body = _json_body(varz())
+                    ctype = "application/json; charset=utf-8"
+                elif path == "/fleetz":
+                    from urllib.parse import parse_qs
+
+                    from . import fleet as _fleet
+
+                    q = parse_qs(query)
+                    spool = (q.get("spool") or [None])[0]
+                    stale = None
+                    try:
+                        stale = float(q["stale_after"][0])
+                    except (KeyError, IndexError, ValueError):
+                        pass
+                    merge = (q.get("merge") or ["1"])[0] not in ("0",
+                                                                 "false")
+                    body = _json_body(_fleet.fleetz(
+                        spool=spool, stale_after=stale, merge=merge))
                     ctype = "application/json; charset=utf-8"
                 else:
                     self.send_error(404, "unknown path %r" % path)

@@ -1,0 +1,581 @@
+"""Fleet observatory: cross-rank aggregation, straggler attribution,
+stitched pod traces (mxnet_tpu/fleet.py; see docs/observability.md
+"Fleet observatory").
+
+Tier-1 matrix:
+* merge semantics — counters sum EXACTLY, histograms add
+  bucket-additively so merged percentiles match pooled-sample
+  percentiles within bucket resolution;
+* torn-snapshot discipline — a truncated payload or missing sidecar is
+  a counted warning, never a crash;
+* the deterministic straggler drill — a real ``WorkerFleet`` of OS
+  processes with one ``LatencySpike``-slowed rank and one
+  clock-offset-injected rank: the collector (library, CLI, and the
+  ``/fleetz`` endpoint) names the slow rank AND its largest-moving
+  attribution bucket, recovers the injected clock offset, and the
+  stitched pod trace passes the chrome-trace invariants;
+* a dead rank degrades to a stale-marked row instead of blocking the
+  merge;
+* the satellite surfaces — events rank provenance + ``--by rank``,
+  ``telemetry_dump --merge``, ``trace_view`` cross-file parent
+  resolution, heartbeat skew fields, the ``/statusz`` fleet subsystem.
+"""
+import json
+import os
+import random
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from mxnet_tpu import events, telemetry as tel, tracing
+from mxnet_tpu import fleet
+from mxnet_tpu.testing import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("MXNET_TEST_PLATFORM") == "tpu",
+    reason="fleet drills spawn CPU-only subprocess pods")
+
+
+@pytest.fixture
+def registry():
+    tel.enable()
+    tel.reset()
+    yield tel
+    tel.reset()
+    tel.disable()
+
+
+@pytest.fixture
+def spool(tmp_path, monkeypatch):
+    d = tmp_path / "spool"
+    d.mkdir()
+    monkeypatch.setenv("MXNET_FLEET_SPOOL", str(d))
+    fleet.set_spool(None)  # env knob governs; publishers may re-pin
+    yield str(d)
+    fleet.set_spool(None)
+
+
+def _publish_rank(spool_dir, rank, n_procs, steps, gap_s, clock_offset=0.0,
+                  barrier=None):
+    """One in-process rank: reset the registry, run a synthetic step
+    loop with ``gap_s`` of data wait per step, publish a snapshot."""
+    tel.reset()
+    pub = fleet.FleetPublisher(spool_dir, rank=rank, n_procs=n_procs,
+                               clock_offset=clock_offset,
+                               publish_trace=False)
+    if barrier is not None:
+        pub.barrier_wall = barrier + clock_offset
+    for _ in range(steps):
+        tel.HOST_GAP_SECONDS.observe(gap_s, loop="sharded")
+        tel.PREFETCH_WAIT_SECONDS.observe(gap_s)
+        tel.TRAIN_STEP_SECONDS.observe(0.002, loop="sharded")
+        tel.TRAIN_STEPS.inc(loop="sharded")
+    assert pub.publish_once() is not None
+    return pub
+
+
+# ---------------------------------------------------------------------------
+# merge semantics
+# ---------------------------------------------------------------------------
+
+class TestMergeMetrics:
+    def test_counters_sum_exactly_and_gauges_take_max(self, registry):
+        snaps = []
+        for inc, g in ((3, 7.0), (5, 2.0), (11, 9.5)):
+            r = tel.Registry()
+            r.counter("mxnet_tpu_x_total", "h", ("loop",)).inc(
+                inc, loop="a")
+            r.counter("mxnet_tpu_x_total", "h", ("loop",)).inc(
+                2 * inc, loop="b")
+            r.gauge("mxnet_tpu_g", "h").set(g)
+            snaps.append(r.collect())
+        out = fleet.merge_metrics(snaps)
+        by_loop = {s["labels"]["loop"]: s["value"]
+                   for s in out["mxnet_tpu_x_total"]["series"]}
+        assert by_loop == {"a": 3 + 5 + 11, "b": 2 * (3 + 5 + 11)}
+        assert out["mxnet_tpu_g"]["series"][0]["value"] == 9.5
+
+    def test_histograms_add_bucket_additively(self, registry):
+        rng = random.Random(7)
+        snaps, pooled = [], []
+        for _ in range(3):
+            r = tel.Registry()
+            h = r.histogram("mxnet_tpu_h_seconds", "h")
+            samples = [rng.uniform(0.0006, 2.0) for _ in range(200)]
+            for v in samples:
+                h.observe(v)
+            pooled.extend(samples)
+            snaps.append(r.collect())
+        out = fleet.merge_metrics(snaps)
+        s = out["mxnet_tpu_h_seconds"]["series"][0]
+        assert s["count"] == len(pooled)
+        assert abs(float(s["sum"]) - sum(pooled)) < 1e-6
+        # cumulative buckets equal the pooled histogram exactly
+        bounds = [b for b in tel.DEFAULT_TIME_BUCKETS]
+        expect = {ub: sum(1 for v in pooled if v <= ub) for ub in bounds}
+        got = {fleet._numf(ub): c for ub, c in s["buckets"]
+               if fleet._numf(ub) != float("inf")}
+        assert got == expect
+        # merged percentile lands in the same bucket interval as the
+        # pooled-sample percentile (bucket resolution is the contract)
+        for q in (0.5, 0.9, 0.99):
+            est = fleet.hist_quantile(s["buckets"], q)
+            exact = sorted(pooled)[int(q * len(pooled))]
+            lo = max([0.0] + [ub for ub in bounds if ub < exact])
+            hi = min(ub for ub in bounds if ub >= exact)
+            assert lo - 1e-9 <= est <= hi + 1e-9, (q, est, exact, lo, hi)
+
+    def test_mixed_bucket_bounds_merge_on_union(self, registry):
+        r1, r2 = tel.Registry(), tel.Registry()
+        r1.histogram("mxnet_tpu_h_seconds", "h",
+                     buckets=(0.1, 1.0)).observe(0.05)
+        r2.histogram("mxnet_tpu_h_seconds", "h",
+                     buckets=(0.5, 2.0)).observe(1.5)
+        s = fleet.merge_metrics(
+            [r1.collect(), r2.collect()])["mxnet_tpu_h_seconds"][
+            "series"][0]
+        assert s["count"] == 2
+        cum = {fleet._numf(ub): c for ub, c in s["buckets"]}
+        assert cum[0.1] == 1 and cum[2.0] == 2
+        assert cum[float("inf")] == 2
+
+    def test_telemetry_alias(self, registry):
+        r = tel.Registry()
+        r.counter("mxnet_tpu_x_total", "h").inc(4)
+        out = tel.merge_collected([r.collect(), r.collect()])
+        assert out["mxnet_tpu_x_total"]["series"][0]["value"] == 8
+
+
+# ---------------------------------------------------------------------------
+# spool discipline
+# ---------------------------------------------------------------------------
+
+class TestSpoolDiscipline:
+    def test_torn_payload_is_counted_not_fatal(self, registry, spool):
+        _publish_rank(spool, 0, 2, steps=4, gap_s=0.001)
+        _publish_rank(spool, 1, 2, steps=4, gap_s=0.001)
+        # tear rank 1's payload after its sidecar was committed
+        p = os.path.join(spool, fleet.SNAPSHOT_NAME % 1)
+        with open(p, "r+b") as f:
+            f.truncate(os.path.getsize(p) // 2)
+        before = tel.FLEET_TORN_SNAPSHOTS.value()
+        view = fleet.read_spool(spool)
+        assert view["torn"] == 1
+        assert sorted(view["ranks"]) == [0]
+        assert any("sha256" in m or "torn" in m
+                   for _, m in view["problems"])
+        assert tel.FLEET_TORN_SNAPSHOTS.value() == before + 1
+        z = fleet.fleetz(spool=spool)
+        assert z["active"] and z["torn_snapshots"] == 1
+
+    def test_missing_sidecar_means_not_durable(self, registry, spool):
+        _publish_rank(spool, 0, 1, steps=2, gap_s=0.001)
+        os.unlink(os.path.join(spool, fleet.SIDECAR_NAME % 0))
+        view = fleet.read_spool(spool)
+        assert view["ranks"] == {} and view["torn"] == 1
+
+    def test_inactive_and_missing_spool(self, monkeypatch):
+        monkeypatch.delenv("MXNET_FLEET_SPOOL", raising=False)
+        fleet.set_spool(None)
+        assert fleet.fleetz()["active"] is False
+        assert fleet.fleetz(spool="/nonexistent/xyz")["active"] is False
+        assert fleet.status_summary() == {"active": False}
+        assert fleet.heartbeat_fields() is None
+
+    def test_publish_never_raises(self, registry, tmp_path):
+        pub = fleet.FleetPublisher(str(tmp_path / "s"), rank=0, n_procs=1)
+        # make the spool unwritable by replacing it with a file
+        os.rmdir(pub.spool)
+        with open(pub.spool, "w") as f:
+            f.write("not a dir")
+        before = tel.FLEET_PUBLISH_ERRORS.value()
+        assert pub.publish_once() is None
+        assert tel.FLEET_PUBLISH_ERRORS.value() == before + 1
+
+
+# ---------------------------------------------------------------------------
+# in-process straggler scoring + status surfaces
+# ---------------------------------------------------------------------------
+
+class TestStragglerScoring:
+    def _pod(self, spool, slow_rank=2, n=3):
+        barrier = time.time()
+        for r in range(n):
+            _publish_rank(spool, r, n, steps=6,
+                          gap_s=0.040 if r == slow_rank else 0.001,
+                          barrier=barrier)
+
+    def test_names_rank_and_bucket(self, registry, spool):
+        self._pod(spool)
+        rep = fleet.straggler_report(fleet.read_spool(spool))
+        assert rep["straggler"] == 2
+        assert rep["bucket"] == "data_wait"
+        assert rep["skew"] > 5.0
+        assert rep["bucket_delta_ms_per_step"] > 20.0
+
+    def test_statusz_fleet_subsystem(self, registry, spool):
+        self._pod(spool)
+        z = tel.statusz()["subsystems"]["fleet"]
+        assert z["active"] is True
+        assert z["ranks_seen"] == 3
+        assert z["straggler"] == 2
+        assert z["straggler_bucket"] == "data_wait"
+        assert sorted(z["snapshot_age_s"]) == ["0", "1", "2"]
+        assert z["stale"] == []
+
+    def test_heartbeat_line_gains_skew_fields(self, registry, spool):
+        from mxnet_tpu.monitor import TelemetryHeartbeat
+
+        line = TelemetryHeartbeat().line()
+        assert "skew" not in line and "straggler" not in line
+        self._pod(spool)
+        line = TelemetryHeartbeat().line()
+        assert "skew" in line, line
+        assert "straggler r2:data_wait" in line, line
+
+    def test_clock_offset_recovered(self, registry, spool):
+        barrier = time.time()
+        _publish_rank(spool, 0, 2, steps=4, gap_s=0.001, barrier=barrier)
+        _publish_rank(spool, 1, 2, steps=4, gap_s=0.001,
+                      clock_offset=5.0, barrier=barrier)
+        offs = fleet.read_spool(spool)["clock_offsets"]
+        assert abs(offs[1] - 5.0) < 0.5 and offs[0] == 0.0
+        # ages are offset-corrected: the skewed rank is NOT 5 s stale
+        view = fleet.read_spool(spool, stale_after=2.0)
+        assert not view["ranks"][1]["stale"]
+
+
+# ---------------------------------------------------------------------------
+# the deterministic tier-1 straggler drill (real OS-process fleet)
+# ---------------------------------------------------------------------------
+
+N_PROCS = 4
+SLOW_RANK = 2
+OFFSET_RANK = 1
+OFFSET_S = 5.0
+
+
+@pytest.fixture(scope="module")
+def drill(tmp_path_factory):
+    spool_dir = str(tmp_path_factory.mktemp("fleet_drill"))
+    wf = faults.WorkerFleet(
+        N_PROCS,
+        ["-m", "mxnet_tpu.testing.fleet_worker",
+         "--spool", spool_dir, "--steps", "12",
+         "--straggler-rank", str(SLOW_RANK),
+         "--straggle-delay", "0.04",
+         "--offset-rank", str(OFFSET_RANK),
+         "--offset", str(OFFSET_S)],
+        cwd=REPO)
+    results = wf.wait(timeout=240)
+    return spool_dir, results
+
+
+class TestStragglerDrill:
+    def test_workers_completed(self, drill):
+        _, results = drill
+        for rank, (rc, out) in enumerate(results):
+            assert rc == 0, "rank %d rc=%s\n%s" % (rank, rc, out)
+            assert "FLEET_DONE" in out, out
+
+    def test_collector_names_rank_and_bucket(self, drill):
+        spool_dir, _ = drill
+        z = fleet.fleetz(spool=spool_dir, stale_after=3600)
+        assert z["active"] and sorted(z["ranks"]) == ["0", "1", "2", "3"]
+        assert z["torn_snapshots"] == 0
+        rep = z["straggler"]
+        assert rep["straggler"] == SLOW_RANK
+        assert rep["bucket"] == "data_wait"
+        assert rep["skew"] > 2.0
+
+    def test_clock_offset_estimated_from_barrier(self, drill):
+        spool_dir, _ = drill
+        z = fleet.fleetz(spool=spool_dir, stale_after=3600, merge=False)
+        offs = z["clock_offsets_s"]
+        assert abs(offs[str(OFFSET_RANK)] - OFFSET_S) < 0.5, offs
+        for r in range(N_PROCS):
+            if r != OFFSET_RANK:
+                assert abs(offs[str(r)]) < 0.5, offs
+
+    def test_merged_counters_equal_sum_exactly(self, drill):
+        spool_dir, _ = drill
+        view = fleet.read_spool(spool_dir, stale_after=3600)
+        per_rank = [row["snapshot"]["metrics"]
+                    for _, row in sorted(view["ranks"].items())]
+        merged = fleet.merge_metrics(per_rank)
+
+        def counter_val(metrics, name, **labels):
+            total = 0
+            for s in metrics.get(name, {}).get("series", []):
+                if all(s["labels"].get(k) == v
+                       for k, v in labels.items()):
+                    total += fleet._numf(s.get("value", 0))
+            return total
+
+        for name in ("mxnet_tpu_train_steps_total",
+                     "mxnet_tpu_fleet_snapshots_total"):
+            exact = sum(counter_val(m, name) for m in per_rank)
+            assert counter_val(merged, name) == exact, name
+        assert counter_val(merged, "mxnet_tpu_train_steps_total",
+                           loop="sharded") == 12 * N_PROCS
+        # merged histogram count pools every rank's observations
+        s = merged["mxnet_tpu_train_step_seconds"]["series"][0]
+        assert s["count"] == 12 * N_PROCS
+
+    def test_stitched_trace_passes_invariants(self, drill):
+        spool_dir, _ = drill
+        payload, problems = fleet.stitch_traces(spool_dir,
+                                                stale_after=3600)
+        assert problems == [], problems
+        fl = payload["otherData"]["fleet"]
+        assert fl["ranks"] == list(range(N_PROCS))
+        assert fl["skipped"] == 0
+        # every rank contributes spans, pids are ranks, ids unique
+        spans = [ev for ev in payload["traceEvents"]
+                 if ev.get("ph") == "X" and ev.get("cat") == "span"]
+        assert {ev["pid"] for ev in spans} == set(range(N_PROCS))
+        sids = [ev["args"]["span_id"] for ev in spans]
+        assert len(sids) == len(set(sids))
+        assert all(sid.startswith("r") for sid in sids)
+        sys.path.insert(0, TOOLS)
+        try:
+            import trace_view
+        finally:
+            sys.path.remove(TOOLS)
+        assert trace_view.validate(payload) == []
+
+    def test_cli_reports_straggler(self, drill):
+        spool_dir, _ = drill
+        r = subprocess.run(
+            [sys.executable, os.path.join(TOOLS, "fleetz.py"),
+             spool_dir, "--stale-after", "3600"],
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "straggler: rank %d" % SLOW_RANK in r.stdout, r.stdout
+        assert "data_wait" in r.stdout
+
+    def test_cli_is_stdlib_only_at_import(self, drill):
+        # acceptance criterion: the collector never pulls jax — run the
+        # full CLI in a probe process and assert no jax module loaded
+        spool_dir, _ = drill
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import sys, runpy\n"
+             "sys.argv = ['fleetz.py', %r, '--stale-after', '3600']\n"
+             "try:\n"
+             "    runpy.run_path(%r, run_name='__main__')\n"
+             "except SystemExit as e:\n"
+             "    assert (e.code or 0) == 0, e.code\n"
+             "assert not any(m.split('.')[0] == 'jax' "
+             "for m in sys.modules), 'jax imported'\n"
+             "print('NOJAX_OK')\n"
+             % (spool_dir, os.path.join(TOOLS, "fleetz.py"))],
+            capture_output=True, text=True, timeout=120)
+        assert probe.returncode == 0, probe.stdout + probe.stderr
+        assert "NOJAX_OK" in probe.stdout
+
+    def test_fleetz_http_endpoint(self, drill):
+        spool_dir, _ = drill
+        tel.enable()
+        server = tel.serve_scrape(port=0, host="127.0.0.1")
+        try:
+            url = ("http://127.0.0.1:%d/fleetz?spool=%s&stale_after=3600"
+                   % (server.port, spool_dir))
+            with urllib.request.urlopen(url, timeout=30) as resp:
+                assert resp.status == 200
+                z = json.loads(resp.read().decode("utf-8"))
+            assert z["active"] is True
+            assert z["straggler"]["straggler"] == SLOW_RANK
+            assert z["straggler"]["bucket"] == "data_wait"
+            assert "merged_metrics" in z
+        finally:
+            tel.stop_scrape()
+            tel.disable()
+
+    def test_trace_view_fleet_mode(self, drill, tmp_path):
+        spool_dir, _ = drill
+        out = str(tmp_path / "pod.json")
+        r = subprocess.run(
+            [sys.executable, os.path.join(TOOLS, "trace_view.py"),
+             "--fleet", spool_dir, "--out", out],
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        with open(out) as f:
+            payload = json.load(f)
+        assert payload["otherData"]["fleet"]["ranks"] == \
+            list(range(N_PROCS))
+        assert "train_step" in r.stdout
+
+    def test_events_carry_rank_provenance(self, drill):
+        # provenance resolution itself (the drill already proved the
+        # env plumbing end-to-end); exercised in-process for the cache
+        _, _ = drill
+        os.environ["MXNET_DIST_PROC_ID"] = "3"
+        os.environ["MXNET_DIST_NUM_PROCS"] = "4"
+        try:
+            events.reset()
+            assert events._proc_identity() == (3, 4)
+        finally:
+            del os.environ["MXNET_DIST_PROC_ID"]
+            del os.environ["MXNET_DIST_NUM_PROCS"]
+            events.reset()
+        assert events._proc_identity() == (0, 1)
+
+
+# ---------------------------------------------------------------------------
+# dead rank -> stale row, merge unblocked
+# ---------------------------------------------------------------------------
+
+class TestDeadRank:
+    def test_dead_rank_degrades_to_stale_row(self, tmp_path):
+        # rank 2 publishes at step 2 then dies; the survivors keep
+        # stepping, linger, and publish a final fresh snapshot — so the
+        # dead rank's last snapshot is simply OLD when the collector
+        # looks, and must degrade to a stale row, not block the merge
+        spool_dir = str(tmp_path / "spool")
+        wf = faults.WorkerFleet(
+            3,
+            ["-m", "mxnet_tpu.testing.fleet_worker",
+             "--spool", spool_dir, "--steps", "6",
+             "--die-early-rank", "2", "--linger", "1.5"],
+            cwd=REPO)
+        results = wf.wait(timeout=240)
+        for rank, (rc, out) in enumerate(results):
+            assert rc == 0, "rank %d rc=%s\n%s" % (rank, rc, out)
+            assert ("FLEET_DIED_EARLY" if rank == 2 else "FLEET_DONE") \
+                in out, out
+
+        z = fleet.fleetz(spool=spool_dir, stale_after=0.75)
+        assert z["active"]
+        assert sorted(z["ranks"]) == ["0", "1", "2"]
+        assert z["ranks"]["2"]["stale"] is True
+        assert z["ranks"]["0"]["stale"] is False
+        assert z["ranks"]["1"]["stale"] is False
+        # merge still pools every rank's counters, dead one included
+        # (6 steps on each survivor, 3 before the early exit)
+        steps = [s for s in z["merged_metrics"][
+            "mxnet_tpu_train_steps_total"]["series"]
+            if s["labels"].get("loop") == "sharded"]
+        assert steps and steps[0]["value"] == 6 + 6 + 3
+        # scoring excludes the stale rank
+        assert "2" not in (z["straggler"].get("scores") or {})
+
+
+# ---------------------------------------------------------------------------
+# satellite tools
+# ---------------------------------------------------------------------------
+
+class TestSatelliteTools:
+    def test_telemetry_dump_merge(self, registry, tmp_path):
+        paths = []
+        for i in (1, 2):
+            tel.reset()
+            tel.TRAIN_STEPS.inc(5 * i, loop="sharded")
+            tel.TRAIN_STEP_SECONDS.observe(0.01 * i, loop="sharded")
+            p = str(tmp_path / ("r%d.json" % i))
+            tel.dump(p)
+            paths.append(p)
+        out = str(tmp_path / "pod.json")
+        r = subprocess.run(
+            [sys.executable, os.path.join(TOOLS, "telemetry_dump.py"),
+             "--merge", *paths, "--out", out],
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        with open(out) as f:
+            merged = json.load(f)
+        series = merged["metrics"]["mxnet_tpu_train_steps_total"][
+            "series"]
+        vals = {tuple(sorted(s["labels"].items())): s["value"]
+                for s in series}
+        assert vals[(("loop", "sharded"),)] == 15
+        hist = merged["metrics"]["mxnet_tpu_train_step_seconds"][
+            "series"]
+        sharded = [s for s in hist
+                   if s["labels"].get("loop") == "sharded"][0]
+        assert sharded["count"] == 2
+        # the merged dump round-trips through the tool itself
+        r2 = subprocess.run(
+            [sys.executable, os.path.join(TOOLS, "telemetry_dump.py"),
+             out], capture_output=True, text=True, timeout=120)
+        assert r2.returncode == 0, r2.stdout + r2.stderr
+
+    def test_events_query_by_rank(self, tmp_path):
+        paths = []
+        for rank in (0, 1):
+            p = tmp_path / ("events-r%d.jsonl" % rank)
+            lines = []
+            for i in range(4):
+                lines.append(json.dumps({
+                    "kind": "train_step", "outcome": "ok",
+                    "time": 100.0 + i + rank * 0.5,
+                    "dur_s": 0.01 * (1 + rank),
+                    "proc_id": rank, "n_procs": 2}))
+            p.write_text("\n".join(lines) + "\n")
+            paths.append(str(p))
+        r = subprocess.run(
+            [sys.executable, os.path.join(TOOLS, "events_query.py"),
+             *paths, "--by", "rank"],
+            capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "r0/2" in r.stdout and "r1/2" in r.stdout
+        assert "8 event(s)" in r.stdout
+
+    def test_events_multi_file_merge_is_time_ordered(self, tmp_path):
+        sys.path.insert(0, TOOLS)
+        try:
+            import events_query
+        finally:
+            sys.path.remove(TOOLS)
+        a = tmp_path / "a.jsonl"
+        b = tmp_path / "b.jsonl"
+        a.write_text(json.dumps({"kind": "k", "time": 200.0}) + "\n")
+        b.write_text(json.dumps({"kind": "k", "time": 100.0}) + "\n")
+        evs, problems = events_query.read_events([str(a), str(b)])
+        assert problems == []
+        assert [e["time"] for e in evs] == [100.0, 200.0]
+
+    def test_trace_view_cross_file_parent_resolution(self, tmp_path):
+        def span(sid, parent=None, ts=0):
+            args = {"span_id": sid, "trace_id": "t", "status": "ok"}
+            if parent:
+                args["parent_id"] = parent
+            return {"name": "s" + sid, "ph": "X", "cat": "span",
+                    "ts": ts, "dur": 5, "pid": 1, "tid": 1,
+                    "args": args}
+
+        f1 = tmp_path / "part1.json"
+        f2 = tmp_path / "part2.json"
+        f1.write_text(json.dumps(
+            {"traceEvents": [span("a", ts=0)], "otherData": {}}))
+        f2.write_text(json.dumps(
+            {"traceEvents": [span("b", parent="a", ts=10)],
+             "otherData": {}}))
+        # single file: the cross-file parent is a violation (the old
+        # behavior — it IS unresolvable in isolation)
+        r1 = subprocess.run(
+            [sys.executable, os.path.join(TOOLS, "trace_view.py"),
+             str(f2)], capture_output=True, text=True, timeout=120)
+        assert r1.returncode == 1
+        assert "parent" in r1.stderr
+        # both files: the parent resolves across the pair
+        r2 = subprocess.run(
+            [sys.executable, os.path.join(TOOLS, "trace_view.py"),
+             str(f1), str(f2)],
+            capture_output=True, text=True, timeout=120)
+        assert r2.returncode == 0, r2.stdout + r2.stderr
+        # a parent in NO file still fails even multi-file
+        f3 = tmp_path / "part3.json"
+        f3.write_text(json.dumps(
+            {"traceEvents": [span("c", parent="zzz", ts=20)],
+             "otherData": {}}))
+        r3 = subprocess.run(
+            [sys.executable, os.path.join(TOOLS, "trace_view.py"),
+             str(f1), str(f3)],
+            capture_output=True, text=True, timeout=120)
+        assert r3.returncode == 1

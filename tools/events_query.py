@@ -15,10 +15,15 @@ and answers the questions aggregate histograms cannot:
   prints the matched span's name, duration and child count, so "this
   request was slow" joins to "and here is what it was doing".
 
+Multiple inputs merge into one time-ordered stream — point it at every
+rank's JSONL and slice ``--by rank`` (the ``proc_id``/``n_procs``
+provenance each event carries) to see which rank's latency moved:
+
     python tools/events_query.py events.jsonl
     python tools/events_query.py events.jsonl --kind token_request \
         --by outcome,stage --top 5
     python tools/events_query.py events.jsonl --join trace.json
+    python tools/events_query.py rank*/events.jsonl --by rank,outcome
 
 Stdlib-only on purpose (no jax import): querying evidence must stay a
 sub-second operation.  Exit 0 on success, 2 on unusable input.
@@ -64,6 +69,11 @@ def read_events(paths):
                 problems.append((path, i, "not an event object"))
                 continue
             events.append(ev)
+    # merge reader: with one JSONL per rank, interleave on the wall
+    # clock so "what happened around t" reads pod-wide (stable sort —
+    # same-timestamp events keep file order)
+    events.sort(key=lambda e: e.get("time")
+                if isinstance(e.get("time"), (int, float)) else 0.0)
     return events, problems
 
 
@@ -75,7 +85,14 @@ def _quantile(sorted_vals, q):
 
 
 def _key_of(ev, fields):
-    return tuple(str(ev.get(f, "-")) for f in fields)
+    # "rank" reads the proc_id/n_procs provenance events.py records
+    # (0/1 single-process), rendered r<id>/<n> so slices stay legible
+    def val(f):
+        if f == "rank":
+            return "r%s/%s" % (ev.get("proc_id", 0), ev.get("n_procs", 1))
+        return str(ev.get(f, "-"))
+
+    return tuple(val(f) for f in fields)
 
 
 def render_slices(events, fields):
@@ -174,7 +191,8 @@ def main(argv=None):
                         "table by (default kind,outcome; stage/reason/"
                         "error_kind/label/model/tenant work too — "
                         "tenant slices gateway_request events per "
-                        "caller)")
+                        "caller, rank slices by the proc_id/n_procs "
+                        "provenance across merged per-rank files)")
     p.add_argument("--top", type=int, default=10,
                    help="slowest events to list with trace ids")
     p.add_argument("--join", metavar="TRACE_JSON",

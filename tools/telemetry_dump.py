@@ -11,13 +11,22 @@ histograms as count/sum/mean/p50/p99.
     python tools/telemetry_dump.py snap.json [--top 20]
     python tools/telemetry_dump.py --diff before.json after.json
     python tools/telemetry_dump.py --diff before.txt after.txt  # scrapes
+    python tools/telemetry_dump.py --merge r0.json r1.json [--out pod.json]
 
 ``--diff`` aligns series by (metric, labels) and prints deltas —
 the before/after view for bench runs (counter/histogram deltas are the
 work done between the snapshots; gauges show old -> new).
+
+``--merge`` folds N per-rank dumps into one pod-level view with the
+fleet collector's semantics (``fleet.merge_metrics``: counters sum
+exactly, histograms add bucket-additively, gauges take the max), so a
+merged histogram's percentiles are the pooled fleet percentiles at
+bucket resolution.  ``--out`` writes the merged dump as JSON (itself
+loadable by this tool and ``--diff``-able).
 """
 import argparse
 import json
+import os
 import re
 import sys
 
@@ -230,6 +239,43 @@ def cmd_show(paths, top):
         print()
 
 
+def cmd_merge(paths, top, out=None):
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from fleetz import load_fleet
+
+    fleet = load_fleet()
+    dumps = [_load(p) for p in paths]
+    merged = {
+        "format_version": 1,
+        "time": max((d.get("time") or 0) for d in dumps) or None,
+        "merged_from": list(paths),
+        "metrics": fleet.merge_metrics([d["metrics"] for d in dumps]),
+    }
+    if out:
+        with open(out, "w") as f:
+            json.dump(merged, f, indent=2, sort_keys=True)
+        print("wrote %s (%d inputs, %d metric families)"
+              % (out, len(paths), len(merged["metrics"])))
+    print("== merged %d dump(s) ==" % len(paths))
+    flat = _flatten(merged)
+    scalars = [(k, v) for k, (kind, v) in flat.items() if kind == "scalar"]
+    hists = [(k, s) for k, (kind, s) in flat.items() if kind == "hist"]
+    scalars.sort(key=lambda kv: -abs(kv[1]))
+    print("%-64s %14s" % ("series", "value"))
+    for k, v in scalars[:top]:
+        print("%-64s %14s" % (k, _fmt_num(v)))
+    if hists:
+        print()
+        print("%-52s %8s %10s %10s %10s %10s" % (
+            "histogram", "count", "sum", "mean", "p50", "p99"))
+        hists.sort(key=lambda kv: -kv[1].get("count", 0))
+        for k, s in hists[:top]:
+            n, tot, mean, p50, p99 = _hist_cells(s)
+            print("%-52s %8d %10s %10s %10s %10s" % (
+                k, n, "%.4g" % _num(tot), _fmt_num(mean), _fmt_num(p50),
+                _fmt_num(p99)))
+
+
 def cmd_diff(path_a, path_b, top):
     data_a, data_b = _load(path_a), _load(path_b)
     a, b = _flatten(data_a), _flatten(data_b)
@@ -284,15 +330,27 @@ def main(argv=None):
                    help="series per section (default 20)")
     p.add_argument("--diff", nargs=2, metavar=("A", "B"),
                    help="diff two dumps instead of printing them")
+    p.add_argument("--merge", nargs="+", metavar="DUMP",
+                   help="merge N per-rank dumps (fleet semantics: "
+                        "counters sum, histograms add bucket-additively)")
+    p.add_argument("--out", help="with --merge: write the merged dump "
+                                 "here as JSON")
     args = p.parse_args(argv)
+    if args.diff and args.merge:
+        p.error("--diff and --merge are mutually exclusive")
     if args.diff:
         if args.paths:
             p.error("--diff takes exactly two files and no positionals")
         cmd_diff(args.diff[0], args.diff[1], args.top)
+    elif args.merge:
+        if args.paths:
+            p.error("--merge takes its files after the flag, "
+                    "no positionals")
+        cmd_merge(args.merge, args.top, out=args.out)
     elif args.paths:
         cmd_show(args.paths, args.top)
     else:
-        p.error("give dump file(s) or --diff A B")
+        p.error("give dump file(s), --diff A B, or --merge A B ...")
     return 0
 
 

@@ -16,6 +16,22 @@ parents, ts-sorted events), and prints:
     python tools/trace_view.py trace.json [--top 20] [--tree]
     python tools/trace_view.py trace.json --top-ops 15
     python tools/trace_view.py flight_recorder/flight-...-nonfinite-p1-1
+    python tools/trace_view.py part1.json part2.json   # split export
+    python tools/trace_view.py --fleet SPOOL [--out pod.json]
+
+Multiple paths validate TOGETHER: span parents resolve against the
+union of span ids across all given files, so a parent exported into a
+different file of the same capture (flight-recorder bundles split by
+priority; fleet spools split by rank) is a resolvable reference, not a
+silently-dropped "parent not in trace" violation — a parent id that
+appears in NO given file still fails.
+
+``--fleet`` treats the path as a fleet spool dir
+(``mxnet_tpu/fleet.py``): per-rank chrome traces are stitched onto one
+clock-offset-corrected pod timeline (pid = rank, span ids prefixed
+``rN:``), torn snapshots are skipped with a counted warning, and the
+stitched payload is validated and summarized like any trace
+(``--out`` writes it for chrome://tracing / Perfetto).
 
 Exit status is nonzero on malformed input or violated invariants, so CI
 can gate on it.
@@ -43,8 +59,25 @@ def load_trace(path):
     return data
 
 
-def validate(data):
-    """Chrome-trace invariant check; returns a list of violations."""
+def span_ids(data):
+    """All span ids declared in a trace payload (for cross-file parent
+    resolution when one capture was exported as several files)."""
+    ids = set()
+    for ev in data["traceEvents"]:
+        if ev.get("ph") == "X" and ev.get("cat") == "span":
+            sid = ev.get("args", {}).get("span_id")
+            if sid is not None:
+                ids.add(sid)
+    return ids
+
+
+def validate(data, known_span_ids=None):
+    """Chrome-trace invariant check; returns a list of violations.
+
+    ``known_span_ids`` extends parent resolution beyond this file: a
+    parent living in a sibling file of the same capture resolves
+    instead of being reported missing (the multi-file bundle case).
+    Duplicate/ts/pid checks stay per-file."""
     problems = []
     seen_ids = set()
     last_ts = None
@@ -70,10 +103,12 @@ def validate(data):
                 problems.append("duplicate span_id %s" % sid)
             else:
                 seen_ids.add(sid)
+    resolvable = seen_ids if known_span_ids is None \
+        else (seen_ids | set(known_span_ids))
     for ev in data["traceEvents"]:
         if ev.get("ph") == "X" and ev.get("cat") == "span":
             parent = ev.get("args", {}).get("parent_id")
-            if parent is not None and parent not in seen_ids:
+            if parent is not None and parent not in resolvable:
                 problems.append("span %r parent %s not in trace"
                                 % (ev.get("name"), parent))
     return problems
@@ -220,11 +255,29 @@ def print_bundle_events(path):
                      if ev.get(k) is not None)))
 
 
+def _stitch_fleet(spool, out):
+    """--fleet: stitch a spool dir's per-rank traces into one pod
+    timeline via the fleet collector (stdlib-only load through
+    fleetz.load_fleet); returns (payload, spool problems)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from fleetz import load_fleet
+
+    payload, problems = load_fleet().stitch_traces(spool)
+    if out:
+        with open(out, "w") as f:
+            json.dump(payload, f)
+        fl = payload.get("otherData", {}).get("fleet", {})
+        print("wrote %s (%d rank(s) stitched, %s skipped)"
+              % (out, len(fl.get("ranks", [])), fl.get("skipped", 0)))
+    return payload, problems
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         description="Summarize/validate mxnet_tpu chrome-trace exports")
-    p.add_argument("path", help="trace JSON file or flight-recorder "
-                                "bundle directory")
+    p.add_argument("paths", nargs="+", metavar="path",
+                   help="trace JSON file(s) or flight-recorder bundle "
+                        "directory; with --fleet, one spool dir")
     p.add_argument("--top", type=int, default=20,
                    help="rows per section (default 20)")
     p.add_argument("--tree", action="store_true",
@@ -232,22 +285,63 @@ def main(argv=None):
     p.add_argument("--top-ops", type=int, default=0, metavar="N",
                    help="print the N most expensive timeline ops with "
                         "total time and est. HBM bytes")
+    p.add_argument("--fleet", action="store_true",
+                   help="treat the path as a fleet spool dir and stitch "
+                        "the per-rank traces into one pod timeline")
+    p.add_argument("--out", help="write the loaded (or stitched) trace "
+                                 "payload to this JSON file")
     args = p.parse_args(argv)
-    data = load_trace(args.path)
-    problems = validate(data)
-    summarize(data, args.top)
-    if os.path.isdir(args.path):
-        print_bundle_events(os.path.join(args.path, "events.json"))
-    if args.top_ops:
-        print_top_ops(data, args.top_ops)
-    if args.tree:
-        print_tree(data, args.top)
-    if problems:
-        print()
-        for msg in problems:
-            print("INVARIANT VIOLATION: %s" % msg, file=sys.stderr)
-        return 1
-    return 0
+
+    if args.fleet:
+        if len(args.paths) != 1:
+            p.error("--fleet takes exactly one spool dir")
+        payload, spool_problems = _stitch_fleet(args.paths[0], args.out)
+        for msg in spool_problems:
+            print("trace_view: fleet: %s" % msg, file=sys.stderr)
+        problems = validate(payload)
+        summarize(payload, args.top)
+        if args.tree:
+            print_tree(payload, args.top)
+        if problems:
+            print()
+            for msg in problems:
+                print("INVARIANT VIOLATION: %s" % msg, file=sys.stderr)
+            return 1
+        return 0
+
+    datas = [load_trace(path) for path in args.paths]
+    # cross-file parent resolution: one capture exported as several
+    # files (bundle split, per-rank spool) must validate as a whole
+    all_ids = set()
+    for data in datas:
+        all_ids |= span_ids(data)
+    exit_code = 0
+    for path, data in zip(args.paths, datas):
+        if len(datas) > 1:
+            print("== %s ==" % path)
+        problems = validate(data, known_span_ids=all_ids)
+        summarize(data, args.top)
+        if os.path.isdir(path):
+            print_bundle_events(os.path.join(path, "events.json"))
+        if args.top_ops:
+            print_top_ops(data, args.top_ops)
+        if args.tree:
+            print_tree(data, args.top)
+        if problems:
+            print()
+            for msg in problems:
+                print("INVARIANT VIOLATION: %s" % msg, file=sys.stderr)
+            exit_code = 1
+        if len(datas) > 1:
+            print()
+    if args.out and datas:
+        with open(args.out, "w") as f:
+            json.dump(datas[0] if len(datas) == 1 else
+                      {"traceEvents": [ev for d in datas
+                                       for ev in d["traceEvents"]],
+                       "otherData": {"merged_from": list(args.paths)}},
+                      f)
+    return exit_code
 
 
 if __name__ == "__main__":
