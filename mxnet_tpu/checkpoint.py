@@ -56,6 +56,7 @@ import random as _pyrandom
 import re
 import shutil
 import signal
+import sys
 import tempfile
 import threading
 import time
@@ -560,8 +561,10 @@ class CheckpointManager:
                     writer()
             except BaseException as e:
                 self._note_save_event(step, "sync", t0, e, pcnt)
+                self._note_goodput_save(step, t0, e)
                 raise
             self._note_save_event(step, "sync", t0, None, pcnt)
+            self._note_goodput_save(step, t0, None)
             return
         t = threading.Thread(target=self._write_guarded,
                              args=(step, writer, pcnt),
@@ -585,12 +588,28 @@ class CheckpointManager:
                                  mode="async"):
                 writer()
             self._note_save_event(step, "async", t0, None, pcnt)
+            self._note_goodput_save(step, t0, None)
         except BaseException as e:  # surfaced on wait()/next save
             self._note_save_event(step, "async", t0, e, pcnt)
+            self._note_goodput_save(step, t0, e)
             with self._lock:
                 self._pending_error = e
         finally:
             _telemetry.CHECKPOINT_QUEUE_DEPTH.dec()
+
+    def _note_goodput_save(self, step, t0, exc):
+        """Goodput ledger: one ``ckpt_save`` segment per save — a
+        committed one advances the lost-work baseline (no-op without a
+        live recorder; never raises into the save path)."""
+        gp = sys.modules.get("mxnet_tpu.goodput")
+        if gp is not None and gp.active():
+            try:
+                gp.record_segment("ckpt_save",
+                                  time.perf_counter() - t0,
+                                  step=int(step),
+                                  committed=exc is None)
+            except Exception:
+                pass
 
     def _note_save_event(self, step, mode, t0, exc, pcnt=1):
         """One wide event per checkpoint save (events.py; no-op when
@@ -1101,6 +1120,14 @@ class CheckpointManager:
             self._note_load_event(step, t0, type(e).__name__)
             raise
         self._note_load_event(step, t0, None, ckpt=out)
+        gp = sys.modules.get("mxnet_tpu.goodput")
+        if gp is not None and gp.active() and out is not None:
+            try:
+                gp.record_segment("ckpt_restore",
+                                  time.perf_counter() - t0,
+                                  step=getattr(out, "step", None))
+            except Exception:
+                pass
         return out
 
     @staticmethod
@@ -1245,6 +1272,7 @@ class CheckpointManager:
             self.logger.warning(
                 "signal %d: flushing final checkpoint before preemption",
                 signum)
+            final_step = None
             try:
                 try:
                     self.wait()
@@ -1254,6 +1282,7 @@ class CheckpointManager:
                 state = state_fn()
                 if state is not None:
                     step, arrays, blobs, meta = state
+                    final_step = int(step)
                     meta = dict(meta or {})
                     meta.setdefault("preempted", True)
                     self.save(step, arrays, blobs=blobs, meta=meta,
@@ -1272,6 +1301,15 @@ class CheckpointManager:
                 _tracing.record_crash("preemption",
                                       extra={"signal": int(signum)})
                 self.preempted = True
+                gp = sys.modules.get("mxnet_tpu.goodput")
+                if gp is not None:
+                    try:
+                        # the SIGTERM exit boundary: the incarnation
+                        # ended preempted, not killed — the flushed
+                        # final checkpoint means no lost work
+                        gp.note_exit("preempt", step=final_step)
+                    except Exception:
+                        pass
                 if exit_code is not None:
                     os._exit(exit_code)
             prev = self._prev_handlers.get(signum)

@@ -337,6 +337,23 @@ FLAGS = {
         "base URL for gluon model_zoo weight downloads (file:// works "
         "for air-gapped mirrors); '' disables downloads "
         "(model_store.get_model_file)"),
+    "MXNET_GOODPUT_DIR": (
+        "", str, "honored",
+        "goodput-ledger job directory (goodput.py): each process "
+        "incarnation appends typed wall-clock segments (productive "
+        "step, compile, checkpoint save/restore, data wait, startup, "
+        "drain) to its own crash-safe JSONL here and the reader "
+        "(tools/goodputz.py, /goodputz, perf_report --goodput) merges "
+        "every incarnation of every rank into one job-lifetime "
+        "goodput/badput report with preemption lost-work pricing; "
+        "'' = ledger off"),
+    "MXNET_GOODPUT_FLUSH_EVERY": (
+        "16", _pint, "honored",
+        "goodput-ledger sidecar cadence: records appended between "
+        "prefix-digest sidecar commits (GoodputRecorder.flush); the "
+        "tail past the last flush is still read best-effort under the "
+        "torn-line discipline, so this bounds re-hash work, not data "
+        "loss"),
     "MXNET_HOME": (
         os.path.join("~", ".mxnet"), str, "honored",
         "data/cache root for gluon contrib dataset downloads "

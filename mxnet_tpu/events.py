@@ -291,12 +291,26 @@ def stats():
 # the bounded background writer
 # ---------------------------------------------------------------------------
 
+_atexit_registered = False
+
+
 def _ensure_writer_locked():
-    global _writer
+    global _writer, _atexit_registered
     if _writer is None or not _writer.is_alive():
         _writer = threading.Thread(target=_writer_loop,
                                    name="events-writer", daemon=True)
         _writer.start()
+    if not _atexit_registered:
+        # flush-on-exit: the writer is a daemon thread, so a
+        # short-lived CLI run (bench tools, prewarm) can exit with a
+        # tail batch still queued — drain it synchronously at
+        # interpreter shutdown.  What a FULL queue already dropped
+        # stays dropped (and counted): atexit recovers the tail, not
+        # the backpressure losses.
+        import atexit
+
+        atexit.register(_drain_once, True)
+        _atexit_registered = True
 
 
 def _writer_loop():

@@ -700,6 +700,10 @@ def fleetz(spool=None, stale_after=None, merge=True):
     for rank, row in sorted(view["ranks"].items()):
         snap = row["snapshot"]
         bd = snap.get("breakdown") or {}
+        # the rank's goodput summary rides its statusz snapshot (the
+        # goodput /statusz subsystem), so a straggler's job-lifetime
+        # badput is visible in the merged pod view
+        gp = ((snap.get("statusz") or {}).get("goodput") or {})
         out["ranks"][str(rank)] = {
             "seq": snap.get("seq"),
             "pid": snap.get("pid"),
@@ -710,6 +714,8 @@ def fleetz(spool=None, stale_after=None, merge=True):
             "wall_ms_per_step": bd.get("wall_ms_per_step"),
             "buckets_ms_per_step": bd.get("buckets_ms_per_step"),
             "clock_offset_s": view["clock_offsets"].get(rank),
+            "goodput_pct": gp.get("goodput_pct")
+            if gp.get("active") else None,
             "trace": os.path.exists(
                 os.path.join(spool, TRACE_NAME % rank)),
         }
@@ -845,6 +851,8 @@ def stitch_traces(spool, stale_after=None):
                 "clock_offsets_s": {str(r): o for r, o in
                                     sorted(offsets.items())},
                 "skipped": len(view["ranks"]) - len(stitched_ranks),
+                "stale": sorted(r for r, row in view["ranks"].items()
+                                if row.get("stale")),
                 "torn_snapshots": view["torn"],
             }
         },

@@ -25,6 +25,7 @@ wrapper degrades to a passthrough iterator).
 from __future__ import annotations
 
 import queue as _queue
+import sys as _sys
 import threading as _threading
 import time as _time
 
@@ -128,11 +129,18 @@ class DevicePrefetcher:
             if tel:
                 _telemetry.PREFETCH_STALLS.inc()
             _tracing.instant("prefetch:stall")
-            t0 = _time.perf_counter() if tel else None
+            _gp = _sys.modules.get("mxnet_tpu.goodput")
+            gp_on = _gp is not None and _gp.active()
+            t0 = _time.perf_counter() if (tel or gp_on) else None
             kind, item = self._q.get()
-            if tel:
-                _telemetry.PREFETCH_WAIT_SECONDS.observe(
-                    _time.perf_counter() - t0)
+            if tel or gp_on:
+                wait_s = _time.perf_counter() - t0
+                if tel:
+                    _telemetry.PREFETCH_WAIT_SECONDS.observe(wait_s)
+                if gp_on:
+                    # the same blocked wall the attribution bucket
+                    # sees becomes the ledger's data_wait segment
+                    _gp.record_segment("data_wait", wait_s)
         if kind == "err":
             self._done = True
             raise item

@@ -17,6 +17,7 @@ from __future__ import annotations
 import functools
 import queue as _queue
 import signal as _signal
+import sys as _sys
 import threading as _threading
 import time as _time
 
@@ -1128,9 +1129,17 @@ class ShardedTrainer:
         from .. import profiler as _profiler
 
         tel = _telemetry.enabled()
-        # the step timestamp serves both telemetry and the wide-event
-        # layer — each is independently enableable
+        # the step timestamp serves telemetry, the wide-event layer
+        # and the goodput ledger — each is independently enableable
+        _gp0 = _sys.modules.get("mxnet_tpu.goodput")
+        gp_live = _gp0 is not None and _gp0.active()
         t_step0 = _time.perf_counter() if tel or _events.enabled() \
+            or gp_live else None
+        # compile wall that lands INSIDE this step window (first-step
+        # jit, bucket recompiles) is compile badput, not goodput —
+        # snapshot the ledger's compile counter so _account can carve
+        # the delta out of the productive_step segment
+        self._gp_compile0 = _gp0.compile_seconds_total() if gp_live \
             else None
         if tel and self._last_dispatch_end is not None:
             # dispatch-to-dispatch idle: host time spent OUTSIDE step
@@ -1307,6 +1316,7 @@ class ShardedTrainer:
         ``skipped_steps``/heartbeat gauges, at epoch ends, or before
         tearing the trainer down.  A no-op in sync mode (metrics were
         consumed inside each step)."""
+        t0 = _time.perf_counter()
         self._flush_metrics(self.global_step, force=True)
         if self._fetcher is not None:
             self._fetcher.wait()
@@ -1314,6 +1324,10 @@ class ShardedTrainer:
                 err, self._fetcher.error = self._fetcher.error, None
                 raise err
         self._raise_pending()
+        _gp = _sys.modules.get("mxnet_tpu.goodput")
+        if _gp is not None and _gp.active():
+            _gp.record_segment("drain", _time.perf_counter() - t0,
+                               step=self.global_step)
         return self
 
     def step_breakdown(self):
@@ -1382,7 +1396,9 @@ class ShardedTrainer:
         # an enable() racing in mid-step must not crash the accounting
         tel = _telemetry.enabled() and t_step0 is not None
         ev_on = _events.enabled() and t_step0 is not None
-        if tel or ev_on:
+        _gp = _sys.modules.get("mxnet_tpu.goodput")
+        gp_on = _gp is not None and _gp.active() and t_step0 is not None
+        if tel or ev_on or gp_on:
             dt = _time.perf_counter() - t_step0
             bs = 0
             for a in (raw_label,) + tuple(raw_in):
@@ -1420,6 +1436,19 @@ class ShardedTrainer:
                 batch_rows=bs or None,
                 samples_per_sec=round(bs * n / dt, 3)
                 if bs and dt > 0 else None)
+        if gp_on:
+            # the goodput ledger's productive_step segment: the same
+            # dispatch-window wall the step histogram observes, minus
+            # any compile wall recorded inside the window (already a
+            # compile segment), tagged with the step reached so
+            # lost-work pricing can anchor on the last committed
+            # checkpoint
+            comp0 = getattr(self, "_gp_compile0", None)
+            comp = max(0.0, _gp.compile_seconds_total() - comp0) \
+                if comp0 is not None else 0.0
+            _gp.record_segment("productive_step",
+                               max(0.0, dt - comp),
+                               step=self.global_step, steps=n)
         if tel or _tracing.enabled():
             # per-step HBM watermark sample: live/peak gauges per device
             # plus a counter track in the exported chrome trace
@@ -1486,6 +1515,11 @@ class ShardedTrainer:
         m.save(s, arrays, blobs=blobs, meta=meta, block=True)
         m.preempted = True
         m.clear_coordinated_commit()
+        _gp = _sys.modules.get("mxnet_tpu.goodput")
+        if _gp is not None:
+            # the coordinated-commit exit boundary: everything up to
+            # the committed step is goodput, nothing is lost work
+            _gp.note_exit("preempt", step=s)
         return True
 
     def check_preemption(self, force=False):
@@ -1558,6 +1592,8 @@ class ShardedTrainer:
             # alone clears aborted-save debris and any stale preemption
             # flag a previous incarnation left behind
             manager.sweep_orphans()
+        resumed = False
+        t_load0 = _time.perf_counter()
         if auto_resume:
             ckpt = manager.load(
                 restrict=self._elastic_restrict(manager),
@@ -1565,13 +1601,33 @@ class ShardedTrainer:
                          "layout": self.layout_name})
             if ckpt is not None:
                 self.restore_checkpoint(ckpt)
+                resumed = True
                 _telemetry.TRAIN_RESUMES.inc()
                 if getattr(ckpt, "resharded", False) and \
                         getattr(ckpt, "sharded", False):
                     _telemetry.ELASTIC_RESUMES.inc()
-        if install_signal_handler:
-            from .. import config as _config
+        load_s = _time.perf_counter() - t_load0
+        from .. import config as _config
 
+        gdir = str(_config.get("MXNET_GOODPUT_DIR") or "")
+        if gdir:
+            # attach is the incarnation boundary: one recorder per
+            # process, begun with the resume provenance the lost-work
+            # rule prices against.  The restore above ran before the
+            # recorder existed, so its wall is recorded here (a direct
+            # manager.load under a live recorder is covered by the
+            # CheckpointManager hook instead).
+            from .. import goodput as _goodput
+
+            if not _goodput.active():
+                rec = _goodput.GoodputRecorder(gdir).begin(
+                    start_reason="resume" if resumed else "fresh",
+                    resumed_from_step=self.global_step if resumed
+                    else None)
+                if resumed:
+                    rec.segment("ckpt_restore", load_s,
+                                step=self.global_step)
+        if install_signal_handler:
             gate = max(1, int(_config.get("MXNET_DIST_PREEMPT_GATE"))) \
                 * max(1, self.steps_per_call)
             manager.install_preemption_handler(self._checkpoint_payload,

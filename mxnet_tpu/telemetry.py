@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import threading
 import time
 
@@ -1009,6 +1010,24 @@ FLEET_TORN_SNAPSHOTS = counter(
     "sidecar, digest mismatch, unparsable payload) — the read_ledger "
     "torn-line discipline applied to the fleet spool.")
 
+# Goodput ledger (goodput.py; see docs/observability.md)
+GOODPUT_SEGMENTS = counter(
+    "mxnet_tpu_goodput_segments_total",
+    "Typed wall-clock segments this incarnation appended to its "
+    "goodput ledger, by kind (productive_step, compile, ckpt_save, "
+    "ckpt_restore, data_wait, startup, drain).",
+    ("kind",))
+GOODPUT_WRITE_ERRORS = counter(
+    "mxnet_tpu_goodput_write_errors_total",
+    "Goodput ledger appends or sidecar flushes that failed (job dir "
+    "unwritable); counted and logged once, never raised into the "
+    "step loop.")
+GOODPUT_TORN_LINES = counter(
+    "mxnet_tpu_goodput_torn_lines_total",
+    "Torn or unparsable goodput ledger lines (and prefix-digest "
+    "mismatches) the reader skipped with a counted problem — the "
+    "read_ledger torn-line discipline applied to the goodput job dir.")
+
 
 # ---------------------------------------------------------------------------
 # jax.monitoring bridge: compile + compilation-cache events
@@ -1040,6 +1059,15 @@ def _on_jax_duration(event, duration_secs, **kw):
     if event in _BACKEND_COMPILE_EVENTS:
         COMPILES.inc()
         COMPILE_SECONDS.observe(duration_secs)
+        # feed the goodput ledger's compile bucket (no-op unless a
+        # recorder is live; the AOT miss path suppresses this via
+        # compile_guard so its owned segment isn't double-counted)
+        gp = sys.modules.get("mxnet_tpu.goodput")
+        if gp is not None:
+            try:
+                gp.record_compile(duration_secs)
+            except Exception:
+                pass
 
 
 def _install_jax_bridge():
@@ -1270,13 +1298,15 @@ def statusz():
         },
         "events": {"enabled": False},
         "fleet": {"active": False},
+        "goodput": {"active": False},
     }
     try:
-        # events and fleet register their providers on import;
-        # importing here makes the subsystems live even when nothing
-        # else pulled them in
+        # events, fleet and goodput register their providers on
+        # import; importing here makes the subsystems live even when
+        # nothing else pulled them in
         from . import events as _events  # noqa: F401
         from . import fleet as _fleet  # noqa: F401
+        from . import goodput as _goodput  # noqa: F401
     except Exception:
         pass
     for name, fn in sorted(_status_providers.items()):
@@ -1407,6 +1437,15 @@ class _ScrapeServer:
                                                                  "false")
                     body = _json_body(_fleet.fleetz(
                         spool=spool, stale_after=stale, merge=merge))
+                    ctype = "application/json; charset=utf-8"
+                elif path == "/goodputz":
+                    from urllib.parse import parse_qs
+
+                    from . import goodput as _goodput
+
+                    q = parse_qs(query)
+                    gdir = (q.get("dir") or [None])[0]
+                    body = _json_body(_goodput.goodputz(dir=gdir))
                     ctype = "application/json; charset=utf-8"
                 else:
                     self.send_error(404, "unknown path %r" % path)

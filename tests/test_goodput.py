@@ -1,0 +1,404 @@
+"""Job-lifetime goodput ledger: kill/resume drill + satellite regressions.
+
+Covers (see docs/observability.md "Goodput ledger"):
+
+* the acceptance drill — a real ``WorkerFleet`` of OS processes runs
+  ``mxnet_tpu.testing.goodput_worker`` twice over one job dir: run 1
+  SIGKILLs rank 1 two steps after its last committed checkpoint, run 2
+  resumes both ranks from their checkpoints and exits clean.  The
+  merged report must (a) attribute exactly the steps-since-checkpoint
+  of the killed incarnation to ``lost_work``, (b) sum every bucket to
+  the externally-timed wall-clock within 5%, and (c) skip torn/partial
+  ledger lines with a counted warning, never a crash;
+* surface parity — ``tools/goodputz.py --json``, the ``/goodputz``
+  HTTP route, ``/statusz``'s ``goodput`` subsystem, the heartbeat
+  ``goodput X.XX%`` tier and ``perf_report --goodput`` all render the
+  same ``goodput_pct``;
+* satellite regressions that ride in the same PR: the events writer's
+  atexit tail flush, ``events_query --by rank`` on pre-provenance
+  files, and the empty-spool / all-stale diagnoses of ``fleetz.py``
+  and ``trace_view.py --fleet``.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+import time
+import urllib.parse
+import urllib.request
+
+import pytest
+
+from mxnet_tpu import fleet, goodput, monitor, telemetry as tel
+from mxnet_tpu.testing import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+TOOLS = os.path.join(REPO, "tools")
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("MXNET_TEST_PLATFORM") == "tpu",
+    reason="goodput drills spawn CPU-only subprocess incarnations")
+
+
+@pytest.fixture
+def registry():
+    tel.enable()
+    tel.reset()
+    yield tel
+    tel.reset()
+    tel.disable()
+
+
+def _run_tool(argv, env=None):
+    e = dict(os.environ)
+    e["JAX_PLATFORMS"] = "cpu"
+    e.update(env or {})
+    return subprocess.run([sys.executable] + argv, cwd=REPO, env=e,
+                          capture_output=True, text=True, timeout=240)
+
+
+# ---------------------------------------------------------------------------
+# the kill/resume acceptance drill (real OS-process incarnations)
+# ---------------------------------------------------------------------------
+
+N_PROCS = 2
+STEPS = 12
+STEP_TIME = 0.03
+SAVE_EVERY = 4
+KILL_RANK = 1
+KILL_STEP = 10          # last committed ckpt at 8 -> exactly 2 lost steps
+LOST_STEPS = KILL_STEP - (KILL_STEP // SAVE_EVERY) * SAVE_EVERY
+
+
+@pytest.fixture(scope="module")
+def drill(tmp_path_factory):
+    root = tmp_path_factory.mktemp("goodput_drill")
+    gdir, cdir = str(root / "gp"), str(root / "ck")
+    common = ["-m", "mxnet_tpu.testing.goodput_worker",
+              "--dir", gdir, "--ckpt", cdir,
+              "--steps", str(STEPS), "--step-time", str(STEP_TIME),
+              "--save-every", str(SAVE_EVERY)]
+    wf = faults.WorkerFleet(
+        N_PROCS, common + ["--kill-rank", str(KILL_RANK),
+                           "--kill-step", str(KILL_STEP)], cwd=REPO)
+    run1 = wf.wait(timeout=240)
+    wf2 = faults.WorkerFleet(N_PROCS, common, cwd=REPO)
+    run2 = wf2.wait(timeout=240)
+    return gdir, run1, run2
+
+
+def _walls(out):
+    """Externally-timed incarnation walls printed by the worker
+    (``GOODPUT_WALL`` on clean exit, ``GOODPUT_KILL_WALL`` right
+    before the self-SIGKILL) — measured WITHOUT the ledger."""
+    return [float(m.group(2)) for m in re.finditer(
+        r"^GOODPUT(_KILL)?_WALL ([0-9.]+)$", out, re.M)]
+
+
+class TestKillResumeDrill:
+    def test_workers_completed(self, drill):
+        _, run1, run2 = drill
+        rc0, out0 = run1[0]
+        assert rc0 == 0 and "GOODPUT_DONE" in out0, out0
+        rck, outk = run1[KILL_RANK]
+        assert rck != 0, outk
+        assert "GOODPUT_KILL_WALL" in outk, outk
+        assert "GOODPUT_DONE" not in outk, outk
+        for rank, (rc, out) in enumerate(run2):
+            assert rc == 0 and "GOODPUT_DONE" in out, \
+                "rank %d rc=%s\n%s" % (rank, rc, out)
+        # rank 0 finished in run 1 -> zero-step clean incarnation
+        assert "GOODPUT_RESUMED %d" % STEPS in run2[0][1]
+        # the killed rank resumes from its last committed checkpoint
+        last_ckpt = (KILL_STEP // SAVE_EVERY) * SAVE_EVERY
+        assert "GOODPUT_RESUMED %d" % last_ckpt in run2[KILL_RANK][1]
+
+    def test_lost_work_attributed_to_killed_incarnation(self, drill):
+        gdir, _, _ = drill
+        p = goodput.goodputz(dir=gdir)
+        assert p["active"] and not p["problems"], p
+        assert p["n_ranks"] == N_PROCS
+        assert p["n_incarnations"] == 2 * N_PROCS
+        killed = [r for r in p["incarnations"]
+                  if r["exit_reason"] == "killed"]
+        assert len(killed) == 1
+        k = killed[0]
+        assert k["rank"] == KILL_RANK
+        assert k["last_step"] == KILL_STEP
+        assert k["last_ckpt_step"] == \
+            (KILL_STEP // SAVE_EVERY) * SAVE_EVERY
+        # (a) steps since the last committed checkpoint, priced at the
+        # incarnation's own measured step time
+        assert k["lost_steps"] == LOST_STEPS
+        assert k["lost_work_s"] == pytest.approx(
+            LOST_STEPS * k["step_time_s"], abs=1e-4)
+        assert k["lost_work_s"] >= LOST_STEPS * STEP_TIME * 0.9
+        assert p["kills"] == 1 and p["lost_steps"] == LOST_STEPS
+        # clean incarnations price nothing as lost
+        for r in p["incarnations"]:
+            if r is not k:
+                assert r["exit_reason"] == "clean" and \
+                    r["lost_steps"] == 0
+        # the resumed incarnation carries its provenance
+        resumed = [r for r in p["incarnations"]
+                   if r["rank"] == KILL_RANK and
+                   r["start_reason"] == "resume"]
+        assert len(resumed) == 1
+        assert resumed[0]["resumed_from_step"] == k["last_ckpt_step"]
+        assert resumed[0]["steps"] == STEPS - k["last_ckpt_step"]
+        # total steps run = 12 (r0) + 10 (killed) + 0 (r0 resume) + 4
+        assert p["steps"] == STEPS + KILL_STEP + \
+            (STEPS - k["last_ckpt_step"])
+
+    def test_buckets_sum_to_externally_timed_wall(self, drill):
+        gdir, run1, run2 = drill
+        p = goodput.goodputz(dir=gdir)
+        # external clock per (rank, incarnation order): worker prints
+        # its wall from time.time() without consulting the ledger
+        ext = {}
+        for rank in range(N_PROCS):
+            ext[rank] = _walls(run1[rank][1]) + _walls(run2[rank][1])
+        rows = sorted(p["incarnations"],
+                      key=lambda r: (r["rank"], r["start_time"]))
+        by_rank = {}
+        for r in rows:
+            by_rank.setdefault(r["rank"], []).append(r)
+        total_ext = 0.0
+        for rank, rws in by_rank.items():
+            assert len(rws) == len(ext[rank]) == 2
+            for row, wall_ext in zip(rws, ext[rank]):
+                total_ext += wall_ext
+                bsum = sum(row["buckets_s"].values())
+                # buckets tile the incarnation wall by construction
+                assert bsum == pytest.approx(row["wall_s"], abs=1e-4)
+                # (b) ...and that wall matches the EXTERNAL clock
+                assert row["wall_s"] == pytest.approx(
+                    wall_ext, rel=0.05, abs=0.02), \
+                    "rank %d: ledger wall %.3fs vs external %.3fs" \
+                    % (rank, row["wall_s"], wall_ext)
+        assert sum(p["buckets_s"].values()) == \
+            pytest.approx(p["wall_s"], abs=1e-3)
+        assert p["wall_s"] == pytest.approx(total_ext, rel=0.05,
+                                            abs=0.05)
+        # the kill showed up as real badput
+        assert p["buckets_s"]["lost_work"] > 0
+        assert p["goodput_pct"] is not None and \
+            0 < p["goodput_pct"] < 100
+
+    def test_mttr_bridges_kill_to_successor_first_step(self, drill):
+        gdir, _, _ = drill
+        p = goodput.goodputz(dir=gdir)
+        ev = p["mttr"]["events"]
+        assert len(ev) == 1 and ev[0]["rank"] == KILL_RANK
+        assert ev[0]["mttr_s"] > 0
+        assert p["mttr"]["mean_s"] == pytest.approx(ev[0]["mttr_s"])
+
+    def test_torn_ledger_skipped_with_counted_warning(
+            self, drill, registry, tmp_path):
+        gdir, _, _ = drill
+        base = goodput.goodputz(dir=gdir)
+        torn_dir = str(tmp_path / "torn")
+        shutil.copytree(gdir, torn_dir)
+        ledgers = sorted(n for n in os.listdir(torn_dir)
+                         if n.endswith(".jsonl"))
+        # a torn tail: one truncated record and one garbage line
+        # appended past the sidecar-covered prefix
+        with open(os.path.join(torn_dir, ledgers[0]), "a") as f:
+            f.write('{"type": "segment", "kind": "productive_st')
+            f.write("\nnot json at all\n")
+        # a corrupted durability sidecar on another ledger
+        ok = os.path.join(torn_dir, ledgers[1] + ".ok")
+        side = json.load(open(ok))
+        side["sha256"] = "0" * 64
+        with open(ok, "w") as f:
+            json.dump(side, f)
+        before = registry.GOODPUT_TORN_LINES.value()
+        p = goodput.goodputz(dir=torn_dir)     # (c) never a crash
+        assert p["torn_lines"] >= 2
+        assert p["problems"], p
+        assert registry.GOODPUT_TORN_LINES.value() >= before + 2
+        # the damage is skipped, not silently absorbed into totals
+        assert p["steps"] == base["steps"]
+        assert p["lost_steps"] == base["lost_steps"]
+        assert p["kills"] == base["kills"]
+
+    def test_all_surfaces_render_the_same_numbers(
+            self, drill, registry):
+        gdir, _, _ = drill
+        expected = goodput.goodputz(dir=gdir)["goodput_pct"]
+        assert expected is not None
+        # 1) the stdlib-only CLI
+        r = _run_tool([os.path.join(TOOLS, "goodputz.py"), gdir,
+                       "--json"])
+        assert r.returncode == 0, r.stderr
+        assert json.loads(r.stdout)["goodput_pct"] == expected
+        # 2) perf_report --goodput renders the same percentage
+        r = _run_tool([os.path.join(TOOLS, "perf_report.py"),
+                       "--goodput", gdir])
+        assert r.returncode == 0, r.stderr
+        m = re.search(r"\((\d+\.\d+)%\)", r.stdout)
+        assert m and float(m.group(1)) == pytest.approx(expected)
+        # 3) /statusz subsystem + 4) heartbeat tier, against the
+        # process-active job dir
+        old = goodput.active_dir()
+        goodput.set_dir(gdir)
+        try:
+            sz = registry.statusz()["subsystems"]["goodput"]
+            assert sz["active"] and sz["goodput_pct"] == expected
+            assert sz["kills"] == 1 and sz["lost_steps"] == LOST_STEPS
+            line = monitor.TelemetryHeartbeat().line()
+            assert "goodput %.2f%%" % expected in line, line
+            # 5) the /goodputz HTTP route
+            srv = registry.serve_scrape(port=0)
+            try:
+                url = "http://127.0.0.1:%d/goodputz?dir=%s" % (
+                    srv.port, urllib.parse.quote(gdir, safe=""))
+                with urllib.request.urlopen(url, timeout=30) as resp:
+                    body = json.load(resp)
+                assert body["goodput_pct"] == expected
+                assert body["n_incarnations"] == 2 * N_PROCS
+            finally:
+                registry.stop_scrape()
+        finally:
+            goodput.set_dir(old)
+
+    def test_perf_report_goodput_appends_ledger_records(
+            self, drill, tmp_path):
+        gdir, _, _ = drill
+        ledger = str(tmp_path / "perf.jsonl")
+        r = _run_tool([os.path.join(TOOLS, "perf_report.py"),
+                       "--goodput", gdir, "--ledger", ledger])
+        assert r.returncode == 0, r.stderr
+        recs = [json.loads(ln) for ln in open(ledger)
+                if ln.strip()]
+        metrics = {rec["metric"] for rec in recs}
+        assert {"goodput_pct", "goodput_lost_work_s",
+                "goodput_mttr_s"} <= metrics
+        # the gate must treat goodput_pct as up-good despite its
+        # "pct" unit being direction-ambiguous in general
+        sys.path.insert(0, TOOLS)
+        try:
+            import perf_gate
+            assert perf_gate.higher_is_better("goodput_pct", "pct") \
+                is True
+        finally:
+            sys.path.remove(TOOLS)
+
+
+class TestGoodputzCliDiagnostics:
+    def test_empty_job_dir_is_a_diagnosis_not_a_report(self, tmp_path):
+        d = str(tmp_path / "empty")
+        os.mkdir(d)
+        r = _run_tool([os.path.join(TOOLS, "goodputz.py"), d])
+        assert r.returncode == 1
+        assert "no incarnation ledgers" in r.stderr
+
+
+# ---------------------------------------------------------------------------
+# satellite: events writer atexit tail flush
+# ---------------------------------------------------------------------------
+
+class TestEventsAtexitFlush:
+    def test_unflushed_tail_survives_clean_exit(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        script = (
+            "from mxnet_tpu import events\n"
+            "events.enable(path=%r, sample=1.0)\n"
+            "for i in range(5):\n"
+            "    events.emit('atexit_drill', outcome='ok',\n"
+            "                dur_s=0.001)\n"
+            "# exit WITHOUT flush(): the atexit drain must recover\n"
+            "# the queued tail\n" % path)
+        r = _run_tool(["-c", script])
+        assert r.returncode == 0, r.stderr
+        evs = [json.loads(ln) for ln in open(path) if ln.strip()]
+        assert len(evs) == 5
+        assert all(e["kind"] == "atexit_drill" for e in evs)
+
+
+# ---------------------------------------------------------------------------
+# satellite: events_query --by rank on pre-provenance files
+# ---------------------------------------------------------------------------
+
+class TestEventsQueryLegacyRank:
+    def test_legacy_events_default_to_rank_zero_and_say_so(
+            self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        with open(path, "w") as f:
+            for i in range(3):      # pre-provenance: no proc_id field
+                f.write(json.dumps({"kind": "load", "outcome": "ok",
+                                    "dur_s": 0.01,
+                                    "time": 100.0 + i}) + "\n")
+            f.write(json.dumps({"kind": "load", "outcome": "ok",
+                                "dur_s": 0.01, "time": 103.0,
+                                "proc_id": 1, "n_procs": 2}) + "\n")
+        r = _run_tool([os.path.join(TOOLS, "events_query.py"), path,
+                       "--by", "rank"])
+        assert r.returncode == 0, r.stderr
+        assert "r0/1" in r.stdout and "r1/2" in r.stdout
+        assert "3 event(s) predate rank provenance" in r.stdout
+        assert "defaulted to rank 0" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# satellite: fleetz / trace_view --fleet empty-spool and all-stale
+# diagnoses
+# ---------------------------------------------------------------------------
+
+def _stale_spool(tmp_path, registry):
+    """A spool with one durable snapshot + trace that is already older
+    than any tight staleness cut by the time the tools read it."""
+    spool = str(tmp_path / "spool")
+    os.mkdir(spool)
+    registry.TRAIN_STEP_SECONDS.observe(0.002, loop="sharded")
+    registry.TRAIN_STEPS.inc(loop="sharded")
+    pub = fleet.FleetPublisher(spool, rank=0, n_procs=1,
+                               publish_trace=False)
+    assert pub.publish_once() is not None
+    with open(os.path.join(spool, fleet.TRACE_NAME % 0), "w") as f:
+        json.dump({"traceEvents": [], "otherData":
+                   {"pid": os.getpid()}}, f)
+    time.sleep(0.3)
+    return spool
+
+
+class TestFleetToolDiagnostics:
+    def test_fleetz_empty_spool_diagnoses_and_fails(self, tmp_path):
+        d = str(tmp_path / "empty")
+        os.mkdir(d)
+        r = _run_tool([os.path.join(TOOLS, "fleetz.py"), d])
+        assert r.returncode == 1, r.stdout
+        assert "no durable rank snapshots" in r.stderr
+
+    def test_fleetz_all_stale_diagnoses_and_fails(
+            self, tmp_path, registry):
+        spool = _stale_spool(tmp_path, registry)
+        r = _run_tool([os.path.join(TOOLS, "fleetz.py"), spool,
+                       "--stale-after", "0.05"])
+        assert r.returncode == 1, r.stdout
+        assert "stale" in r.stderr
+        # ...and the same spool passes with a sane cut
+        r = _run_tool([os.path.join(TOOLS, "fleetz.py"), spool,
+                       "--stale-after", "3600"])
+        assert r.returncode == 0, r.stderr
+
+    def test_trace_view_fleet_empty_spool_fails(self, tmp_path):
+        d = str(tmp_path / "empty")
+        os.mkdir(d)
+        r = _run_tool([os.path.join(TOOLS, "trace_view.py"),
+                       "--fleet", d])
+        assert r.returncode == 1, r.stdout
+        assert "no rank traces stitched" in r.stderr
+
+    def test_trace_view_fleet_all_stale_fails(
+            self, tmp_path, registry):
+        spool = _stale_spool(tmp_path, registry)
+        r = _run_tool([os.path.join(TOOLS, "trace_view.py"),
+                       "--fleet", spool],
+                      env={"MXNET_FLEET_STALE": "0.05"})
+        assert r.returncode == 1, r.stdout
+        assert "STALE" in r.stderr

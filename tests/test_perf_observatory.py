@@ -255,12 +255,54 @@ def _records_bench_decode():
     return recs
 
 
+# a merged /goodputz payload the goodput emitter prices into ledger
+# records (canned — the real kill/resume drill lives in
+# tests/test_goodput.py)
+_GOODPUT_PAYLOAD = {
+    "active": True, "dir": "/tmp/goodput-job", "wall_s": 120.0,
+    "goodput_pct": 81.25, "goodput_s": 97.5, "badput_s": 22.5,
+    "buckets_s": {"goodput": 97.5, "lost_work": 6.0, "compile": 4.0,
+                  "ckpt_save": 2.0, "ckpt_restore": 1.0,
+                  "data_wait": 3.0, "startup": 2.5, "drain": 0.5,
+                  "other": 3.0},
+    "steps": 3200, "lost_steps": 200, "kills": 1,
+    "n_incarnations": 2, "n_ranks": 1,
+    "mttr": {"events": [{"rank": 0, "killed": 100.0,
+                         "resumed": 142.0, "mttr_s": 42.0}],
+             "mean_s": 42.0},
+}
+
+
+def _records_goodput():
+    from mxnet_tpu import goodput
+
+    recs = goodput.ledger_records(_GOODPUT_PAYLOAD)
+    assert {r["metric"] for r in recs} == {
+        "goodput_pct", "goodput_lost_work_s", "goodput_mttr_s"}
+    # inactive or wall-less payloads emit nothing rather than zeros
+    assert goodput.ledger_records({"active": False}) == []
+    assert goodput.ledger_records(
+        dict(_GOODPUT_PAYLOAD, wall_s=0.0)) == []
+    return recs
+
+
+def test_goodput_ledger_records_reject_malformed():
+    from mxnet_tpu import goodput
+
+    rec = goodput.ledger_records(_GOODPUT_PAYLOAD)[0]
+    for breakage in ({"unit": ""}, {"value": None},
+                     {"value": float("nan")}):
+        bad = dict(rec)
+        bad.update(breakage)
+        assert pl.validate_record(bad), breakage
+
+
 @pytest.mark.parametrize("builder", [
     _records_bench, _records_bench_lm, _records_bench_serving,
     _records_bench_fusion, _records_bench_checkpoint, _records_bench_io,
-    _records_bench_decode,
+    _records_bench_decode, _records_goodput,
 ], ids=["bench", "bench_lm", "bench_serving", "bench_fusion",
-        "bench_checkpoint", "bench_io", "bench_decode"])
+        "bench_checkpoint", "bench_io", "bench_decode", "goodput"])
 def test_every_emitter_builds_schema_valid_records(builder):
     recs = builder()
     assert recs, "emitter produced no records"

@@ -220,6 +220,16 @@ def main(argv=None):
         span_index = build_span_index(args.join)
     fields = [f.strip() for f in args.by.split(",") if f.strip()]
     out = ["%d event(s)" % len(events), ""]
+    if "rank" in fields:
+        # event files written before rank provenance existed carry no
+        # proc_id — they slice as rank 0, and we SAY so instead of
+        # silently folding old data into r0
+        legacy = sum(1 for e in events if "proc_id" not in e)
+        if legacy:
+            out.append("note: %d event(s) predate rank provenance "
+                       "(no proc_id field) — defaulted to rank 0"
+                       % legacy)
+            out.append("")
     out.extend(render_slices(events, fields))
     out.append("")
     out.extend(render_top(events, args.top, span_index))

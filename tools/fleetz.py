@@ -122,7 +122,29 @@ def main(argv=None):
         sys.stdout.write("\n")
     else:
         print(render(view, top=args.top))
-    return 0 if view.get("active") else 1
+    if not view.get("active"):
+        return 1
+    # an empty merge is a diagnosis, not a report: a spool with no
+    # durable snapshots (wrong dir? publishers never attached?) or one
+    # where every rank went stale (job dead? staleness cut too tight?)
+    # must say so and fail, never exit 0 with an empty table
+    ranks = view.get("ranks") or {}
+    if not ranks:
+        print("fleetz: no durable rank snapshots in %s — is this the "
+              "right spool dir, and did any FleetPublisher attach? "
+              "(%d torn snapshot(s))"
+              % (view["spool"], view.get("torn_snapshots", 0)),
+              file=sys.stderr)
+        return 1
+    if all(row.get("stale") for row in ranks.values()):
+        print("fleetz: all %d rank snapshot(s) in %s are stale "
+              "(older than %.1fs) — the job is dead or the "
+              "--stale-after cut is too tight"
+              % (len(ranks), view["spool"],
+                 view.get("stale_after_s", 0.0)),
+              file=sys.stderr)
+        return 1
+    return 0
 
 
 if __name__ == "__main__":

@@ -64,12 +64,18 @@ _LOWER_BETTER_UNITS = {"seconds", "s", "ms", "us", "bytes", "ms/token",
 
 
 def higher_is_better(metric, unit):
+    m = str(metric).lower()
+    # goodput regresses DOWNWARD (a drop means more badput), and its
+    # pct unit must never drift into a lower-better bucket: name-pin
+    # the direction ahead of the unit tables so the intent survives
+    # both a default flip and a future "pct" unit rule
+    if m == "goodput_pct" or m.endswith("_goodput_pct"):
+        return True
     u = str(unit).lower()
     if u in _HIGHER_BETTER_UNITS:
         return True
     if u in _LOWER_BETTER_UNITS:
         return False
-    m = str(metric).lower()
     if m.endswith(("_seconds", "_ms", "_latency", "_overhead_ms_per_save",
                    "_bytes", "_ttft_p50", "_ttft_p99", "_interference_p99")):
         return False

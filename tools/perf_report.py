@@ -319,11 +319,38 @@ def _buckets_of(attr):
             if isinstance(v, (int, float))}
 
 
+def goodput_report(job_dir, ledger=None):
+    """--goodput: render the job-lifetime goodput/badput report (the
+    same numbers /goodputz and the goodput statusz subsystem serve)
+    and, with --ledger, append the schema-valid goodput records."""
+    sys.path.insert(0, HERE)
+    from goodputz import load_goodput
+
+    gp = load_goodput()
+    payload = gp.goodputz(dir=job_dir)
+    print(gp.render_report(payload))
+    if not payload.get("active"):
+        print("perf_report: goodput: %s"
+              % payload.get("error", "inactive"), file=sys.stderr)
+        return 2
+    if not payload.get("n_incarnations"):
+        print("perf_report: goodput: no incarnation ledgers in %s"
+              % job_dir, file=sys.stderr)
+        return 2
+    if ledger:
+        recs = gp.ledger_records(payload)
+        pl.append(recs, path=ledger)
+        print("appended %d goodput record(s) to %s"
+              % (len(recs), ledger))
+    return 0
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    p.add_argument("--ledger", required=True,
+    p.add_argument("--ledger",
                    help="JSONL run ledger (perf_ledger.emit appends; "
-                        "MXNET_PERF_LEDGER names it for bench runs)")
+                        "MXNET_PERF_LEDGER names it for bench runs); "
+                        "required except with --goodput")
     p.add_argument("--run", help="report only this run id "
                                  "(default: every run, newest last)")
     p.add_argument("--diff", nargs=2, metavar=("RUN_A", "RUN_B"),
@@ -336,7 +363,19 @@ def main(argv=None):
                                    "the single-run view")
     p.add_argument("--telemetry", help="telemetry.dump() JSON to merge "
                                        "into the single-run view")
+    p.add_argument("--goodput", metavar="JOB_DIR",
+                   help="render the job-lifetime goodput report for "
+                        "this goodput dir (goodput.py ledgers); with "
+                        "--ledger, also appends the schema-valid "
+                        "goodput_pct/lost-work records so the bench "
+                        "history carries the job-level view")
     args = p.parse_args(argv)
+
+    if args.goodput:
+        return goodput_report(args.goodput, args.ledger)
+
+    if args.ledger is None:
+        p.error("--ledger is required (except with --goodput)")
 
     if args.backfill:
         return backfill(args.backfill, args.ledger)

@@ -298,6 +298,26 @@ def main(argv=None):
         payload, spool_problems = _stitch_fleet(args.paths[0], args.out)
         for msg in spool_problems:
             print("trace_view: fleet: %s" % msg, file=sys.stderr)
+        fl = (payload.get("otherData") or {}).get("fleet") or {}
+        stitched = fl.get("ranks") or []
+        stale = fl.get("stale") or []
+        if not stitched:
+            # nothing merged: diagnose instead of validating an empty
+            # timeline as a success
+            print("trace_view: fleet: no rank traces stitched from %s "
+                  "— no durable snapshots (wrong spool dir, or no "
+                  "publisher attached)?  %d torn snapshot(s)"
+                  % (args.paths[0], fl.get("torn_snapshots", 0)),
+                  file=sys.stderr)
+            return 1
+        if stale and len(stale) >= len(stitched) and \
+                all(r in stale for r in stitched):
+            print("trace_view: fleet: every stitched rank (%s) is "
+                  "STALE — the job is dead or the staleness cut is "
+                  "too tight; the timeline below is historical"
+                  % ",".join(str(r) for r in stale), file=sys.stderr)
+            summarize(payload, args.top)
+            return 1
         problems = validate(payload)
         summarize(payload, args.top)
         if args.tree:
